@@ -1,0 +1,19 @@
+"""sentio-tpu: a TPU-native retrieval-augmented generation framework.
+
+A brand-new framework with the capability surface of the reference RAG
+service (hybrid dense+BM25 retrieval with rrf/weighted_rrf/comb_sum fusion,
+scorer plugins, cross-encoder reranking, citation-grounded generation, LLM
+self-verification, ingestion/chunking, resilience ladder, caching, auth,
+observability) — re-designed TPU-first: every model runs in-process on a JAX
+device mesh (Flax bi-encoder, cross-encoder, Llama-class decoder with paged
+KV), requests are coalesced into data-parallel batches over ICI, and the
+dense index is an exact sharded matmul+top-k in HBM.
+
+This top-level module stays import-light: importing :mod:`sentio_tpu` must not
+pull in JAX (CLI startup, host-only tooling). Heavy subsystems live under
+``sentio_tpu.models`` / ``sentio_tpu.parallel`` / ``sentio_tpu.kernels``.
+"""
+
+__version__ = "0.1.0"
+
+__all__ = ["__version__"]

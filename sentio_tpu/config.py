@@ -1,0 +1,411 @@
+"""Typed configuration tree for the whole framework.
+
+The reference scatters ~60 env-aliased pydantic-settings fields plus ad-hoc
+``os.getenv`` at use sites (/root/reference/src/utils/settings.py:27-191,
+retrievers/factory.py:35-48). Here there is ONE typed tree, built once from
+the environment via :func:`Settings.from_env`, with the reference's env names
+kept as aliases so existing deployments carry over — plus a TPU section the
+reference never needed (mesh shape, dtype, KV paging, batching deadline).
+
+No pydantic dependency at this layer: plain dataclasses keep import cost ~0
+and make the tree trivially picklable into worker processes.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, replace
+from typing import Optional, Sequence
+
+__all__ = [
+    "ChunkingConfig",
+    "RetrievalConfig",
+    "RerankConfig",
+    "GeneratorConfig",
+    "EmbedderConfig",
+    "MeshConfig",
+    "ServeConfig",
+    "CacheConfig",
+    "AuthConfig",
+    "ObservabilityConfig",
+    "Settings",
+    "get_settings",
+    "set_settings",
+]
+
+
+def _env_str(names: Sequence[str], default: str) -> str:
+    for name in names:
+        value = os.environ.get(name)
+        if value is not None and value != "":
+            return value
+    return default
+
+
+def _env_int(names: Sequence[str], default: int) -> int:
+    raw = _env_str(names, "")
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def _env_float(names: Sequence[str], default: float) -> float:
+    raw = _env_str(names, "")
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def _env_bool(names: Sequence[str], default: bool) -> bool:
+    raw = _env_str(names, "").strip().lower()
+    if not raw:
+        return default
+    return raw in ("1", "true", "yes", "on")
+
+
+@dataclass
+class ChunkingConfig:
+    """Splitter settings (reference: chunking/text_splitter.py:23-80)."""
+
+    strategy: str = "recursive"  # recursive | fixed | sentence
+    chunk_size: int = 512
+    chunk_overlap: int = 64
+
+    @classmethod
+    def from_env(cls) -> "ChunkingConfig":
+        return cls(
+            strategy=_env_str(["CHUNKING_STRATEGY"], "recursive"),
+            chunk_size=_env_int(["CHUNK_SIZE"], 512),
+            chunk_overlap=_env_int(["CHUNK_OVERLAP"], 64),
+        )
+
+
+@dataclass
+class RetrievalConfig:
+    """Retriever strategy + fusion knobs (reference: retrievers/factory.py:21-196)."""
+
+    strategy: str = "hybrid"  # dense | bm25 | hybrid
+    top_k: int = 10
+    rrf_k: int = 60
+    fusion_method: str = "rrf"  # rrf | weighted_rrf | comb_sum
+    dense_weight: float = 0.7
+    sparse_weight: float = 0.3
+    # scorer plugin stack (reference default weights 0.8/0.2/0.5, factory.py:64-80)
+    use_scorers: bool = False
+    keyword_scorer_weight: float = 0.8
+    recency_scorer_weight: float = 0.2
+    mmr_scorer_weight: float = 0.5
+    mmr_lambda: float = 0.7
+    # BM25 parameters (Okapi defaults; pyserini used k1=0.9 b=0.4 at scale)
+    bm25_k1: float = 1.5
+    bm25_b: float = 0.75
+    bm25_backend: str = "auto"  # auto | numpy | native
+    # dense index
+    index_backend: str = "tpu"  # tpu | qdrant
+    collection_name: str = "sentio"
+
+    @classmethod
+    def from_env(cls) -> "RetrievalConfig":
+        return cls(
+            strategy=_env_str(["RETRIEVAL_STRATEGY", "RETRIEVER_TYPE"], "hybrid"),
+            top_k=_env_int(["RETRIEVAL_TOP_K", "TOP_K"], 10),
+            rrf_k=_env_int(["RRF_K"], 60),
+            fusion_method=_env_str(["FUSION_METHOD", "HYBRID_FUSION"], "rrf"),
+            dense_weight=_env_float(["DENSE_WEIGHT"], 0.7),
+            sparse_weight=_env_float(["SPARSE_WEIGHT"], 0.3),
+            use_scorers=_env_bool(["USE_SCORERS"], False),
+            keyword_scorer_weight=_env_float(["KEYWORD_SCORER_WEIGHT"], 0.8),
+            recency_scorer_weight=_env_float(["RECENCY_SCORER_WEIGHT"], 0.2),
+            mmr_scorer_weight=_env_float(["MMR_SCORER_WEIGHT"], 0.5),
+            mmr_lambda=_env_float(["MMR_LAMBDA"], 0.7),
+            bm25_k1=_env_float(["BM25_K1"], 1.5),
+            bm25_b=_env_float(["BM25_B"], 0.75),
+            bm25_backend=_env_str(["BM25_BACKEND"], "auto"),
+            index_backend=_env_str(["INDEX_BACKEND", "VECTOR_STORE"], "tpu"),
+            collection_name=_env_str(["COLLECTION_NAME", "QDRANT_COLLECTION"], "sentio"),
+        )
+
+
+@dataclass
+class RerankConfig:
+    """Reranker selection (reference: rerankers/__init__.py:11-30, jina_reranker.py)."""
+
+    enabled: bool = True
+    kind: str = "cross_encoder"  # cross_encoder | passthrough
+    top_k: int = 5
+    max_pair_tokens: int = 512
+    batch_size: int = 32
+
+    @classmethod
+    def from_env(cls) -> "RerankConfig":
+        return cls(
+            enabled=_env_bool(["USE_RERANKER"], True),
+            kind=_env_str(["RERANKER_KIND", "RERANKER_TYPE"], "cross_encoder"),
+            top_k=_env_int(["RERANK_TOP_K"], 5),
+            max_pair_tokens=_env_int(["RERANK_MAX_PAIR_TOKENS"], 512),
+            batch_size=_env_int(["RERANK_BATCH_SIZE"], 32),
+        )
+
+
+@dataclass
+class EmbedderConfig:
+    """Bi-encoder settings. ``provider='tpu'`` is the in-process Flax model;
+    ``'hash'`` is the deterministic offline fake (the reference's mock-mode
+    pattern, jina.py:141-159 there) used by tests and no-hardware dev."""
+
+    provider: str = "tpu"  # tpu | hash
+    dim: int = 1024
+    max_tokens: int = 512
+    batch_size: int = 128
+    cache_size: int = 10_000
+    cache_ttl_s: float = 3600.0
+    model_preset: str = "base"  # tiny | base (tiny = CPU-test scale)
+
+    @classmethod
+    def from_env(cls) -> "EmbedderConfig":
+        return cls(
+            provider=_env_str(["EMBEDDER_PROVIDER", "EMBEDDING_PROVIDER"], "tpu"),
+            dim=_env_int(["EMBEDDING_DIM"], 1024),
+            max_tokens=_env_int(["EMBED_MAX_TOKENS"], 512),
+            batch_size=_env_int(["EMBED_BATCH_SIZE"], 128),
+            cache_size=_env_int(["EMBEDDING_CACHE_SIZE"], 10_000),
+            cache_ttl_s=_env_float(["EMBEDDING_CACHE_TTL"], 3600.0),
+            model_preset=_env_str(["EMBEDDER_PRESET"], "base"),
+        )
+
+
+@dataclass
+class GeneratorConfig:
+    """Generator/verifier settings (reference: llm/factory.py:14-69,
+    graph/factory.py:90,145 — context budget 2000 tok, 1024 max new)."""
+
+    provider: str = "tpu"  # tpu | echo (deterministic fake)
+    model_preset: str = "llama3-8b"  # llama3-8b | tiny
+    checkpoint_path: str = ""
+    mode: str = "balanced"  # fast | balanced | quality | creative
+    max_new_tokens: int = 1024
+    context_token_budget: int = 2000
+    max_prompt_tokens: int = 4096
+    use_verifier: bool = True
+    verifier_max_tokens: int = 512
+    dtype: str = "bfloat16"
+    kv_page_size: int = 128
+    kv_max_pages_per_seq: int = 64
+    max_batch_size: int = 8
+    prefill_buckets: tuple[int, ...] = (256, 512, 1024, 2048, 4096)
+    temperature_by_mode: tuple[tuple[str, float], ...] = (
+        ("fast", 0.0),
+        ("balanced", 0.3),
+        ("quality", 0.2),
+        ("creative", 0.7),
+    )
+
+    def temperature(self, mode: Optional[str] = None) -> float:
+        table = dict(self.temperature_by_mode)
+        return table.get(mode or self.mode, 0.3)
+
+    @classmethod
+    def from_env(cls) -> "GeneratorConfig":
+        return cls(
+            provider=_env_str(["LLM_PROVIDER", "CHAT_LLM_PROVIDER"], "tpu"),
+            model_preset=_env_str(["LLM_MODEL", "CHAT_LLM_MODEL"], "llama3-8b"),
+            checkpoint_path=_env_str(["LLM_CHECKPOINT", "MODEL_PATH"], ""),
+            mode=_env_str(["LLM_MODE"], "balanced"),
+            max_new_tokens=_env_int(["LLM_MAX_TOKENS", "MAX_NEW_TOKENS"], 1024),
+            context_token_budget=_env_int(["CONTEXT_TOKEN_BUDGET"], 2000),
+            max_prompt_tokens=_env_int(["MAX_PROMPT_TOKENS"], 4096),
+            use_verifier=_env_bool(["USE_VERIFIER"], True),
+            verifier_max_tokens=_env_int(["VERIFIER_MAX_TOKENS"], 512),
+            dtype=_env_str(["LLM_DTYPE"], "bfloat16"),
+            kv_page_size=_env_int(["KV_PAGE_SIZE"], 128),
+            kv_max_pages_per_seq=_env_int(["KV_MAX_PAGES_PER_SEQ"], 64),
+            max_batch_size=_env_int(["LLM_MAX_BATCH"], 8),
+        )
+
+
+@dataclass
+class MeshConfig:
+    """TPU mesh geometry. Axes: ``dp`` (data/batch over ICI), ``tp`` (tensor
+    sharding of model weights), ``sp`` (sequence/context parallel). A zero
+    means "infer from available devices" (all devices on dp unless tp_size
+    set). Multi-slice deployments add a leading ``dcn`` data axis."""
+
+    dp_size: int = 0
+    tp_size: int = 1
+    sp_size: int = 1
+    dcn_size: int = 1
+    backend: str = ""  # "" = jax default; "cpu" to force host platform
+
+    @classmethod
+    def from_env(cls) -> "MeshConfig":
+        return cls(
+            dp_size=_env_int(["MESH_DP"], 0),
+            tp_size=_env_int(["MESH_TP"], 1),
+            sp_size=_env_int(["MESH_SP"], 1),
+            dcn_size=_env_int(["MESH_DCN"], 1),
+            backend=_env_str(["MESH_BACKEND"], ""),
+        )
+
+
+@dataclass
+class ServeConfig:
+    """HTTP serving surface (reference: api/app.py:81-101, 250-281)."""
+
+    host: str = "0.0.0.0"
+    port: int = 8000
+    # per-IP sliding-window limits (reference: 10/min /embed, 100/min rest)
+    rate_limit_embed_per_min: int = 10
+    rate_limit_default_per_min: int = 100
+    max_question_chars: int = 2000
+    max_embed_chars: int = 50_000
+    top_k_max: int = 20
+    cors_origins: str = "*"
+    # request coalescing for the TPU batcher
+    batch_deadline_ms: float = 8.0
+    batch_max_size: int = 8
+
+    @classmethod
+    def from_env(cls) -> "ServeConfig":
+        return cls(
+            host=_env_str(["API_HOST", "HOST"], "0.0.0.0"),
+            port=_env_int(["API_PORT", "PORT"], 8000),
+            rate_limit_embed_per_min=_env_int(["RATE_LIMIT_EMBED"], 10),
+            rate_limit_default_per_min=_env_int(["RATE_LIMIT_DEFAULT"], 100),
+            max_question_chars=_env_int(["MAX_QUESTION_CHARS"], 2000),
+            max_embed_chars=_env_int(["MAX_EMBED_CHARS"], 50_000),
+            top_k_max=_env_int(["TOP_K_MAX"], 20),
+            cors_origins=_env_str(["CORS_ORIGINS"], "*"),
+            batch_deadline_ms=_env_float(["BATCH_DEADLINE_MS"], 8.0),
+            batch_max_size=_env_int(["BATCH_MAX_SIZE"], 8),
+        )
+
+
+@dataclass
+class CacheConfig:
+    """Cache tiers (reference: caching/cache_manager.py:18-125)."""
+
+    backend: str = "memory"  # memory | multi_tier (L2 hook) | off
+    max_entries: int = 10_000
+    default_ttl_s: float = 3600.0
+    query_cache_ttl_s: float = 600.0
+
+    @classmethod
+    def from_env(cls) -> "CacheConfig":
+        return cls(
+            backend=_env_str(["CACHE_BACKEND"], "memory"),
+            max_entries=_env_int(["CACHE_MAX_ENTRIES"], 10_000),
+            default_ttl_s=_env_float(["CACHE_TTL"], 3600.0),
+            query_cache_ttl_s=_env_float(["QUERY_CACHE_TTL"], 600.0),
+        )
+
+
+@dataclass
+class AuthConfig:
+    """Auth/security (reference: utils/auth.py:30-77). Disabled by default in
+    dev; JWT is stdlib HMAC-SHA256."""
+
+    enabled: bool = False
+    jwt_secret: str = ""
+    access_ttl_s: int = 1800
+    refresh_ttl_s: int = 7 * 24 * 3600
+    max_failed_attempts: int = 5
+    lockout_s: int = 900
+    min_password_len: int = 12
+
+    @classmethod
+    def from_env(cls) -> "AuthConfig":
+        return cls(
+            enabled=_env_bool(["AUTH_ENABLED"], False),
+            jwt_secret=_env_str(["JWT_SECRET", "JWT_SECRET_KEY"], ""),
+            access_ttl_s=_env_int(["JWT_ACCESS_TTL"], 1800),
+            refresh_ttl_s=_env_int(["JWT_REFRESH_TTL"], 7 * 24 * 3600),
+            max_failed_attempts=_env_int(["AUTH_MAX_FAILED"], 5),
+            lockout_s=_env_int(["AUTH_LOCKOUT_S"], 900),
+            min_password_len=_env_int(["AUTH_MIN_PASSWORD_LEN"], 12),
+        )
+
+
+@dataclass
+class ObservabilityConfig:
+    """Tracing + metrics (reference: observability/tracing.py, metrics.py)."""
+
+    tracing_enabled: bool = False
+    otlp_endpoint: str = ""
+    console_exporter: bool = False
+    service_name: str = "sentio-tpu"
+    metrics_enabled: bool = True
+    monitor_interval_s: float = 30.0
+    profiler_dir: str = ""  # non-empty => jax.profiler traces per batch step
+
+    @classmethod
+    def from_env(cls) -> "ObservabilityConfig":
+        return cls(
+            tracing_enabled=_env_bool(["TRACING_ENABLED", "OTEL_ENABLED"], False),
+            otlp_endpoint=_env_str(["OTEL_EXPORTER_OTLP_ENDPOINT"], ""),
+            console_exporter=_env_bool(["OTEL_CONSOLE"], False),
+            service_name=_env_str(["OTEL_SERVICE_NAME"], "sentio-tpu"),
+            metrics_enabled=_env_bool(["METRICS_ENABLED"], True),
+            monitor_interval_s=_env_float(["MONITOR_INTERVAL_S"], 30.0),
+            profiler_dir=_env_str(["JAX_PROFILER_DIR"], ""),
+        )
+
+
+@dataclass
+class Settings:
+    """The whole tree. Build with :func:`Settings.from_env` once at startup;
+    tests construct it directly with overrides (no env mutation needed)."""
+
+    chunking: ChunkingConfig = field(default_factory=ChunkingConfig)
+    retrieval: RetrievalConfig = field(default_factory=RetrievalConfig)
+    rerank: RerankConfig = field(default_factory=RerankConfig)
+    embedder: EmbedderConfig = field(default_factory=EmbedderConfig)
+    generator: GeneratorConfig = field(default_factory=GeneratorConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
+    cache: CacheConfig = field(default_factory=CacheConfig)
+    auth: AuthConfig = field(default_factory=AuthConfig)
+    observability: ObservabilityConfig = field(default_factory=ObservabilityConfig)
+    data_dir: str = ".sentio"
+
+    @classmethod
+    def from_env(cls) -> "Settings":
+        return cls(
+            chunking=ChunkingConfig.from_env(),
+            retrieval=RetrievalConfig.from_env(),
+            rerank=RerankConfig.from_env(),
+            embedder=EmbedderConfig.from_env(),
+            generator=GeneratorConfig.from_env(),
+            mesh=MeshConfig.from_env(),
+            serve=ServeConfig.from_env(),
+            cache=CacheConfig.from_env(),
+            auth=AuthConfig.from_env(),
+            observability=ObservabilityConfig.from_env(),
+            data_dir=_env_str(["SENTIO_DATA_DIR"], ".sentio"),
+        )
+
+    def with_overrides(self, **sections) -> "Settings":
+        return replace(self, **sections)
+
+
+_settings: Optional[Settings] = None
+
+
+def get_settings() -> Settings:
+    """Process-wide settings singleton, built lazily from the environment."""
+    global _settings
+    if _settings is None:
+        _settings = Settings.from_env()
+    return _settings
+
+
+def set_settings(settings: Optional[Settings]) -> None:
+    """Install (or clear, with None) the singleton — used by tests and serve
+    startup to pin an explicit tree."""
+    global _settings
+    _settings = settings

@@ -1,0 +1,154 @@
+"""Minimal typed DAG executor — the framework's LangGraph replacement.
+
+The reference assembles its pipeline as a LangGraph ``StateGraph`` with
+conditional edges (/root/reference/src/core/graph/factory.py:94-188). We need
+the same shape — named nodes over a shared state, static and conditional
+edges, sync + async invocation — but with zero external deps and with stage
+boundaries that double as host/TPU dispatch points (a node is free to await a
+batched device call). Nodes return *partial* state updates; the executor
+merges them, records per-node wall time, and never lets a node exception kill
+the pipeline unless the node opts out of soft-fail.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Mapping, Optional, Union
+
+logger = logging.getLogger(__name__)
+
+END = "__end__"
+
+NodeFn = Callable[[dict], Union[Mapping[str, Any], Awaitable[Mapping[str, Any]], None]]
+RouterFn = Callable[[dict], str]
+
+
+class GraphError(Exception):
+    """Raised for structural problems (unknown node, no entry point, cycles
+    past the step limit) — never for node-level soft failures."""
+
+
+@dataclass
+class _Node:
+    name: str
+    fn: NodeFn
+    soft_fail: bool = True
+
+
+@dataclass
+class CompiledGraph:
+    """An immutable, runnable pipeline. Build via :class:`GraphBuilder`."""
+
+    nodes: dict[str, _Node]
+    edges: dict[str, Union[str, RouterFn]]
+    entry: str
+    max_steps: int = 64
+
+    async def ainvoke(self, state: dict, config: Optional[dict] = None) -> dict:
+        state = dict(state)
+        meta = dict(state.get("metadata", {}))
+        if config:
+            meta.setdefault("graph_config", dict(config))
+        state["metadata"] = meta
+
+        current = self.entry
+        steps = 0
+        path: list[str] = []
+        while current != END:
+            if current not in self.nodes:
+                raise GraphError(f"unknown node {current!r} (path so far: {path})")
+            steps += 1
+            if steps > self.max_steps:
+                raise GraphError(f"step limit {self.max_steps} exceeded; path: {path}")
+            node = self.nodes[current]
+            path.append(current)
+            t0 = time.perf_counter()
+            try:
+                update = node.fn(state)
+                if inspect.isawaitable(update):
+                    update = await update
+            except Exception as exc:  # noqa: BLE001 — soft-fail ladder by design
+                if not node.soft_fail:
+                    raise
+                logger.exception("node %s failed softly", node.name)
+                update = {"metadata": {f"{node.name}_error": str(exc)}}
+            dt_ms = (time.perf_counter() - t0) * 1000.0
+            state = _merge(state, update)
+            timings = dict(state["metadata"].get("node_timings_ms", {}))
+            timings[node.name] = round(timings.get(node.name, 0.0) + dt_ms, 3)
+            state["metadata"]["node_timings_ms"] = timings
+
+            edge = self.edges.get(current, END)
+            current = edge(state) if callable(edge) else edge
+        state["metadata"]["graph_path"] = path
+        return state
+
+    def invoke(self, state: dict, config: Optional[dict] = None) -> dict:
+        """Sync entry point. Safe to call when no event loop is running."""
+        return asyncio.run(self.ainvoke(state, config))
+
+
+def _merge(state: dict, update: Optional[Mapping[str, Any]]) -> dict:
+    if not update:
+        return state
+    new = dict(state)
+    for key, value in update.items():
+        if key == "metadata" and isinstance(value, Mapping):
+            meta = dict(new.get("metadata", {}))
+            meta.update(value)
+            new["metadata"] = meta
+        else:
+            new[key] = value
+    return new
+
+
+@dataclass
+class GraphBuilder:
+    """Fluent builder mirroring the reference's StateGraph assembly surface:
+    ``add_node`` / ``add_edge`` / ``add_conditional_edge`` / ``set_entry``."""
+
+    _nodes: dict[str, _Node] = field(default_factory=dict)
+    _edges: dict[str, Union[str, RouterFn]] = field(default_factory=dict)
+    _entry: Optional[str] = None
+    max_steps: int = 64
+
+    def add_node(self, name: str, fn: NodeFn, soft_fail: bool = True) -> "GraphBuilder":
+        if name == END:
+            raise GraphError(f"{END!r} is reserved")
+        if name in self._nodes:
+            raise GraphError(f"duplicate node {name!r}")
+        self._nodes[name] = _Node(name, fn, soft_fail)
+        return self
+
+    def add_edge(self, src: str, dst: str) -> "GraphBuilder":
+        self._edges[src] = dst
+        return self
+
+    def add_conditional_edge(self, src: str, router: RouterFn) -> "GraphBuilder":
+        self._edges[src] = router
+        return self
+
+    def set_entry(self, name: str) -> "GraphBuilder":
+        self._entry = name
+        return self
+
+    def compile(self) -> CompiledGraph:
+        if not self._entry:
+            raise GraphError("no entry point set")
+        if self._entry not in self._nodes:
+            raise GraphError(f"entry {self._entry!r} is not a node")
+        for src, edge in self._edges.items():
+            if src not in self._nodes:
+                raise GraphError(f"edge from unknown node {src!r}")
+            if isinstance(edge, str) and edge != END and edge not in self._nodes:
+                raise GraphError(f"edge to unknown node {edge!r}")
+        return CompiledGraph(
+            nodes=dict(self._nodes),
+            edges=dict(self._edges),
+            entry=self._entry,
+            max_steps=self.max_steps,
+        )

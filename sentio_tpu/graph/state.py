@@ -1,0 +1,96 @@
+"""Pipeline state flowing through the RAG graph.
+
+Parity with the reference's ``RAGState`` TypedDict + pure mutators
+(/root/reference/src/core/graph/state.py:10-139): query, retrieved/reranked/
+selected documents, response, metadata, evaluation. State is a plain dict and
+every mutator is pure (returns a new dict) — node functions return *partial*
+updates which the executor merges, which is also what makes the executor
+trivially resumable and traceable.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, TypedDict
+
+from sentio_tpu.models.document import Document
+
+
+class RAGState(TypedDict, total=False):
+    query: str
+    query_id: str
+    retrieved_documents: list[Document]
+    reranked_documents: list[Document]
+    selected_documents: list[Document]
+    context: str
+    response: str
+    metadata: dict[str, Any]
+    evaluation: dict[str, Any]
+
+
+def create_initial_state(query: str, metadata: dict[str, Any] | None = None) -> RAGState:
+    return RAGState(
+        query=query,
+        query_id=str(uuid.uuid4()),
+        retrieved_documents=[],
+        reranked_documents=[],
+        selected_documents=[],
+        context="",
+        response="",
+        metadata=dict(metadata or {}),
+        evaluation={},
+    )
+
+
+def _merged_meta(state: RAGState, extra: dict[str, Any]) -> dict[str, Any]:
+    meta = dict(state.get("metadata", {}))
+    meta.update(extra)
+    return meta
+
+
+def add_retrieved_documents(state: RAGState, docs: list[Document]) -> RAGState:
+    new = dict(state)
+    new["retrieved_documents"] = list(docs)
+    new["metadata"] = _merged_meta(state, {"num_retrieved": len(docs), "retrieved_at": time.time()})
+    return new  # type: ignore[return-value]
+
+
+def add_reranked_documents(state: RAGState, docs: list[Document]) -> RAGState:
+    new = dict(state)
+    new["reranked_documents"] = list(docs)
+    new["metadata"] = _merged_meta(state, {"num_reranked": len(docs)})
+    return new  # type: ignore[return-value]
+
+
+def add_selected_documents(state: RAGState, docs: list[Document], context: str = "") -> RAGState:
+    new = dict(state)
+    new["selected_documents"] = list(docs)
+    if context:
+        new["context"] = context
+    new["metadata"] = _merged_meta(state, {"num_selected": len(docs)})
+    return new  # type: ignore[return-value]
+
+
+def set_response(state: RAGState, response: str, **meta: Any) -> RAGState:
+    new = dict(state)
+    new["response"] = response
+    if meta:
+        new["metadata"] = _merged_meta(state, meta)
+    return new  # type: ignore[return-value]
+
+
+def set_evaluation(state: RAGState, evaluation: dict[str, Any]) -> RAGState:
+    new = dict(state)
+    new["evaluation"] = dict(evaluation)
+    return new  # type: ignore[return-value]
+
+
+def best_documents(state: RAGState) -> list[Document]:
+    """The most-processed document list available — selector falls back through
+    reranked → retrieved (reference nodes.py:269-301 semantics)."""
+    for key in ("selected_documents", "reranked_documents", "retrieved_documents"):
+        docs = state.get(key)
+        if docs:
+            return docs  # type: ignore[return-value]
+    return []

@@ -1,0 +1,67 @@
+"""Core document model.
+
+Capability parity with the reference's ``Document`` dataclass
+(/root/reference/src/core/models/document.py:8-20): ``text`` + ``metadata`` +
+auto-uuid ``id``. We additionally carry an optional host-side ``embedding``
+(numpy array) because in this framework embeddings are produced in-process
+(TPU forward pass) and flow through the ingest pipeline with the document
+rather than living only in an external vector store.
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+def _new_id() -> str:
+    return str(uuid.uuid4())
+
+
+@dataclass
+class Document:
+    """A unit of retrievable text with metadata and optional embedding."""
+
+    text: str
+    metadata: dict[str, Any] = field(default_factory=dict)
+    id: str = field(default_factory=_new_id)
+    embedding: Optional[Any] = None  # numpy ndarray when present; never a jax array
+
+    def __post_init__(self) -> None:
+        if self.metadata is None:
+            self.metadata = {}
+
+    @property
+    def content(self) -> str:
+        """Text with the reference's content-normalization fallback.
+
+        The reference tolerates documents whose text migrated into
+        ``metadata['content']`` (nodes.py:76-79 there); we keep that contract
+        so payloads from external stores round-trip.
+        """
+        if self.text:
+            return self.text
+        return str(self.metadata.get("content", "") or "")
+
+    def score(self, default: float = 0.0) -> float:
+        """Best-known relevance score from metadata."""
+        for key in ("hybrid_score", "rerank_score", "score"):
+            value = self.metadata.get(key)
+            if value is not None:
+                try:
+                    return float(value)
+                except (TypeError, ValueError):
+                    continue
+        return default
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"id": self.id, "text": self.content, "metadata": dict(self.metadata)}
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "Document":
+        return cls(
+            text=str(data.get("text", "") or ""),
+            metadata=dict(data.get("metadata", {}) or {}),
+            id=str(data.get("id") or _new_id()),
+        )

@@ -1,0 +1,45 @@
+"""Test harness configuration.
+
+Force JAX onto the host CPU platform with 8 virtual devices BEFORE jax is
+imported anywhere — this is how multi-chip sharding (dp/tp/sp meshes,
+collectives) is exercised on a single host with no TPU attached, mirroring
+the reference's mock-backend test strategy (SURVEY.md §4) at the device
+level.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from sentio_tpu.config import Settings, set_settings  # noqa: E402
+
+
+@pytest.fixture()
+def settings():
+    """A fresh default Settings tree pinned as the singleton for the test."""
+    s = Settings()
+    set_settings(s)
+    yield s
+    set_settings(None)
+
+
+@pytest.fixture()
+def docs():
+    from sentio_tpu.models.document import Document
+
+    corpus = [
+        ("d1", "The quick brown fox jumps over the lazy dog."),
+        ("d2", "TPUs accelerate matrix multiplication with a systolic array."),
+        ("d3", "JAX composes function transformations like jit grad and vmap."),
+        ("d4", "The dog sleeps while the fox runs through the forest."),
+        ("d5", "Retrieval augmented generation combines search with language models."),
+        ("d6", "BM25 is a ranking function used by search engines for scoring."),
+        ("d7", "Flash attention tiles the softmax computation to save memory bandwidth."),
+        ("d8", "A lazy dog and a quick fox are common in typing exercises."),
+    ]
+    return [Document(text=t, id=i, metadata={"source": f"{i}.txt"}) for i, t in corpus]

@@ -1,0 +1,95 @@
+import numpy as np
+
+from sentio_tpu.models.document import Document
+from sentio_tpu.ops.bm25 import BM25Index, BM25Params, default_tokenizer
+
+
+def test_tokenizer_lowercases_and_splits():
+    assert default_tokenizer("Hello, World! 42") == ["hello", "world", "42"]
+
+
+def test_exact_term_match_ranks_first(docs):
+    index = BM25Index().build(docs)
+    results = index.retrieve("systolic array matrix", top_k=3)
+    assert results
+    assert results[0].id == "d2"
+    assert results[0].metadata["score"] > 0
+
+
+def test_scores_match_naive_okapi(docs):
+    """Vectorized CSR scoring must equal a straightforward per-doc loop."""
+    params = BM25Params(k1=1.2, b=0.6)
+    index = BM25Index(params=params).build(docs)
+    query = "quick fox dog"
+    fast = index.scores(query)
+
+    # naive implementation
+    tokenized = [default_tokenizer(d.content) for d in docs]
+    n = len(docs)
+    avgdl = sum(len(t) for t in tokenized) / n
+    naive = np.zeros(n)
+    for tok in default_tokenizer(query):
+        df = sum(1 for t in tokenized if tok in t)
+        if df == 0:
+            continue
+        idf = max(np.log(1 + (n - df + 0.5) / (df + 0.5)), 0.0)
+        for di, toks in enumerate(tokenized):
+            tf = toks.count(tok)
+            if tf == 0:
+                continue
+            denom = tf + params.k1 * (1 - params.b + params.b * len(toks) / avgdl)
+            naive[di] += idf * tf * (params.k1 + 1) / denom
+    np.testing.assert_allclose(fast, naive, rtol=1e-5)
+
+
+def test_unknown_terms_score_zero(docs):
+    index = BM25Index().build(docs)
+    assert index.search("zzzxqwv nonexistent", top_k=5) == []
+
+
+def test_repeated_query_terms_accumulate(docs):
+    index = BM25Index().build(docs)
+    single = index.scores("fox")
+    double = index.scores("fox fox")
+    np.testing.assert_allclose(double, single * 2, rtol=1e-5)
+
+
+def test_bm25_plus_delta_boosts_matches(docs):
+    okapi = BM25Index(BM25Params()).build(docs)
+    plus = BM25Index(BM25Params(variant="plus")).build(docs)
+    q = "fox"
+    s_ok, s_plus = okapi.scores(q), plus.scores(q)
+    matched = s_ok > 0
+    assert (s_plus[matched] > s_ok[matched]).all()
+    assert (s_plus[~matched] == 0).all()
+
+
+def test_save_load_roundtrip(tmp_path, docs):
+    index = BM25Index().build(docs)
+    index.save(tmp_path / "bm25")
+    loaded = BM25Index.load(tmp_path / "bm25")
+    q = "retrieval language models"
+    np.testing.assert_allclose(loaded.scores(q), index.scores(q), rtol=1e-6)
+    orig = [(d.id, d.metadata["score"]) for d in index.retrieve(q, 5)]
+    new = [(d.id, d.metadata["score"]) for d in loaded.retrieve(q, 5)]
+    assert orig == new
+
+
+def test_empty_corpus():
+    index = BM25Index().build([])
+    assert index.search("anything") == []
+    assert index.scores("anything").shape == (0,)
+
+
+def test_load_with_custom_tokenizer_guard(tmp_path, docs):
+    def shouty(text):
+        return text.upper().split()
+
+    index = BM25Index(tokenizer=shouty).build(docs)
+    index.save(tmp_path / "custom")
+    import pytest
+
+    with pytest.raises(ValueError, match="custom tokenizer"):
+        BM25Index.load(tmp_path / "custom")
+    loaded = BM25Index.load(tmp_path / "custom", tokenizer=shouty)
+    np.testing.assert_allclose(loaded.scores("quick FOX"), index.scores("quick FOX"))

@@ -1,0 +1,82 @@
+import pytest
+
+from sentio_tpu.config import ChunkingConfig
+from sentio_tpu.models.document import Document
+from sentio_tpu.ops.chunking import ChunkingError, TextChunker
+
+
+def test_short_text_single_chunk():
+    chunker = TextChunker(ChunkingConfig(chunk_size=100, chunk_overlap=10))
+    assert chunker.split_text("hello world") == ["hello world"]
+
+
+def test_empty_text_no_chunks():
+    chunker = TextChunker(ChunkingConfig())
+    assert chunker.split_text("") == []
+    assert chunker.split_text("   \n  ") == []
+
+
+def test_chunks_respect_size():
+    text = "para one.\n\n" + ("word " * 200) + "\n\nfinal para."
+    chunker = TextChunker(ChunkingConfig(chunk_size=120, chunk_overlap=20))
+    chunks = chunker.split_text(text)
+    assert len(chunks) > 1
+    assert all(len(c) <= 120 for c in chunks)
+
+
+def test_no_content_lost():
+    text = "alpha beta gamma. " * 50
+    chunker = TextChunker(ChunkingConfig(chunk_size=80, chunk_overlap=0))
+    chunks = chunker.split_text(text)
+    assert "".join(chunks).replace(" ", "") == text.replace(" ", "").rstrip()
+
+
+def test_overlap_carried():
+    text = "abcdefghij " * 30
+    chunker = TextChunker(ChunkingConfig(chunk_size=50, chunk_overlap=10, strategy="fixed"))
+    chunks = chunker.split_text(text)
+    for prev, nxt in zip(chunks, chunks[1:]):
+        assert prev[-5:] in text  # overlap region exists in source
+
+
+def test_split_documents_preserves_parent_metadata():
+    chunker = TextChunker(ChunkingConfig(chunk_size=40, chunk_overlap=5))
+    doc = Document(text="sentence one. " * 20, metadata={"source": "a.txt"}, id="doc-1")
+    chunks = chunker.split([doc])
+    assert len(chunks) > 1
+    for i, c in enumerate(chunks):
+        assert c.metadata["parent_id"] == "doc-1"
+        assert c.metadata["chunk_index"] == i
+        assert c.metadata["source"] == "a.txt"
+        assert c.id == f"doc-1:{i}"
+    stats = chunker.get_stats()
+    assert stats["documents"] == 1
+    assert stats["chunks"] == len(chunks)
+
+
+def test_invalid_config_rejected():
+    with pytest.raises(ChunkingError):
+        TextChunker(ChunkingConfig(chunk_size=0))
+    with pytest.raises(ChunkingError):
+        TextChunker(ChunkingConfig(chunk_size=10, chunk_overlap=10))
+    with pytest.raises(ChunkingError):
+        TextChunker(ChunkingConfig(strategy="bogus"))
+
+
+def test_pack_no_infinite_loop_on_exact_size_piece():
+    # regression: a piece of exactly chunk_size chars after a flush used to spin forever
+    chunker = TextChunker(ChunkingConfig(chunk_size=10, chunk_overlap=3))
+    chunks = chunker.split_text("abcd abcdefghi x")
+    assert chunks
+    assert all(len(c) <= 10 for c in chunks)
+
+
+def test_sentence_strategy():
+    text = "First sentence here. Second one follows! Third asks? Fourth ends."
+    chunker = TextChunker(ChunkingConfig(chunk_size=45, chunk_overlap=0, strategy="sentence"))
+    chunks = chunker.split_text(text)
+    assert len(chunks) >= 2
+    assert all(len(c) <= 45 for c in chunks)
+    rejoined = " ".join(chunks)
+    for word in ("First", "Second", "Third", "Fourth"):
+        assert word in rejoined

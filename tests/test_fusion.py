@@ -1,0 +1,62 @@
+import pytest
+
+from sentio_tpu.models.document import Document
+from sentio_tpu.ops.fusion import fuse
+
+
+def _docs(ids_scores):
+    return [
+        Document(text=f"text {i}", id=i, metadata={"score": s}) for i, s in ids_scores
+    ]
+
+
+def test_rrf_prefers_doc_in_both_lists():
+    a = _docs([("x", 9.0), ("y", 5.0), ("z", 1.0)])
+    b = _docs([("y", 0.8), ("w", 0.5)])
+    fused = fuse([a, b], method="rrf", rrf_k=60)
+    assert fused[0].id == "y"  # appears in both lists
+    assert fused[0].metadata["hybrid_score"] == pytest.approx(1 / 61 + 1 / 62)
+
+
+def test_rrf_ignores_weights_but_weighted_rrf_uses_them():
+    a = _docs([("a", 1.0)])
+    b = _docs([("b", 1.0)])
+    plain = fuse([a, b], method="rrf", weights=[0.1, 10.0])
+    assert plain[0].metadata["hybrid_score"] == pytest.approx(plain[1].metadata["hybrid_score"])
+    weighted = fuse([a, b], method="weighted_rrf", weights=[0.1, 10.0])
+    assert weighted[0].id == "b"
+
+
+def test_comb_sum_minmax_normalizes_scales():
+    # list A scores in [0, 100], list B in [0, 1]; normalization equalizes them
+    a = _docs([("a1", 100.0), ("a2", 50.0), ("a3", 0.0)])
+    b = _docs([("b1", 1.0), ("a2", 0.6), ("b3", 0.0)])
+    fused = fuse([a, b], method="comb_sum", weights=[1.0, 1.0])
+    by_id = {d.id: d.metadata["hybrid_score"] for d in fused}
+    assert by_id["a1"] == pytest.approx(1.0)
+    assert by_id["a2"] == pytest.approx(0.5 + 0.6)
+    assert fused[0].id == "a2"
+
+
+def test_dedup_merges_metadata():
+    a = [Document(text="t", id="d", metadata={"score": 1.0, "from_dense": True})]
+    b = [Document(text="t", id="d", metadata={"score": 5.0, "from_sparse": True})]
+    fused = fuse([a, b], method="rrf")
+    assert len(fused) == 1
+    assert fused[0].metadata["from_dense"] and fused[0].metadata["from_sparse"]
+
+
+def test_top_k_truncates():
+    a = _docs([(f"d{i}", 10.0 - i) for i in range(10)])
+    assert len(fuse([a], method="rrf", top_k=3)) == 3
+
+
+def test_unknown_method_raises():
+    with pytest.raises(ValueError):
+        fuse([], method="bogus")
+
+
+def test_constant_scores_normalize_to_one():
+    a = _docs([("a", 5.0), ("b", 5.0)])
+    fused = fuse([a], method="comb_sum")
+    assert all(d.metadata["hybrid_score"] == pytest.approx(1.0) for d in fused)

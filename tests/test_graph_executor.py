@@ -1,0 +1,154 @@
+import asyncio
+
+import pytest
+
+from sentio_tpu.graph.executor import END, GraphBuilder, GraphError
+from sentio_tpu.graph.state import (
+    add_retrieved_documents,
+    best_documents,
+    create_initial_state,
+    set_response,
+)
+from sentio_tpu.models.document import Document
+
+
+def _linear_graph():
+    return (
+        GraphBuilder()
+        .add_node("a", lambda s: {"response": "A"})
+        .add_node("b", lambda s: {"response": s["response"] + "B"})
+        .add_edge("a", "b")
+        .add_edge("b", END)
+        .set_entry("a")
+        .compile()
+    )
+
+
+def test_linear_invoke_merges_updates():
+    graph = _linear_graph()
+    out = graph.invoke(create_initial_state("q"))
+    assert out["response"] == "AB"
+    assert out["metadata"]["graph_path"] == ["a", "b"]
+    assert set(out["metadata"]["node_timings_ms"]) == {"a", "b"}
+
+
+def test_async_nodes():
+    async def anode(state):
+        await asyncio.sleep(0)
+        return {"response": "async!"}
+
+    graph = (
+        GraphBuilder().add_node("n", anode).add_edge("n", END).set_entry("n").compile()
+    )
+    out = graph.invoke(create_initial_state("q"))
+    assert out["response"] == "async!"
+
+
+def test_conditional_routing():
+    def router(state):
+        return "long" if len(state["query"]) > 5 else "short"
+
+    graph = (
+        GraphBuilder()
+        .add_node("start", lambda s: {})
+        .add_node("long", lambda s: {"response": "long path"})
+        .add_node("short", lambda s: {"response": "short path"})
+        .add_conditional_edge("start", router)
+        .add_edge("long", END)
+        .add_edge("short", END)
+        .set_entry("start")
+        .compile()
+    )
+    assert graph.invoke(create_initial_state("tiny"))["response"] == "short path"
+    assert graph.invoke(create_initial_state("a longer query"))["response"] == "long path"
+
+
+def test_soft_fail_records_error_and_continues():
+    def boom(state):
+        raise RuntimeError("kernel exploded")
+
+    graph = (
+        GraphBuilder()
+        .add_node("boom", boom)
+        .add_node("after", lambda s: {"response": "survived"})
+        .add_edge("boom", "after")
+        .add_edge("after", END)
+        .set_entry("boom")
+        .compile()
+    )
+    out = graph.invoke(create_initial_state("q"))
+    assert out["response"] == "survived"
+    assert "kernel exploded" in out["metadata"]["boom_error"]
+
+
+def test_hard_fail_propagates():
+    def boom(state):
+        raise RuntimeError("fatal")
+
+    graph = (
+        GraphBuilder()
+        .add_node("boom", boom, soft_fail=False)
+        .add_edge("boom", END)
+        .set_entry("boom")
+        .compile()
+    )
+    with pytest.raises(RuntimeError, match="fatal"):
+        graph.invoke(create_initial_state("q"))
+
+
+def test_cycle_hits_step_limit():
+    builder = (
+        GraphBuilder()
+        .add_node("a", lambda s: {})
+        .add_node("b", lambda s: {})
+        .add_edge("a", "b")
+        .add_edge("b", "a")
+        .set_entry("a")
+    )
+    builder.max_steps = 10
+    with pytest.raises(GraphError, match="step limit"):
+        builder.compile().invoke(create_initial_state("q"))
+
+
+def test_structural_validation():
+    with pytest.raises(GraphError):
+        GraphBuilder().compile()  # no entry
+    with pytest.raises(GraphError):
+        GraphBuilder().add_node("a", lambda s: {}).add_edge("a", "ghost").set_entry("a").compile()
+    with pytest.raises(GraphError):
+        GraphBuilder().add_node("a", lambda s: {}).add_node("a", lambda s: {})
+
+
+def test_metadata_merge_not_replace():
+    graph = (
+        GraphBuilder()
+        .add_node("a", lambda s: {"metadata": {"k1": 1}})
+        .add_node("b", lambda s: {"metadata": {"k2": 2}})
+        .add_edge("a", "b")
+        .add_edge("b", END)
+        .set_entry("a")
+        .compile()
+    )
+    out = graph.invoke(create_initial_state("q", metadata={"k0": 0}))
+    assert out["metadata"]["k0"] == 0
+    assert out["metadata"]["k1"] == 1
+    assert out["metadata"]["k2"] == 2
+
+
+def test_state_helpers():
+    state = create_initial_state("what is jax?", metadata={"user_top_k": 3})
+    assert state["query_id"]
+    docs = [Document(text="t", id="d1")]
+    state = add_retrieved_documents(state, docs)
+    assert state["metadata"]["num_retrieved"] == 1
+    assert best_documents(state)[0].id == "d1"
+    state = set_response(state, "answer", model="tiny")
+    assert state["response"] == "answer"
+    assert state["metadata"]["model"] == "tiny"
+
+
+def test_document_content_fallback():
+    doc = Document(text="", metadata={"content": "from metadata"})
+    assert doc.content == "from metadata"
+    assert Document(text="direct").content == "direct"
+    assert Document(text="x", metadata={"rerank_score": 0.5}).score() == 0.5
