@@ -1,0 +1,42 @@
+"""Cross-encoder relevance scorer (bge-reranker-base class).
+
+TPU-native replacement for the reference's remote rerank API
+(/root/reference/src/core/rerankers/jina_reranker.py:120-154): (query, doc)
+pairs are tokenized as ``[CLS] q [SEP] d [SEP]`` with token types, run
+through the shared bidirectional encoder, and the [CLS] state feeds a scalar
+relevance head. Batched pairs → one forward pass → scores; the MXU sees one
+big matmul stack instead of N HTTP calls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sentio_tpu.models import layers as L
+from sentio_tpu.models.transformer import EncoderConfig, cls_pool, encoder_forward, init_encoder
+
+Array = jax.Array
+
+
+def init_cross_encoder(rng: Array, cfg: EncoderConfig) -> dict:
+    enc_rng, head_rng = jax.random.split(rng)
+    return {
+        "encoder": init_encoder(enc_rng, cfg),
+        "head": L.dense_init(head_rng, cfg.dim, 1),
+    }
+
+
+def cross_encoder_scores(
+    params: dict,
+    cfg: EncoderConfig,
+    ids: Array,
+    mask: Array,
+    type_ids: Array,
+) -> Array:
+    """[B, T] pair encodings → [B] float32 relevance scores (unbounded;
+    consumers sigmoid or rank directly — ranking only needs order)."""
+    hidden = encoder_forward(params["encoder"], cfg, ids, mask, type_ids)
+    pooled = cls_pool(hidden)
+    scores = L.dense(params["head"], pooled, jnp.float32)
+    return scores[:, 0].astype(jnp.float32)

@@ -1,0 +1,218 @@
+"""Decoder-only LM (Llama-3 family): RMSNorm pre-norm, RoPE, GQA, SwiGLU.
+
+The generator AND verifier of the pipeline — one set of weights serves both
+(the reference made two HTTP calls to a hosted model per request:
+/root/reference/src/core/llm/providers/openai.py:117, answer_verifier.py:47;
+here both are forward passes on the same sharded params).
+
+Pure functions over an explicit param pytree (see models/layers.py). The KV
+cache is an explicit pytree threaded through calls, stacked over layers
+([L, B, S, Hkv, D]) so one PartitionSpec shards every layer's cache: batch on
+``dp``, kv-heads on ``tp``. Static shapes throughout: prefill pads to a
+bucket, decode attends over the full cache window under a position mask —
+one compiled program per (batch-bucket, cache-bucket).
+
+Tensor-parallel layout is Megatron-style via the path rules in
+parallel/sharding.py: wq/wk/wv/w_gate/w_up column-sharded, wo/w_down
+row-sharded → two psums per block, inserted by XLA from the shardings.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from sentio_tpu.models import layers as L
+
+Array = jax.Array
+Cache = dict  # {"k": [L,B,S,Hkv,D], "v": [L,B,S,Hkv,D]}
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    mlp_dim: int = 14_336
+    max_len: int = 8192
+    rope_theta: float = 500_000.0
+    dtype: str = "bfloat16"
+    norm_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def jdtype(self) -> jnp.dtype:
+        return jnp.dtype(self.dtype)
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "LlamaConfig":
+        """CPU-test scale; byte-level vocab (ByteTokenizer round-trips)."""
+        return cls(
+            vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            mlp_dim=128, max_len=512, rope_theta=10_000.0,
+        )
+
+
+def init_llama(rng: Array, cfg: LlamaConfig) -> dict:
+    keys = iter(jax.random.split(rng, 2 + cfg.n_layers * 7))
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+    params: dict = {
+        "embed_tokens": L.embed_init(next(keys), cfg.vocab_size, cfg.dim),
+        "lm_head": L.dense_init(next(keys), cfg.dim, cfg.vocab_size, with_bias=False),
+        "final_norm": L.rmsnorm_init(cfg.dim),
+    }
+    for i in range(cfg.n_layers):
+        params[f"layers_{i}"] = {
+            "attn_norm": L.rmsnorm_init(cfg.dim),
+            "attn": {
+                "wq": L.dense_init(next(keys), cfg.dim, cfg.dim, with_bias=False),
+                "wk": L.dense_init(next(keys), cfg.dim, kv_dim, with_bias=False),
+                "wv": L.dense_init(next(keys), cfg.dim, kv_dim, with_bias=False),
+                "wo": L.dense_init(next(keys), cfg.dim, cfg.dim, with_bias=False),
+            },
+            "mlp_norm": L.rmsnorm_init(cfg.dim),
+            "mlp": {
+                "w_gate": L.dense_init(next(keys), cfg.dim, cfg.mlp_dim, with_bias=False),
+                "w_up": L.dense_init(next(keys), cfg.dim, cfg.mlp_dim, with_bias=False),
+                "w_down": L.dense_init(next(keys), cfg.mlp_dim, cfg.dim, with_bias=False),
+            },
+        }
+    return params
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> Cache:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.jdtype), "v": jnp.zeros(shape, cfg.jdtype)}
+
+
+def _write_cache(cache_layer: Array, kv: Array, index: Array | int) -> Array:
+    """Write kv [B,T,H,D] into cache_layer [B,S,H,D] at seq offset ``index``
+    (scalar) or per-row offsets (vector [B])."""
+    idx = jnp.asarray(index)
+    if idx.ndim == 0:
+        return jax.lax.dynamic_update_slice(cache_layer, kv, (0, idx, 0, 0))
+    return jax.vmap(
+        lambda row_cache, row_kv, row_idx: jax.lax.dynamic_update_slice(
+            row_cache, row_kv, (row_idx, 0, 0)
+        )
+    )(cache_layer, kv, idx)
+
+
+def _attn(
+    lp: dict,
+    cfg: LlamaConfig,
+    x: Array,
+    positions: Array,
+    cos: Array,
+    sin: Array,
+    layer: int,
+    cache: Optional[Cache],
+    cache_index: Array,
+    pad_mask: Optional[Array],
+) -> tuple[Array, Optional[Cache]]:
+    dt = cfg.jdtype
+    b, t, d = x.shape
+    h, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    q = L.dense(lp["wq"], x, dt).reshape(b, t, h, hd)
+    k = L.dense(lp["wk"], x, dt).reshape(b, t, hkv, hd)
+    v = L.dense(lp["wv"], x, dt).reshape(b, t, hkv, hd)
+    q = L.apply_rope(q, positions, cos, sin)
+    k = L.apply_rope(k, positions, cos, sin)
+
+    if cache is not None:
+        # write this step's k/v into the cache window at cache_index, which is
+        # a scalar (aligned prefill) or [B] vector (ragged decode: coalesced
+        # sequences of different lengths each write at their own slot)
+        k_cache = _write_cache(cache["k"][layer], k.astype(dt), cache_index)
+        v_cache = _write_cache(cache["v"][layer], v.astype(dt), cache_index)
+        cache["k"] = cache["k"].at[layer].set(k_cache)
+        cache["v"] = cache["v"].at[layer].set(v_cache)
+        s = k_cache.shape[1]
+        # query i (absolute pos = positions[:, i]) attends keys j <= pos_i
+        kj = jnp.arange(s)[None, None, None, :]
+        mask = kj <= positions[:, None, :, None]  # [B,1,T,S]
+        k_full, v_full = k_cache, v_cache
+    else:
+        s = t
+        mask = L.causal_mask(t)
+        if pad_mask is not None:
+            mask = mask & pad_mask[:, None, None, :]
+        k_full, v_full = k, v
+
+    k_full = L.repeat_kv(k_full, h // hkv)
+    v_full = L.repeat_kv(v_full, h // hkv)
+    out = L.attention(q, k_full, v_full, mask, dt).reshape(b, t, d)
+    return L.dense(lp["wo"], out, dt), cache
+
+
+def _mlp(lp: dict, cfg: LlamaConfig, x: Array) -> Array:
+    dt = cfg.jdtype
+    gate = jax.nn.silu(L.dense(lp["w_gate"], x, dt))
+    up = L.dense(lp["w_up"], x, dt)
+    return L.dense(lp["w_down"], gate * up, dt)
+
+
+def llama_forward(
+    params: dict,
+    cfg: LlamaConfig,
+    ids: Array,
+    positions: Optional[Array] = None,
+    cache: Optional[Cache] = None,
+    cache_index: Array | int = 0,
+    pad_mask: Optional[Array] = None,
+) -> tuple[Array, Optional[Cache]]:
+    """ids [B, T] → logits [B, T, vocab] (float32) and the updated cache.
+
+    * Training / scoring: ``cache=None`` → causal attention over T.
+    * Prefill: pass a fresh cache, ``positions = arange(T)``, index 0.
+    * Decode: T == 1, ``positions = [[cur]]``, ``cache_index = cur``; with a
+      ragged batch, ``positions = lens[:, None]`` and ``cache_index = lens``
+      ([B] vector) so each row writes/reads at its own offset.
+    """
+    dt = cfg.jdtype
+    b, t = ids.shape
+    if cache is not None:
+        cache = dict(cache)  # never mutate the caller's pytree
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+    rope_len = cache["k"].shape[2] if cache is not None else max(t, cfg.max_len)
+    cos, sin = L.rope_frequencies(cfg.head_dim, rope_len, cfg.rope_theta)
+
+    x = L.embed(params["embed_tokens"], ids, dt)
+    for i in range(cfg.n_layers):
+        lp = params[f"layers_{i}"]
+        attn_out, cache = _attn(
+            lp["attn"], cfg, L.rmsnorm(lp["attn_norm"], x, cfg.norm_eps),
+            positions, cos, sin, i, cache, cache_index, pad_mask,
+        )
+        x = x + attn_out
+        x = x + _mlp(lp["mlp"], cfg, L.rmsnorm(lp["mlp_norm"], x, cfg.norm_eps))
+    x = L.rmsnorm(params["final_norm"], x, cfg.norm_eps)
+    logits = L.dense(params["lm_head"], x, dt)
+    return logits.astype(jnp.float32), cache
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def llama_loss(params: dict, cfg: LlamaConfig, ids: Array, mask: Array) -> Array:
+    """Mean next-token cross-entropy over unpadded positions — the training
+    objective for fine-tuning and for the multi-chip dry-run train step."""
+    logits, _ = llama_forward(params, cfg, ids[:, :-1], pad_mask=mask[:, :-1])
+    targets = ids[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[:, :, None], axis=-1)[..., 0]
+    weights = mask[:, 1:].astype(jnp.float32)
+    return (nll * weights).sum() / jnp.maximum(weights.sum(), 1.0)

@@ -1,0 +1,181 @@
+"""Tokenizers: a reversible byte-level tokenizer, a word-hash tokenizer, and
+an optional HuggingFace wrapper for real checkpoints.
+
+The reference never tokenizes — its models are remote APIs and its token
+budgeting approximates 4 chars/token (/root/reference/src/core/graph/
+nodes.py:296-338). In-process models need the real thing:
+
+* :class:`ByteTokenizer` — vocab = 256 bytes + specials, fully reversible.
+  The test/dev tokenizer: tiny models trained/ran over bytes round-trip text
+  exactly, so the whole generate→verify pipeline is drivable offline.
+* :class:`WordHashTokenizer` — deterministic word→id hashing; the encoder
+  fake-backend tokenizer (stable ids, no vocab file), mirroring the
+  reference's hash-seeded mock embeddings pattern (jina.py:141-159 there).
+* :class:`HFTokenizer` — wraps a local ``transformers`` tokenizer for real
+  checkpoints (Llama-3, bge, XLM-R). Local files only; never downloads.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Optional, Protocol, Sequence
+
+import numpy as np
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    pad_id: int
+    bos_id: int
+    eos_id: int
+    cls_id: int
+    sep_id: int
+
+    def encode(self, text: str, add_bos: bool = False, add_eos: bool = False) -> list[int]: ...
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+
+def batch_encode(
+    tokenizer: "Tokenizer",
+    texts: Sequence[str],
+    max_len: int,
+    add_bos: bool = False,
+    add_eos: bool = False,
+    pad_to: Optional[int] = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Encode + truncate + right-pad a batch. Returns (ids, mask) int32/bool
+    arrays shaped [B, L] with L = pad_to or the longest row (<= max_len)."""
+    rows = [tokenizer.encode(t, add_bos=add_bos, add_eos=add_eos)[:max_len] for t in texts]
+    rows = [r if r else [tokenizer.pad_id] for r in rows]
+    width = pad_to if pad_to is not None else max(len(r) for r in rows)
+    width = max(min(width, max_len), 1)
+    ids = np.full((len(rows), width), tokenizer.pad_id, dtype=np.int32)
+    mask = np.zeros((len(rows), width), dtype=bool)
+    for i, r in enumerate(rows):
+        r = r[:width]
+        ids[i, : len(r)] = r
+        mask[i, : len(r)] = True
+    return ids, mask
+
+
+def batch_encode_pairs(
+    tokenizer: "Tokenizer",
+    pairs: Sequence[tuple[str, str]],
+    max_len: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Cross-encoder input: [CLS] a [SEP] b [SEP] with type ids 0/1.
+    The first segment keeps at most half the budget; the doc gets the rest."""
+    ids = np.full((len(pairs), max_len), tokenizer.pad_id, dtype=np.int32)
+    mask = np.zeros((len(pairs), max_len), dtype=bool)
+    types = np.zeros((len(pairs), max_len), dtype=np.int32)
+    for i, (a, b) in enumerate(pairs):
+        a_ids = tokenizer.encode(a)[: max_len // 2 - 2]
+        b_budget = max_len - len(a_ids) - 3
+        b_ids = tokenizer.encode(b)[: max(b_budget, 0)]
+        row = [tokenizer.cls_id] + a_ids + [tokenizer.sep_id] + b_ids + [tokenizer.sep_id]
+        row = row[:max_len]
+        ids[i, : len(row)] = row
+        mask[i, : len(row)] = True
+        boundary = min(len(a_ids) + 2, max_len)
+        types[i, boundary : len(row)] = 1
+    return ids, mask, types
+
+
+@dataclass
+class _SpecialIds:
+    pad_id: int
+    bos_id: int
+    eos_id: int
+    cls_id: int
+    sep_id: int
+
+
+class ByteTokenizer:
+    """UTF-8 bytes + 5 specials. ``decode(encode(s)) == s`` for any string."""
+
+    def __init__(self, vocab_size: int = 512) -> None:
+        if vocab_size < 261:
+            raise ValueError("ByteTokenizer needs vocab_size >= 261")
+        self.vocab_size = vocab_size
+        self.pad_id, self.bos_id, self.eos_id, self.cls_id, self.sep_id = range(256, 261)
+
+    def encode(self, text: str, add_bos: bool = False, add_eos: bool = False) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        if add_bos:
+            ids = [self.bos_id] + ids
+        if add_eos:
+            ids = ids + [self.eos_id]
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", errors="replace")
+
+
+class WordHashTokenizer:
+    """Stable word→id hash (md5, like the reference's deterministic mock
+    embeddings). Irreversible; decode returns placeholder tokens."""
+
+    def __init__(self, vocab_size: int = 512) -> None:
+        if vocab_size < 16:
+            raise ValueError("vocab too small")
+        self.vocab_size = vocab_size
+        self.pad_id, self.bos_id, self.eos_id, self.cls_id, self.sep_id = range(5)
+        self._n_special = 8
+
+    def _hash(self, word: str) -> int:
+        h = int.from_bytes(hashlib.md5(word.encode()).digest()[:4], "little")
+        return self._n_special + h % (self.vocab_size - self._n_special)
+
+    def encode(self, text: str, add_bos: bool = False, add_eos: bool = False) -> list[int]:
+        ids = [self._hash(w) for w in text.lower().split()]
+        if add_bos:
+            ids = [self.bos_id] + ids
+        if add_eos:
+            ids = ids + [self.eos_id]
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return " ".join(f"<{i}>" for i in ids if i >= self._n_special)
+
+
+class HFTokenizer:
+    """Adapter over a local HuggingFace tokenizer directory. Import of
+    ``transformers`` is deferred and the path must exist locally — this
+    framework performs no network access for model assets."""
+
+    def __init__(self, path: str) -> None:
+        from transformers import AutoTokenizer  # deferred heavy import
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.vocab_size = int(self._tok.vocab_size)
+        ids = _SpecialIds(
+            pad_id=self._tok.pad_token_id if self._tok.pad_token_id is not None else 0,
+            bos_id=self._tok.bos_token_id if self._tok.bos_token_id is not None else 0,
+            eos_id=self._tok.eos_token_id if self._tok.eos_token_id is not None else 0,
+            cls_id=self._tok.cls_token_id if self._tok.cls_token_id is not None else 0,
+            sep_id=self._tok.sep_token_id if self._tok.sep_token_id is not None else 0,
+        )
+        self.pad_id, self.bos_id, self.eos_id = ids.pad_id, ids.bos_id, ids.eos_id
+        self.cls_id, self.sep_id = ids.cls_id, ids.sep_id
+
+    def encode(self, text: str, add_bos: bool = False, add_eos: bool = False) -> list[int]:
+        ids = self._tok.encode(text, add_special_tokens=False)
+        if add_bos:
+            ids = [self.bos_id] + ids
+        if add_eos:
+            ids = ids + [self.eos_id]
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode([i for i in ids], skip_special_tokens=True)
+
+
+def get_tokenizer(kind: str, vocab_size: int = 512, path: str = "") -> Tokenizer:
+    if kind == "byte":
+        return ByteTokenizer(vocab_size)
+    if kind == "hash":
+        return WordHashTokenizer(vocab_size)
+    if kind == "hf":
+        return HFTokenizer(path)
+    raise ValueError(f"unknown tokenizer kind {kind!r}")

@@ -1,0 +1,136 @@
+"""Bidirectional transformer encoder (BERT/XLM-R family).
+
+Backbone for both the bi-encoder embedder (replacing the reference's remote
+Jina embeddings API, /root/reference/src/core/embeddings/providers/jina.py:33)
+and the cross-encoder reranker (replacing api.jina.ai/v1/rerank,
+jina_reranker.py:120-154). Post-LN residual blocks with learned positions and
+token-type embeddings so weights of the public BERT/XLM-R/bge checkpoint
+family convert directly (see models/convert.py).
+
+Pure functions over an explicit param pytree; see models/layers.py for the
+rationale. All shapes static; mask handles padding, so one compiled program
+per (batch-bucket, seq-bucket).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from sentio_tpu.models import layers as L
+
+Array = jax.Array
+
+
+@dataclass(frozen=True)
+class EncoderConfig:
+    vocab_size: int = 32_000
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    n_types: int = 2
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def jdtype(self) -> jnp.dtype:
+        return jnp.dtype(self.dtype)
+
+    @classmethod
+    def tiny(cls) -> "EncoderConfig":
+        """CPU-test scale (the deterministic 'fake backend' of SURVEY.md §4,
+        but a real model with random weights rather than a mock)."""
+        return cls(vocab_size=512, dim=64, n_layers=2, n_heads=2, mlp_dim=128, max_len=128)
+
+    @classmethod
+    def base(cls) -> "EncoderConfig":
+        return cls(vocab_size=250_002, dim=1024, n_layers=24, n_heads=16, mlp_dim=4096, max_len=8192)
+
+
+def init_encoder(rng: Array, cfg: EncoderConfig) -> dict:
+    keys = iter(jax.random.split(rng, 4 + cfg.n_layers * 6))
+    params: dict = {
+        "embed_tokens": L.embed_init(next(keys), cfg.vocab_size, cfg.dim),
+        "embed_positions": L.embed_init(next(keys), cfg.max_len, cfg.dim),
+        "embed_types": L.embed_init(next(keys), cfg.n_types, cfg.dim),
+        "embed_norm": L.layernorm_init(cfg.dim),
+    }
+    for i in range(cfg.n_layers):
+        params[f"layers_{i}"] = {
+            "attn": {
+                "wq": L.dense_init(next(keys), cfg.dim, cfg.dim),
+                "wk": L.dense_init(next(keys), cfg.dim, cfg.dim),
+                "wv": L.dense_init(next(keys), cfg.dim, cfg.dim),
+                "wo": L.dense_init(next(keys), cfg.dim, cfg.dim),
+            },
+            "attn_norm": L.layernorm_init(cfg.dim),
+            "mlp": {
+                "w_in": L.dense_init(next(keys), cfg.dim, cfg.mlp_dim),
+                "w_out": L.dense_init(next(keys), cfg.mlp_dim, cfg.dim),
+            },
+            "mlp_norm": L.layernorm_init(cfg.dim),
+        }
+    return params
+
+
+def encoder_forward(
+    params: dict,
+    cfg: EncoderConfig,
+    ids: Array,
+    mask: Array,
+    type_ids: Optional[Array] = None,
+) -> Array:
+    """ids/mask: [B, T] (mask True = real token). Returns hidden [B, T, D]."""
+    dt = cfg.jdtype
+    b, t = ids.shape
+    positions = jnp.arange(t)[None, :]
+    x = (
+        L.embed(params["embed_tokens"], ids, dt)
+        + L.embed(params["embed_positions"], positions, dt)
+    )
+    if type_ids is not None:
+        x = x + L.embed(params["embed_types"], type_ids, dt)
+    x = L.layernorm(params["embed_norm"], x)
+
+    attn_mask = (mask[:, None, None, :]).astype(bool)  # [B,1,1,T] keys masked
+    for i in range(cfg.n_layers):
+        lp = params[f"layers_{i}"]
+        x = _block(lp, cfg, x, attn_mask)
+    return x
+
+
+def _block(lp: dict, cfg: EncoderConfig, x: Array, attn_mask: Array) -> Array:
+    dt = cfg.jdtype
+    b, t, d = x.shape
+    h, hd = cfg.n_heads, cfg.head_dim
+
+    q = L.dense(lp["attn"]["wq"], x, dt).reshape(b, t, h, hd)
+    k = L.dense(lp["attn"]["wk"], x, dt).reshape(b, t, h, hd)
+    v = L.dense(lp["attn"]["wv"], x, dt).reshape(b, t, h, hd)
+    attn_out = L.attention(q, k, v, attn_mask, dt).reshape(b, t, d)
+    x = L.layernorm(lp["attn_norm"], x + L.dense(lp["attn"]["wo"], attn_out, dt))
+
+    mlp = L.dense(lp["mlp"]["w_out"], jax.nn.gelu(L.dense(lp["mlp"]["w_in"], x, dt)), dt)
+    return L.layernorm(lp["mlp_norm"], x + mlp)
+
+
+def mean_pool(hidden: Array, mask: Array) -> Array:
+    """Masked mean over tokens → L2-normalized embedding [B, D], float32."""
+    m = mask.astype(jnp.float32)[:, :, None]
+    summed = (hidden.astype(jnp.float32) * m).sum(axis=1)
+    counts = jnp.maximum(m.sum(axis=1), 1.0)
+    pooled = summed / counts
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
+
+
+def cls_pool(hidden: Array) -> Array:
+    """First-token representation [B, D] (cross-encoder head input)."""
+    return hidden[:, 0, :].astype(jnp.float32)

@@ -1,0 +1,257 @@
+"""Embedding service: in-process bi-encoder on the device mesh.
+
+Replaces the reference's remote Jina embeddings API
+(/root/reference/src/core/embeddings/providers/jina.py:33) and reproduces its
+service contract from the embedder base class (embeddings/base.py:23-423):
+LFU+TTL embedding cache, request/hit/error stats, sync + async entry points,
+``warm_up`` probe, lazy ``dimension``. Two providers, selected by config:
+
+* ``tpu`` — the Flax-free JAX bi-encoder (models/transformer.py), tokenized
+  host-side, batched and bucketed, jitted once per bucket shape.
+* ``hash`` — deterministic seeded pseudo-vectors, the reference's offline
+  mock mode (jina.py:141-159) kept as the no-hardware test backend.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from sentio_tpu.config import EmbedderConfig, get_settings
+
+
+class EmbeddingError(Exception):
+    pass
+
+
+class EmbeddingCache:
+    """LFU with TTL, thread-safe (reference: embeddings/base.py:23-106)."""
+
+    def __init__(self, max_size: int = 10_000, ttl_s: float = 3600.0) -> None:
+        self.max_size = max_size
+        self.ttl_s = ttl_s
+        self._store: dict[str, tuple[np.ndarray, float, int]] = {}  # key -> (vec, t, hits)
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def key(text: str) -> str:
+        return hashlib.sha256(text.encode()).hexdigest()
+
+    def get(self, text: str) -> Optional[np.ndarray]:
+        k = self.key(text)
+        with self._lock:
+            entry = self._store.get(k)
+            if entry is None:
+                self.misses += 1
+                return None
+            vec, t, hits = entry
+            if self.ttl_s > 0 and time.time() - t > self.ttl_s:
+                del self._store[k]
+                self.misses += 1
+                return None
+            self._store[k] = (vec, t, hits + 1)
+            self.hits += 1
+            return vec
+
+    def put(self, text: str, vec: np.ndarray) -> None:
+        k = self.key(text)
+        with self._lock:
+            if len(self._store) >= self.max_size and k not in self._store:
+                # evict least-frequently-used
+                victim = min(self._store.items(), key=lambda kv: kv[1][2])[0]
+                del self._store[victim]
+            self._store[k] = (vec, time.time(), 0)
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "size": len(self._store),
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": round(self.hits / total, 4) if total else 0.0,
+            }
+
+
+class BaseEmbedder:
+    """Common service wrapper: cache, stats, sync/async, warm-up."""
+
+    def __init__(self, config: Optional[EmbedderConfig] = None) -> None:
+        self.config = config or get_settings().embedder
+        self.cache = EmbeddingCache(self.config.cache_size, self.config.cache_ttl_s)
+        self.stats = {"requests": 0, "texts": 0, "errors": 0, "time_s": 0.0}
+
+    @property
+    def dimension(self) -> int:
+        return self.config.dim
+
+    # -- provider hook -------------------------------------------------------
+
+    def _embed_batch(self, texts: list[str]) -> np.ndarray:  # [B, dim] float32
+        raise NotImplementedError
+
+    # -- public API ----------------------------------------------------------
+
+    def embed_many(self, texts: Sequence[str]) -> np.ndarray:
+        t0 = time.perf_counter()
+        self.stats["requests"] += 1
+        self.stats["texts"] += len(texts)
+        out = np.zeros((len(texts), self.dimension), np.float32)
+        missing: list[tuple[int, str]] = []
+        for i, text in enumerate(texts):
+            cached = self.cache.get(text)
+            if cached is not None:
+                out[i] = cached
+            else:
+                missing.append((i, text))
+        try:
+            for start in range(0, len(missing), self.config.batch_size):
+                chunk = missing[start : start + self.config.batch_size]
+                vecs = self._embed_batch([t for _, t in chunk])
+                for (i, text), vec in zip(chunk, vecs):
+                    out[i] = vec
+                    self.cache.put(text, vec)
+        except Exception:
+            self.stats["errors"] += 1
+            raise
+        finally:
+            self.stats["time_s"] += time.perf_counter() - t0
+        return out
+
+    def embed(self, text: str) -> np.ndarray:
+        return self.embed_many([text])[0]
+
+    async def embed_many_async(self, texts: Sequence[str]) -> np.ndarray:
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self.embed_many, list(texts)
+        )
+
+    async def embed_async(self, text: str) -> np.ndarray:
+        return (await self.embed_many_async([text]))[0]
+
+    def warm_up(self) -> bool:
+        """Probe with a trivial input (reference: base.py:387-416); also
+        triggers jit compilation so the first real request doesn't pay it."""
+        try:
+            vec = self.embed("warm up probe")
+            return vec.shape == (self.dimension,)
+        except Exception:
+            return False
+
+    def get_stats(self) -> dict:
+        return {**self.stats, "cache": self.cache.stats()}
+
+
+class HashEmbedder(BaseEmbedder):
+    """Deterministic hash-seeded unit vectors — same trick as the reference's
+    empty-API-key mock mode. Texts sharing content always embed identically,
+    so retrieval tests are reproducible with zero hardware."""
+
+    def _embed_batch(self, texts: list[str]) -> np.ndarray:
+        out = np.zeros((len(texts), self.dimension), np.float32)
+        for i, text in enumerate(texts):
+            seed = int.from_bytes(hashlib.sha256(text.lower().encode()).digest()[:8], "little")
+            rng = np.random.default_rng(seed)
+            vec = rng.standard_normal(self.dimension).astype(np.float32)
+            # mix in token-level signal so related texts correlate
+            for tok in set(text.lower().split()):
+                tseed = int.from_bytes(hashlib.md5(tok.encode()).digest()[:8], "little")
+                trng = np.random.default_rng(tseed)
+                vec += 4.0 * trng.standard_normal(self.dimension).astype(np.float32)
+            out[i] = vec / max(np.linalg.norm(vec), 1e-9)
+        return out
+
+
+class TpuEmbedder(BaseEmbedder):
+    """The real path: tokenize host-side, run the bi-encoder on device.
+
+    Sequences bucket to powers of two (one compiled program per bucket);
+    params live on the mesh (replicated by default — the encoder is small
+    relative to HBM; flip to ENCODER_TP_RULES for TP).
+    """
+
+    BUCKETS = (16, 32, 64, 128, 256, 512)
+    BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+    def __init__(
+        self,
+        config: Optional[EmbedderConfig] = None,
+        params=None,
+        model_config=None,
+        tokenizer=None,
+        mesh=None,
+    ) -> None:
+        super().__init__(config)
+        import jax
+
+        from sentio_tpu.models.tokenizer import ByteTokenizer
+        from sentio_tpu.models.transformer import (
+            EncoderConfig,
+            encoder_forward,
+            init_encoder,
+            mean_pool,
+        )
+
+        self.model_config = model_config or (
+            EncoderConfig.tiny() if self.config.model_preset == "tiny" else EncoderConfig.base()
+        )
+        self.tokenizer = tokenizer or ByteTokenizer(self.model_config.vocab_size)
+        if params is None:
+            params = init_encoder(jax.random.PRNGKey(0), self.model_config)
+        self.params = params
+        self.mesh = mesh
+        if mesh is not None:
+            from sentio_tpu.parallel.sharding import ENCODER_TP_RULES, shard_params
+
+            self.params = shard_params(params, mesh, ENCODER_TP_RULES)
+
+        cfg = self.model_config
+
+        def fwd(p, ids, mask):
+            return mean_pool(encoder_forward(p, cfg, ids, mask), mask)
+
+        self._fwd = jax.jit(fwd)
+
+    @property
+    def dimension(self) -> int:
+        return self.model_config.dim
+
+    def _embed_batch(self, texts: list[str]) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from sentio_tpu.models.tokenizer import batch_encode
+        from sentio_tpu.parallel.batcher import bucket_size
+
+        ids, mask = batch_encode(
+            self.tokenizer, texts, max_len=min(self.config.max_tokens, self.model_config.max_len)
+        )
+        # pad seq AND batch to buckets so jit compiles once per bucket pair,
+        # not once per (n_texts, longest_text) combination
+        n = ids.shape[0]
+        width = bucket_size(ids.shape[1], self.BUCKETS)
+        rows = bucket_size(n, self.BATCH_BUCKETS)
+        ids = np.pad(
+            ids, ((0, rows - n), (0, width - ids.shape[1])),
+            constant_values=self.tokenizer.pad_id,
+        )
+        mask = np.pad(mask, ((0, rows - n), (0, width - mask.shape[1])))
+        out = self._fwd(self.params, jnp.asarray(ids), jnp.asarray(mask))
+        return np.asarray(out, np.float32)[:n]
+
+
+_PROVIDERS = {"hash": HashEmbedder, "tpu": TpuEmbedder}
+
+
+def get_embedder(config: Optional[EmbedderConfig] = None, **kwargs) -> BaseEmbedder:
+    """Provider registry (reference: embeddings/factory.py:55-120). Unknown
+    providers fall back to ``hash`` like the reference falls back to jina."""
+    config = config or get_settings().embedder
+    cls = _PROVIDERS.get(config.provider, HashEmbedder)
+    return cls(config, **kwargs) if cls is TpuEmbedder else cls(config)

@@ -1,0 +1,154 @@
+"""Deadline-based request coalescing for TPU dispatch.
+
+The reference's concurrency story is a connection pool to external services
+(/root/reference/src/core/vector_store/async_qdrant_store.py:50-266). On TPU
+the equivalent primitive is a *batcher*: concurrent requests (embed / rerank /
+generate) are coalesced into one padded device batch so the MXU sees large
+matmuls, with a deadline bound (default ~8 ms) so p50 latency doesn't pay for
+occupancy. One compiled program per bucketed batch size; the batcher rounds
+up to the bucket and the model side masks padding.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Generic, Optional, Sequence, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+ProcessFn = Callable[[list[T]], Awaitable[Sequence[R]]]
+
+
+class BatcherClosed(Exception):
+    pass
+
+
+@dataclass
+class BatcherStats:
+    batches: int = 0
+    items: int = 0
+    errors: int = 0
+    occupancy_sum: float = 0.0
+    wait_ms_sum: float = 0.0
+
+    def snapshot(self) -> dict[str, float]:
+        return {
+            "batches": self.batches,
+            "items": self.items,
+            "errors": self.errors,
+            "avg_occupancy": round(self.occupancy_sum / self.batches, 3) if self.batches else 0.0,
+            "avg_wait_ms": round(self.wait_ms_sum / self.items, 3) if self.items else 0.0,
+        }
+
+
+@dataclass
+class _Pending(Generic[T, R]):
+    item: T
+    future: "asyncio.Future[R]"
+    enqueued_at: float = field(default_factory=time.perf_counter)
+
+
+class Batcher(Generic[T, R]):
+    """Coalesces awaited ``submit`` calls into batched ``process_fn`` calls.
+
+    ``process_fn`` receives a list of items (1 <= n <= max_size) and must
+    return one result per item, in order. A failing batch fails only the
+    futures in that batch — the batcher itself stays up (circuit breaking
+    happens a layer above, like the reference's resilience ladder).
+    """
+
+    def __init__(
+        self,
+        process_fn: ProcessFn,
+        max_size: int = 8,
+        deadline_ms: float = 8.0,
+        name: str = "batcher",
+    ) -> None:
+        if max_size < 1:
+            raise ValueError("max_size must be >= 1")
+        self.process_fn = process_fn
+        self.max_size = max_size
+        self.deadline_s = max(deadline_ms, 0.0) / 1000.0
+        self.name = name
+        self.stats = BatcherStats()
+        self._queue: asyncio.Queue[Optional[_Pending[T, R]]] = asyncio.Queue()
+        self._worker: Optional[asyncio.Task] = None
+        self._closed = False
+
+    # ---------------------------------------------------------------- public
+
+    async def submit(self, item: T) -> R:
+        if self._closed:
+            raise BatcherClosed(f"{self.name} is closed")
+        self._ensure_worker()
+        pending: _Pending[T, R] = _Pending(item, asyncio.get_running_loop().create_future())
+        await self._queue.put(pending)
+        return await pending.future
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._worker is not None:
+            await self._queue.put(None)
+            await self._worker
+            self._worker = None
+
+    # --------------------------------------------------------------- worker
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None or self._worker.done():
+            self._worker = asyncio.get_running_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        while True:
+            head = await self._queue.get()
+            if head is None:
+                return
+            batch = [head]
+            deadline = time.perf_counter() + self.deadline_s
+            while len(batch) < self.max_size:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = await asyncio.wait_for(self._queue.get(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    break
+                if nxt is None:
+                    await self._dispatch(batch)
+                    return
+                batch.append(nxt)
+            await self._dispatch(batch)
+
+    async def _dispatch(self, batch: list[_Pending[T, R]]) -> None:
+        now = time.perf_counter()
+        self.stats.batches += 1
+        self.stats.items += len(batch)
+        self.stats.occupancy_sum += len(batch) / self.max_size
+        self.stats.wait_ms_sum += sum((now - p.enqueued_at) * 1000.0 for p in batch)
+        try:
+            results = await self.process_fn([p.item for p in batch])
+            if len(results) != len(batch):
+                raise RuntimeError(
+                    f"{self.name}: process_fn returned {len(results)} results "
+                    f"for {len(batch)} items"
+                )
+            for pending, result in zip(batch, results):
+                if not pending.future.done():
+                    pending.future.set_result(result)
+        except Exception as exc:  # noqa: BLE001 — fail the batch, not the batcher
+            self.stats.errors += 1
+            for pending in batch:
+                if not pending.future.done():
+                    pending.future.set_exception(exc)
+
+
+def bucket_size(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n (compile once per bucket, pad to it). Falls back
+    to the largest bucket if n exceeds them all."""
+    for b in sorted(buckets):
+        if n <= b:
+            return b
+    return max(buckets)

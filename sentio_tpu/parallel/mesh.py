@@ -1,0 +1,148 @@
+"""Device mesh construction — the framework's "communication backend".
+
+The reference scales with Kubernetes HPA over stateless pods and has no
+NCCL/MPI layer (SURVEY.md §2.12). Here distribution is first-class: a
+:class:`jax.sharding.Mesh` with named axes
+
+* ``dp`` — data parallel (batch of coalesced requests) over ICI,
+* ``tp`` — tensor parallel (model weight sharding) over ICI,
+* ``sp`` — sequence/context parallel (ring attention) over ICI,
+
+and an optional leading ``dcn`` data axis for multi-slice pods. All
+collectives are XLA's (psum / all_gather / ppermute) — mesh geometry and
+sharding specs are the entire comm layer; there is no socket code to write.
+
+Axis order matters on TPU: the innermost mesh dims map to the
+torus-contiguous device order produced by ``mesh_utils.create_device_mesh``,
+so tp (all-reduce heavy) is placed innermost to ride the fastest ICI links.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from sentio_tpu.config import MeshConfig
+
+logger = logging.getLogger(__name__)
+
+AXIS_DCN = "dcn"
+AXIS_DP = "dp"
+AXIS_SP = "sp"
+AXIS_TP = "tp"
+
+# canonical axis order, outermost → innermost
+MESH_AXES = (AXIS_DCN, AXIS_DP, AXIS_SP, AXIS_TP)
+
+
+class MeshError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Resolved mesh geometry."""
+
+    dcn: int
+    dp: int
+    sp: int
+    tp: int
+
+    @property
+    def shape(self) -> tuple[int, int, int, int]:
+        return (self.dcn, self.dp, self.sp, self.tp)
+
+    @property
+    def n_devices(self) -> int:
+        return self.dcn * self.dp * self.sp * self.tp
+
+
+def resolve_spec(config: MeshConfig, n_devices: int) -> MeshSpec:
+    """Turn a (possibly partial) MeshConfig into concrete axis sizes.
+
+    ``dp_size == 0`` means "absorb all remaining devices on the data axis" —
+    the right default for a serving mesh where throughput scales with dp.
+    """
+    tp = max(1, config.tp_size)
+    sp = max(1, config.sp_size)
+    dcn = max(1, config.dcn_size)
+    fixed = tp * sp * dcn
+    if n_devices % fixed != 0:
+        raise MeshError(
+            f"{n_devices} devices not divisible by tp*sp*dcn={fixed} "
+            f"(tp={tp}, sp={sp}, dcn={dcn})"
+        )
+    dp = config.dp_size if config.dp_size > 0 else n_devices // fixed
+    spec = MeshSpec(dcn=dcn, dp=dp, sp=sp, tp=tp)
+    if spec.n_devices != n_devices:
+        raise MeshError(
+            f"mesh {spec.shape} needs {spec.n_devices} devices, have {n_devices}"
+        )
+    return spec
+
+
+def build_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the global device mesh.
+
+    Single-slice: ``mesh_utils.create_device_mesh`` lays devices out so that
+    neighboring mesh coordinates are ICI neighbors. Multi-slice (dcn > 1):
+    ``create_hybrid_device_mesh`` keeps the dcn axis across slices and every
+    ICI axis within a slice, so tp/sp collectives never cross DCN.
+    """
+    config = config or MeshConfig()
+    if devices is None:
+        devices = jax.devices(config.backend) if config.backend else jax.devices()
+    spec = resolve_spec(config, len(devices))
+
+    if spec.dcn > 1:
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1, spec.dp, spec.sp, spec.tp),
+            dcn_mesh_shape=(spec.dcn, 1, 1, 1),
+            devices=devices,
+        )
+    else:
+        try:
+            dev_array = mesh_utils.create_device_mesh(spec.shape, devices=list(devices))
+        except (ValueError, AssertionError):
+            # host-platform or odd topologies: plain reshape is always valid
+            dev_array = np.asarray(list(devices)).reshape(spec.shape)
+    mesh = Mesh(dev_array, MESH_AXES)
+    logger.info("mesh built: %s over %d %s devices", dict(zip(MESH_AXES, spec.shape)),
+                spec.n_devices, dev_array.flat[0].platform)
+    return mesh
+
+
+def data_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Axes a request batch is sharded over (all data-like axes)."""
+    return tuple(a for a in (AXIS_DCN, AXIS_DP) if mesh.shape[a] > 1) or (AXIS_DP,)
+
+
+def batch_multiple(mesh: Mesh) -> int:
+    """Batches submitted to pjit'd fns must be a multiple of this."""
+    return mesh.shape[AXIS_DCN] * mesh.shape[AXIS_DP]
+
+
+_default_mesh: Optional[Mesh] = None
+
+
+def get_mesh(config: Optional[MeshConfig] = None) -> Mesh:
+    """Process-wide mesh singleton (built once at startup, like the
+    reference's DI container owns its clients)."""
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = build_mesh(config)
+    return _default_mesh
+
+
+def set_mesh(mesh: Optional[Mesh]) -> None:
+    global _default_mesh
+    _default_mesh = mesh

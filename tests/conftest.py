@@ -9,10 +9,20 @@ level.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+# the axon TPU plugin self-registers from sitecustomize when this is set,
+# overriding JAX_PLATFORMS — tests must run on the virtual CPU mesh
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# sitecustomize imports jax at interpreter startup (before this file runs),
+# locking JAX_PLATFORMS=axon from the ambient env — config.update still wins
+# as long as no backend has initialized yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

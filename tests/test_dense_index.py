@@ -1,0 +1,132 @@
+import numpy as np
+import pytest
+
+from sentio_tpu.config import MeshConfig
+from sentio_tpu.models.document import Document
+from sentio_tpu.ops.dense_index import DenseIndexError, TpuDenseIndex
+from sentio_tpu.parallel.mesh import build_mesh
+
+
+def _corpus(n=20, dim=16, seed=0):
+    rng = np.random.default_rng(seed)
+    embs = rng.standard_normal((n, dim)).astype(np.float32)
+    docs = [Document(text=f"doc {i}", id=f"d{i}") for i in range(n)]
+    return docs, embs
+
+
+class TestSingleDevice:
+    def test_exact_topk_matches_numpy(self):
+        docs, embs = _corpus(50, 16)
+        index = TpuDenseIndex(dim=16, dtype="float32")
+        index.add(docs, embs)
+        q = np.random.default_rng(1).standard_normal(16).astype(np.float32)
+        hits = index.search(q, top_k=5)
+        # numpy reference: cosine similarity
+        en = embs / np.linalg.norm(embs, axis=1, keepdims=True)
+        qn = q / np.linalg.norm(q)
+        expected = np.argsort(-(en @ qn))[:5]
+        assert [h[0].id for h in hits] == [f"d{i}" for i in expected]
+        np.testing.assert_allclose(
+            [h[1] for h in hits], np.sort(en @ qn)[::-1][:5], atol=1e-5
+        )
+
+    def test_batch_search(self):
+        docs, embs = _corpus(30, 8)
+        index = TpuDenseIndex(dim=8, dtype="float32")
+        index.add(docs, embs)
+        qs = np.random.default_rng(2).standard_normal((4, 8)).astype(np.float32)
+        results = index.search_batch(qs, top_k=3)
+        assert len(results) == 4
+        singles = [index.search(q, top_k=3) for q in qs]
+        for batch_row, single in zip(results, singles):
+            assert [d.id for d, _ in batch_row] == [d.id for d, _ in single]
+
+    def test_delete_and_upsert(self):
+        docs, embs = _corpus(10, 8)
+        index = TpuDenseIndex(dim=8, dtype="float32")
+        index.add(docs, embs)
+        assert index.size == 10
+        assert index.delete(["d3", "nope"]) == 1
+        assert index.size == 9
+        q = embs[3]
+        assert all(d.id != "d3" for d, _ in index.search(q, top_k=9))
+        # upsert d5 with d3's old embedding: must now match where d3 did
+        index.add([Document(text="new d5", id="d5")], embs[3:4])
+        assert index.size == 9
+        top = index.search(embs[3], top_k=1)[0]
+        assert top[0].id == "d5" and top[0].text == "new d5"
+
+    def test_retrieve_sets_metadata(self):
+        docs, embs = _corpus(5, 8)
+        index = TpuDenseIndex(dim=8, dtype="float32")
+        index.add(docs, embs)
+        out = index.retrieve(embs[0], top_k=2)
+        assert out[0].metadata["retriever"] == "dense"
+        assert "score" in out[0].metadata
+
+    def test_empty_and_validation(self):
+        index = TpuDenseIndex(dim=8)
+        assert index.search(np.zeros(8, np.float32)) == []
+        with pytest.raises(DenseIndexError):
+            index.add([Document(text="x")], np.zeros((1, 4), np.float32))
+        with pytest.raises(DenseIndexError):
+            index.add([Document(text="x"), Document(text="y")], np.zeros((1, 8)))
+
+    def test_save_load_roundtrip(self, tmp_path):
+        docs, embs = _corpus(12, 8)
+        index = TpuDenseIndex(dim=8, dtype="float32")
+        index.add(docs, embs)
+        index.delete(["d0"])
+        index.save(tmp_path / "dense")
+        loaded = TpuDenseIndex.load(tmp_path / "dense", dtype="float32")
+        assert loaded.size == 11
+        q = embs[5]
+        orig = [(d.id, round(s, 5)) for d, s in index.search(q, 5)]
+        new = [(d.id, round(s, 5)) for d, s in loaded.search(q, 5)]
+        assert orig == new
+
+    def test_top_k_larger_than_corpus(self):
+        docs, embs = _corpus(3, 8)
+        index = TpuDenseIndex(dim=8, dtype="float32")
+        index.add(docs, embs)
+        assert len(index.search(embs[0], top_k=50)) == 3
+
+
+class TestShardedIndex:
+    def test_sharded_matches_single_device(self):
+        mesh = build_mesh(MeshConfig())  # 8-way dp over CPU devices
+        docs, embs = _corpus(40, 16, seed=3)
+        plain = TpuDenseIndex(dim=16, dtype="float32")
+        plain.add(docs, embs)
+        sharded = TpuDenseIndex(dim=16, mesh=mesh, dtype="float32")
+        sharded.add(docs, embs)
+        qs = np.random.default_rng(4).standard_normal((3, 16)).astype(np.float32)
+        for q in qs:
+            a = [(d.id, round(s, 4)) for d, s in plain.search(q, 7)]
+            b = [(d.id, round(s, 4)) for d, s in sharded.search(q, 7)]
+            assert a == b
+
+    def test_sharded_small_corpus(self):
+        """Fewer docs than devices — padding rows must never surface."""
+        mesh = build_mesh(MeshConfig())
+        docs, embs = _corpus(3, 8, seed=5)
+        index = TpuDenseIndex(dim=8, mesh=mesh, dtype="float32")
+        index.add(docs, embs)
+        hits = index.search(embs[1], top_k=3)
+        assert len(hits) == 3
+        assert hits[0][0].id == "d1"
+
+
+def test_compaction_bounds_dead_rows():
+    docs, embs = _corpus(20, 8)
+    index = TpuDenseIndex(dim=8, dtype="float32")
+    index.add(docs, embs)
+    # churn: upsert the same corpus repeatedly (tombstones old rows each time)
+    for _ in range(5):
+        fresh = [Document(text=d.text, id=d.id) for d in docs]
+        index.add(fresh, embs)
+    assert index.size == 20
+    total_rows = len(index._documents)
+    assert total_rows <= 20 * 1.5  # compaction kept the table bounded
+    hits = index.search(embs[4], top_k=1)
+    assert hits[0][0].id == "d4"

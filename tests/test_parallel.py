@@ -1,0 +1,171 @@
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sentio_tpu.config import MeshConfig
+from sentio_tpu.models.llama import LlamaConfig, init_llama, llama_forward
+from sentio_tpu.parallel.batcher import Batcher, BatcherClosed, bucket_size
+from sentio_tpu.parallel.mesh import (
+    MeshError,
+    batch_multiple,
+    build_mesh,
+    resolve_spec,
+)
+from sentio_tpu.parallel.sharding import (
+    LLAMA_TP_RULES,
+    batch_sharding,
+    describe_shardings,
+    shard_params,
+    spec_for,
+)
+from jax.sharding import PartitionSpec as P
+
+
+class TestMesh:
+    def test_resolve_defaults_all_dp(self):
+        spec = resolve_spec(MeshConfig(), 8)
+        assert spec.shape == (1, 8, 1, 1)
+
+    def test_resolve_tp(self):
+        spec = resolve_spec(MeshConfig(tp_size=4), 8)
+        assert spec.shape == (1, 2, 1, 4)
+
+    def test_resolve_rejects_indivisible(self):
+        with pytest.raises(MeshError):
+            resolve_spec(MeshConfig(tp_size=3), 8)
+
+    def test_resolve_rejects_overcommit(self):
+        with pytest.raises(MeshError):
+            resolve_spec(MeshConfig(dp_size=4, tp_size=4), 8)
+
+    def test_build_mesh_axes(self):
+        mesh = build_mesh(MeshConfig(tp_size=2, sp_size=2))
+        assert dict(mesh.shape) == {"dcn": 1, "dp": 2, "sp": 2, "tp": 2}
+        assert batch_multiple(mesh) == 2
+
+    def test_mesh_uses_all_devices(self):
+        mesh = build_mesh(MeshConfig())
+        assert mesh.devices.size == len(jax.devices())
+
+
+class TestShardingRules:
+    def test_llama_rule_resolution(self):
+        assert spec_for("layers_3/attn/wq/kernel", LLAMA_TP_RULES, 2) == P(None, "tp")
+        assert spec_for("layers_0/attn/wo/kernel", LLAMA_TP_RULES, 2) == P("tp", None)
+        assert spec_for("layers_9/mlp/w_up/kernel", LLAMA_TP_RULES, 2) == P(None, "tp")
+        assert spec_for("layers_9/mlp/w_down/kernel", LLAMA_TP_RULES, 2) == P("tp", None)
+        assert spec_for("embed_tokens/embedding", LLAMA_TP_RULES, 2) == P("tp", None)
+        assert spec_for("final_norm/scale", LLAMA_TP_RULES, 1) == P(None)
+        assert spec_for("something/unmatched", LLAMA_TP_RULES, 2) == P()
+
+    def test_tp_sharded_forward_matches_replicated(self):
+        cfg = LlamaConfig(
+            vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            mlp_dim=128, max_len=64, rope_theta=10_000.0, dtype="float32",
+        )
+        params = init_llama(jax.random.PRNGKey(0), cfg)
+        ids = jnp.asarray(np.random.default_rng(1).integers(1, 500, (4, 8)), jnp.int32)
+        ref, _ = llama_forward(params, cfg, ids)
+
+        mesh = build_mesh(MeshConfig(tp_size=2))
+        sharded = shard_params(params, mesh, LLAMA_TP_RULES)
+        ids_sharded = jax.device_put(ids, batch_sharding(mesh))
+        out, _ = llama_forward(sharded, cfg, ids_sharded)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=1e-4)
+
+    def test_describe_shardings_covers_all_params(self):
+        cfg = LlamaConfig.tiny()
+        params = init_llama(jax.random.PRNGKey(0), cfg)
+        mesh = build_mesh(MeshConfig(tp_size=2))
+        desc = describe_shardings(params, mesh, LLAMA_TP_RULES)
+        n_leaves = len(jax.tree_util.tree_leaves(params))
+        assert len(desc) == n_leaves
+        assert desc["layers_0/attn/wq/kernel"] == "PartitionSpec(None, 'tp')"
+
+
+class TestBatcher:
+    def test_coalesces_concurrent_submits(self):
+        async def run():
+            sizes = []
+
+            async def process(items):
+                sizes.append(len(items))
+                return [x * 2 for x in items]
+
+            batcher = Batcher(process, max_size=4, deadline_ms=50.0)
+            results = await asyncio.gather(*[batcher.submit(i) for i in range(4)])
+            await batcher.close()
+            return results, sizes
+
+        results, sizes = asyncio.run(run())
+        assert sorted(results) == [0, 2, 4, 6]
+        assert max(sizes) > 1  # actually coalesced
+
+    def test_deadline_flushes_partial_batch(self):
+        async def run():
+            async def process(items):
+                return items
+
+            batcher = Batcher(process, max_size=100, deadline_ms=5.0)
+            result = await asyncio.wait_for(batcher.submit("only"), timeout=2.0)
+            await batcher.close()
+            return result
+
+        assert asyncio.run(run()) == "only"
+
+    def test_failed_batch_fails_futures_not_batcher(self):
+        async def run():
+            calls = {"n": 0}
+
+            async def process(items):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise RuntimeError("device OOM")
+                return items
+
+            batcher = Batcher(process, max_size=2, deadline_ms=1.0)
+            with pytest.raises(RuntimeError, match="device OOM"):
+                await batcher.submit("a")
+            ok = await batcher.submit("b")  # batcher survives
+            stats = batcher.stats.snapshot()
+            await batcher.close()
+            return ok, stats
+
+        ok, stats = asyncio.run(run())
+        assert ok == "b"
+        assert stats["errors"] == 1
+        assert stats["batches"] == 2
+
+    def test_result_count_mismatch_is_error(self):
+        async def run():
+            async def process(items):
+                return items[:-1]
+
+            batcher = Batcher(process, max_size=1, deadline_ms=1.0)
+            with pytest.raises(RuntimeError, match="returned"):
+                await batcher.submit("x")
+            await batcher.close()
+
+        asyncio.run(run())
+
+    def test_closed_batcher_rejects(self):
+        async def run():
+            async def process(items):
+                return items
+
+            batcher = Batcher(process, max_size=1, deadline_ms=1.0)
+            await batcher.submit("warm")
+            await batcher.close()
+            with pytest.raises(BatcherClosed):
+                await batcher.submit("late")
+
+        asyncio.run(run())
+
+    def test_bucket_size(self):
+        assert bucket_size(1, [2, 4, 8]) == 2
+        assert bucket_size(3, [2, 4, 8]) == 4
+        assert bucket_size(8, [2, 4, 8]) == 8
+        assert bucket_size(9, [2, 4, 8]) == 8  # clamps to max
