@@ -1,0 +1,77 @@
+"""Graph assembly: conditional pipeline construction from config.
+
+Parity with /root/reference/src/core/graph/factory.py:28-208 (``GraphConfig``
+with USE_RERANKER / USE_VERIFIER toggles, ``build_basic_graph``,
+``build_streaming_graph``) on our own executor — stage boundaries double as
+host/TPU dispatch points. The conditional edges mirror the reference's:
+retrieve → [rerank] → select → generate → [verify] → END.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from sentio_tpu.config import Settings, get_settings
+from sentio_tpu.graph.executor import END, CompiledGraph, GraphBuilder
+from sentio_tpu.graph.nodes import (
+    create_document_selector_node,
+    create_generator_node,
+    create_reranker_node,
+    create_retriever_node,
+    create_verifier_node,
+)
+
+
+@dataclass
+class GraphConfig:
+    use_reranker: bool = True
+    use_verifier: bool = True
+    settings: Settings = field(default_factory=get_settings)
+
+    @classmethod
+    def from_settings(cls, settings: Optional[Settings] = None) -> "GraphConfig":
+        settings = settings or get_settings()
+        return cls(
+            use_reranker=settings.rerank.enabled,
+            use_verifier=settings.generator.use_verifier,
+            settings=settings,
+        )
+
+
+def build_basic_graph(
+    retriever,
+    generator,
+    reranker=None,
+    verifier=None,
+    config: Optional[GraphConfig] = None,
+) -> CompiledGraph:
+    config = config or GraphConfig.from_settings()
+    settings = config.settings
+    builder = GraphBuilder()
+
+    builder.add_node("retrieve", create_retriever_node(retriever, settings))
+    use_rerank = config.use_reranker and reranker is not None
+    if use_rerank:
+        builder.add_node("rerank", create_reranker_node(reranker, settings))
+    builder.add_node("select", create_document_selector_node(settings))
+    builder.add_node("generate", create_generator_node(generator, settings))
+    use_verify = config.use_verifier and verifier is not None
+    if use_verify:
+        builder.add_node("verify", create_verifier_node(verifier, settings))
+
+    builder.set_entry("retrieve")
+    builder.add_edge("retrieve", "rerank" if use_rerank else "select")
+    if use_rerank:
+        builder.add_edge("rerank", "select")
+    builder.add_edge("select", "generate")
+    builder.add_edge("generate", "verify" if use_verify else END)
+    if use_verify:
+        builder.add_edge("verify", END)
+    return builder.compile()
+
+
+def build_streaming_graph(*args, **kwargs) -> CompiledGraph:
+    """Streaming runs the same pipeline; the serving layer streams the
+    generator stage directly (the reference's alias, factory.py:191-208)."""
+    return build_basic_graph(*args, **kwargs)

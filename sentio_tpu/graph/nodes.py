@@ -1,0 +1,171 @@
+"""Graph node factories: retrieve → rerank → select → generate → verify.
+
+Parity with /root/reference/src/core/graph/nodes.py:37-478: per-request
+``user_top_k`` override, content-normalization via ``Document.content``,
+the selector's sort/dedup/token-budget pass (≈4 chars/token heuristic,
+nodes.py:276-338 there), the generator's mode/temperature metadata, and the
+verifier rewriting the answer on a ``fail`` verdict (:471-472). Every node
+returns a *partial* state update and records soft errors in metadata instead
+of raising — the executor's soft-fail plus these per-node catches reproduce
+the reference's "every stage degrades, nothing 500s" ladder.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Optional
+
+from sentio_tpu.config import Settings, get_settings
+from sentio_tpu.graph.state import RAGState, best_documents
+from sentio_tpu.models.document import Document
+
+logger = logging.getLogger(__name__)
+
+
+def _user_top_k(state: RAGState, default: int, cap: int = 50) -> int:
+    raw = state.get("metadata", {}).get("user_top_k")
+    if raw is None:
+        return default
+    try:
+        return max(1, min(int(raw), cap))
+    except (TypeError, ValueError):
+        return default
+
+
+def create_retriever_node(retriever, settings: Optional[Settings] = None):
+    settings = settings or get_settings()
+
+    async def retrieve_node(state: RAGState) -> dict[str, Any]:
+        top_k = _user_top_k(state, settings.retrieval.top_k)
+        t0 = time.perf_counter()
+        try:
+            docs = await retriever.aretrieve(state["query"], top_k=top_k)
+        except Exception as exc:  # noqa: BLE001
+            logger.exception("retrieval failed")
+            return {"retrieved_documents": [], "metadata": {"retrieval_error": str(exc)}}
+        return {
+            "retrieved_documents": docs,
+            "metadata": {
+                "num_retrieved": len(docs),
+                "retrieval_ms": round((time.perf_counter() - t0) * 1000, 2),
+                "retriever": getattr(retriever, "name", "unknown"),
+            },
+        }
+
+    return retrieve_node
+
+
+def create_reranker_node(reranker, settings: Optional[Settings] = None):
+    settings = settings or get_settings()
+
+    async def rerank_node(state: RAGState) -> dict[str, Any]:
+        docs = state.get("retrieved_documents") or []
+        if not docs:
+            return {"reranked_documents": [], "metadata": {"num_reranked": 0}}
+        top_k = _user_top_k(state, settings.rerank.top_k)
+        t0 = time.perf_counter()
+        result = await reranker.arerank(state["query"], docs, top_k=top_k)
+        return {
+            "reranked_documents": result.documents,
+            "metadata": {
+                "num_reranked": len(result.documents),
+                "rerank_ms": round((time.perf_counter() - t0) * 1000, 2),
+                "reranker": result.model,
+                "rerank_fallback": result.fallback_used,
+            },
+        }
+
+    return rerank_node
+
+
+def create_document_selector_node(settings: Optional[Settings] = None):
+    settings = settings or get_settings()
+    budget_tokens = settings.generator.context_token_budget
+
+    def select_node(state: RAGState) -> dict[str, Any]:
+        docs = state.get("reranked_documents") or state.get("retrieved_documents") or []
+        # sort by best score, dedup by id (reference nodes.py:276-338)
+        docs = sorted(docs, key=lambda d: d.score(), reverse=True)
+        seen: set[str] = set()
+        budget_chars = budget_tokens * 4  # ≈4 chars/token heuristic
+        used = 0
+        selected: list[Document] = []
+        for doc in docs:
+            if doc.id in seen:
+                continue
+            seen.add(doc.id)
+            text = doc.content
+            if not text.strip():
+                continue
+            cost = len(text)
+            if used + cost > budget_chars and selected:
+                continue  # keep scanning: a shorter doc may still fit
+            selected.append(doc)
+            used += cost
+            if used >= budget_chars:
+                break
+        return {
+            "selected_documents": selected,
+            "metadata": {
+                "num_selected": len(selected),
+                "context_chars": used,
+                "context_budget_chars": budget_chars,
+            },
+        }
+
+    return select_node
+
+
+def create_generator_node(generator, settings: Optional[Settings] = None):
+    settings = settings or get_settings()
+
+    def generate_node(state: RAGState) -> dict[str, Any]:
+        docs = best_documents(state)
+        meta = state.get("metadata", {})
+        mode = meta.get("mode") or settings.generator.mode
+        temperature = meta.get("temperature")
+        t0 = time.perf_counter()
+        try:
+            answer = generator.generate(
+                state["query"], docs, mode=mode,
+                temperature=temperature if temperature is None else float(temperature),
+            )
+        except Exception as exc:  # noqa: BLE001
+            logger.exception("generation failed")
+            return {"response": "", "metadata": {"generation_error": str(exc)}}
+        return {
+            "response": answer,
+            "metadata": {
+                "generation_ms": round((time.perf_counter() - t0) * 1000, 2),
+                "generation_mode": mode,
+                "generator": getattr(generator.provider, "name", "unknown"),
+            },
+        }
+
+    return generate_node
+
+
+def create_verifier_node(verifier, settings: Optional[Settings] = None):
+    settings = settings or get_settings()
+
+    def verify_node(state: RAGState) -> dict[str, Any]:
+        answer = state.get("response", "")
+        if not answer:
+            return {"evaluation": {"verdict": "warn", "notes": ["empty answer"]}}
+        docs = best_documents(state)
+        t0 = time.perf_counter()
+        result = verifier.verify(state["query"], answer, docs)
+        update: dict[str, Any] = {
+            "evaluation": result.to_dict(),
+            "metadata": {
+                "verify_ms": round((time.perf_counter() - t0) * 1000, 2),
+                "verdict": result.verdict,
+            },
+        }
+        if result.verdict == "fail" and result.revised_answer:
+            update["response"] = result.revised_answer
+            update["metadata"]["answer_revised"] = True
+        return update
+
+    return verify_node

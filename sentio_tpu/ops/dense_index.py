@@ -63,6 +63,13 @@ class TpuDenseIndex:
             raise DenseIndexError("documents/embeddings length mismatch")
         norms = np.linalg.norm(embeddings, axis=1, keepdims=True)
         embeddings = embeddings / np.maximum(norms, 1e-9)
+        # duplicate ids within one batch: last write wins (otherwise the
+        # earlier row would stay alive but unreachable through _id_to_row)
+        last_by_id = {doc.id: i for i, doc in enumerate(documents)}
+        if len(last_by_id) != len(documents):
+            keep = sorted(last_by_id.values())
+            documents = [documents[i] for i in keep]
+            embeddings = embeddings[keep]
         for doc in documents:
             if doc.id in self._id_to_row:  # upsert: tombstone the old row
                 self._alive[self._id_to_row[doc.id]] = False

@@ -160,8 +160,10 @@ class HashEmbedder(BaseEmbedder):
             seed = int.from_bytes(hashlib.sha256(text.lower().encode()).digest()[:8], "little")
             rng = np.random.default_rng(seed)
             vec = rng.standard_normal(self.dimension).astype(np.float32)
-            # mix in token-level signal so related texts correlate
-            for tok in set(text.lower().split()):
+            # mix in token-level signal so related texts correlate; sorted so
+            # float summation order (and thus the vector) is identical across
+            # processes regardless of PYTHONHASHSEED
+            for tok in sorted(set(text.lower().split())):
                 tseed = int.from_bytes(hashlib.md5(tok.encode()).digest()[:8], "little")
                 trng = np.random.default_rng(tseed)
                 vec += 4.0 * trng.standard_normal(self.dimension).astype(np.float32)

@@ -1,0 +1,173 @@
+"""LLMGenerator: citation-grounded answer generation over the TPU engine.
+
+Parity with /root/reference/src/core/llm/generator.py:19-333 and
+chat_adapter.py:29-94: numbered ``[n] Source … score`` context assembly with
+an instruction footer, temperature-by-mode (fast/balanced/quality/creative =
+0.0/0.3/0.2/0.7), sync + streaming paths, and a provider seam — the exact
+swap point the reference used for OpenAI-compatible APIs — now dispatching
+to the in-process :class:`GeneratorEngine`. An ``echo`` provider is the
+deterministic offline fake (the reference's mock-mode test pattern).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, Optional, Protocol, Sequence
+
+from sentio_tpu.config import GeneratorConfig, get_settings
+from sentio_tpu.models.document import Document
+from sentio_tpu.ops.prompts import PromptBuilder
+
+
+class ChatProvider(Protocol):
+    name: str
+
+    def chat(self, prompt: str, max_new_tokens: int, temperature: float) -> str: ...
+    def stream(self, prompt: str, max_new_tokens: int, temperature: float) -> Iterator[str]: ...
+
+
+@dataclass
+class EchoProvider:
+    """Deterministic fake: answers by quoting the top source. Lets the whole
+    pipeline (graph, API, CLI, tests) run with zero hardware and stable
+    output, like the reference's hash-mock embedder did for embeddings."""
+
+    name: str = "echo"
+
+    def chat(self, prompt: str, max_new_tokens: int, temperature: float) -> str:
+        line = ""
+        for cand in prompt.splitlines():
+            if cand.strip().startswith("[1]"):
+                line = cand.strip()
+                break
+        if line:
+            return f"Based on the provided sources, the most relevant finding is: {line}"
+        return "No sources were provided, so no grounded answer is available."
+
+    def stream(self, prompt: str, max_new_tokens: int, temperature: float) -> Iterator[str]:
+        text = self.chat(prompt, max_new_tokens, temperature)
+        for i in range(0, len(text), 16):
+            yield text[i : i + 16]
+
+
+@dataclass
+class TpuProvider:
+    engine: object = None  # GeneratorEngine
+    name: str = "tpu"
+
+    def chat(self, prompt: str, max_new_tokens: int, temperature: float) -> str:
+        result = self.engine.generate(
+            [prompt], max_new_tokens=max_new_tokens, temperature=temperature
+        )[0]
+        return result.text
+
+    def stream(self, prompt: str, max_new_tokens: int, temperature: float) -> Iterator[str]:
+        yield from self.engine.stream(
+            prompt, max_new_tokens=max_new_tokens, temperature=temperature
+        )
+
+
+_PROVIDERS: dict[str, type] = {}
+
+
+def register_provider(name: str):
+    """Decorator registry (reference: llm/providers/__init__.py:12-41)."""
+
+    def deco(cls):
+        _PROVIDERS[name] = cls
+        return cls
+
+    return deco
+
+
+register_provider("echo")(EchoProvider)
+register_provider("tpu")(TpuProvider)
+
+
+def get_provider(name: str, **kwargs):
+    cls = _PROVIDERS.get(name)
+    if cls is None:
+        raise ValueError(f"unknown LLM provider {name!r}; known: {sorted(_PROVIDERS)}")
+    return cls(**kwargs)
+
+
+@dataclass
+class LLMGenerator:
+    provider: ChatProvider = field(default_factory=EchoProvider)
+    config: GeneratorConfig = field(default_factory=lambda: get_settings().generator)
+    prompts: PromptBuilder = field(default_factory=PromptBuilder)
+
+    # ---------------------------------------------------------- context build
+
+    def prepare_context(self, documents: Sequence[Document]) -> str:
+        """Numbered, citation-ready context block (reference
+        generator.py:193-254): '[n] Source: … (score …)' headers + text."""
+        if not documents:
+            return "(no context documents)"
+        blocks = []
+        for i, doc in enumerate(documents, start=1):
+            source = doc.metadata.get("source") or doc.metadata.get("source_file") or doc.id
+            score = doc.score()
+            header = f"[{i}] Source: {source} (score {score:.3f})"
+            blocks.append(f"{header}\n{doc.content.strip()}")
+        return "\n\n".join(blocks)
+
+    def build_prompt(self, query: str, documents: Sequence[Document]) -> str:
+        instruction = self.prompts.load("profile")
+        context = self.prepare_context(documents)
+        return self.prompts.build("retrieve", instruction=instruction, context=context, query=query)
+
+    # ------------------------------------------------------------- generation
+
+    def generate(
+        self,
+        query: str,
+        documents: Sequence[Document],
+        mode: Optional[str] = None,
+        temperature: Optional[float] = None,
+        max_new_tokens: Optional[int] = None,
+    ) -> str:
+        prompt = self.build_prompt(query, documents)
+        temp = temperature if temperature is not None else self.config.temperature(mode)
+        return self.provider.chat(
+            prompt,
+            max_new_tokens=max_new_tokens or self.config.max_new_tokens,
+            temperature=temp,
+        )
+
+    def stream(
+        self,
+        query: str,
+        documents: Sequence[Document],
+        mode: Optional[str] = None,
+        temperature: Optional[float] = None,
+        max_new_tokens: Optional[int] = None,
+    ) -> Iterator[str]:
+        prompt = self.build_prompt(query, documents)
+        temp = temperature if temperature is not None else self.config.temperature(mode)
+        yield from self.provider.stream(
+            prompt,
+            max_new_tokens=max_new_tokens or self.config.max_new_tokens,
+            temperature=temp,
+        )
+
+    def chat_raw(self, prompt: str, max_new_tokens: int, temperature: float) -> str:
+        """Direct provider access (verifier path — shares the weights)."""
+        return self.provider.chat(prompt, max_new_tokens=max_new_tokens, temperature=temperature)
+
+
+def create_generator(
+    settings=None,
+    engine=None,
+) -> LLMGenerator:
+    """env→generator wiring (reference: llm/factory.py:14-69)."""
+    settings = settings or get_settings()
+    cfg = settings.generator
+    if cfg.provider == "tpu" and engine is not None:
+        provider = TpuProvider(engine=engine)
+    elif cfg.provider == "tpu":
+        # no engine supplied (tests, host-only dev) → deterministic echo
+        provider = EchoProvider()
+    else:
+        provider = get_provider(cfg.provider)
+    return LLMGenerator(provider=provider, config=cfg)

@@ -1,0 +1,90 @@
+"""Prompt templates: file-loaded, class-cached, with inline fallbacks.
+
+Parity with /root/reference/src/core/llm/prompt_builder.py:22-162 — templates
+live in ``prompts/*.md``, substitution uses literal ``str.replace`` on
+``{instruction}/{context}/{query}`` (NOT ``.format``, so braces inside
+retrieved context can never KeyError), files are read once per process, and
+missing files fall back to built-in templates so the framework runs from a
+bare checkout.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Optional
+
+_FALLBACK_TEMPLATES = {
+    "profile": (
+        "You are a retrieval-grounded assistant. Answer strictly from the "
+        "provided sources, cite them as [n], and say when the sources are "
+        "insufficient."
+    ),
+    "retrieve": (
+        "{instruction}\n\n"
+        "Context documents:\n{context}\n\n"
+        "Question: {query}\n\n"
+        "Answer using only the context above. Cite sources inline as [n]. "
+        "If the context does not contain the answer, say so plainly."
+    ),
+    "verify": (
+        "You are auditing an answer for faithfulness to its sources.\n"
+        "Question: {query}\n\nSources:\n{context}\n\nAnswer:\n{instruction}\n\n"
+        'Reply with ONLY a JSON object: {"verdict": "pass"|"warn"|"fail", '
+        '"citations_ok": true|false, "notes": ["..."], '
+        '"revised_answer": "... (only when verdict is fail)"}'
+    ),
+    "summarize": "Summarize the following faithfully and concisely:\n\n{context}",
+    "fallback_no_retrieval": (
+        "I could not search the knowledge base just now. From general "
+        "knowledge, with no citations available: {query}"
+    ),
+    "fallback_no_llm": (
+        "The language model is unavailable. The most relevant passages "
+        "found were:\n{context}"
+    ),
+    "fallback_apology": (
+        "I'm sorry — I can't answer right now due to an internal error. "
+        "Please try again shortly."
+    ),
+}
+
+
+class PromptBuilder:
+    _cache: dict[str, str] = {}
+
+    def __init__(self, prompts_dir: Optional[str] = None) -> None:
+        self.prompts_dir = Path(prompts_dir) if prompts_dir else Path("prompts")
+
+    def load(self, name: str) -> str:
+        cache_key = f"{self.prompts_dir}:{name}"
+        cached = self._cache.get(cache_key)
+        if cached is not None:
+            return cached
+        path = self.prompts_dir / f"{name}.md"
+        try:
+            text = path.read_text().strip()
+        except OSError:
+            text = _FALLBACK_TEMPLATES.get(name, "{instruction}\n{context}\n{query}")
+        self._cache[cache_key] = text
+        return text
+
+    def build(
+        self,
+        name: str,
+        instruction: str = "",
+        context: str = "",
+        query: str = "",
+    ) -> str:
+        template = self.load(name)
+        values = {"instruction": instruction, "context": context, "query": query}
+        # single-pass substitution: placeholder strings occurring INSIDE a
+        # substituted value (an answer quoting "{context}", say) must not be
+        # re-expanded, and other braces in retrieved text stay literal
+        return re.sub(
+            r"\{(instruction|context|query)\}", lambda m: values[m.group(1)], template
+        )
+
+    @classmethod
+    def clear_cache(cls) -> None:
+        cls._cache.clear()

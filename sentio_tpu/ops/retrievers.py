@@ -1,0 +1,164 @@
+"""Retrievers: dense (TPU index), sparse (host BM25), and hybrid fusion.
+
+Parity with /root/reference/src/core/retrievers/: ``BaseRetriever`` ABC with
+an async wrapper (base.py:29-42), dense retrieval (dense.py:21-119 — but the
+embedding is an in-process TPU forward and the store is the in-HBM exact
+index instead of Qdrant-over-HTTP), BM25 (sparse.py), and the hybrid fuser
+(hybrid.py:48-324) with rrf/weighted_rrf/comb_sum and post-fusion scorer
+plugins. The dense and sparse legs run concurrently — device matmul and host
+CPU scoring overlap (`asyncio.gather` over the executor).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from sentio_tpu.config import RetrievalConfig, Settings, get_settings
+from sentio_tpu.models.document import Document
+from sentio_tpu.ops.bm25 import BM25Index
+from sentio_tpu.ops.dense_index import TpuDenseIndex
+from sentio_tpu.ops.fusion import fuse
+from sentio_tpu.ops.scorers import ScorerPlugin
+
+
+class RetrieverError(Exception):
+    pass
+
+
+class BaseRetriever:
+    """retrieve(query, top_k) → ranked Documents; aretrieve = executor wrap."""
+
+    name = "base"
+
+    def retrieve(self, query: str, top_k: int = 10) -> list[Document]:
+        raise NotImplementedError
+
+    async def aretrieve(self, query: str, top_k: int = 10) -> list[Document]:
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self.retrieve, query, top_k
+        )
+
+
+@dataclass
+class DenseRetriever(BaseRetriever):
+    embedder: object
+    index: TpuDenseIndex
+    name: str = "dense"
+
+    def retrieve(self, query: str, top_k: int = 10) -> list[Document]:
+        q_vec = self.embedder.embed(query)
+        return self.index.retrieve(np.asarray(q_vec, np.float32), top_k)
+
+
+@dataclass
+class SparseRetriever(BaseRetriever):
+    index: BM25Index
+    name: str = "bm25"
+
+    def retrieve(self, query: str, top_k: int = 10) -> list[Document]:
+        return self.index.retrieve(query, top_k)
+
+
+@dataclass
+class HybridRetriever(BaseRetriever):
+    """Fuses any number of legs. Candidate pools are over-fetched (top_k * 2,
+    min 10) before fusion so the fused head has depth, matching the
+    reference's pool-then-truncate behavior."""
+
+    retrievers: Sequence[BaseRetriever] = ()
+    config: RetrievalConfig = field(default_factory=RetrievalConfig)
+    scorers: Sequence[ScorerPlugin] = ()
+    name: str = "hybrid"
+
+    def _weights(self) -> list[float]:
+        table = {"dense": self.config.dense_weight, "bm25": self.config.sparse_weight}
+        return [table.get(r.name, 1.0) for r in self.retrievers]
+
+    def retrieve(self, query: str, top_k: int = 10) -> list[Document]:
+        return asyncio.run(self.aretrieve(query, top_k))
+
+    async def aretrieve(self, query: str, top_k: int = 10) -> list[Document]:
+        pool = max(top_k * 2, 10)
+        legs = await asyncio.gather(
+            *[r.aretrieve(query, pool) for r in self.retrievers],
+            return_exceptions=True,
+        )
+        ok_lists: list[list[Document]] = []
+        ok_weights: list[float] = []
+        for leg, weight in zip(legs, self._weights()):
+            if isinstance(leg, Exception):
+                continue  # degraded: a failed leg drops out, fusion continues
+            ok_lists.append(leg)
+            ok_weights.append(weight)
+        if not ok_lists:
+            raise RetrieverError("all retrieval legs failed")
+        fused = fuse(
+            ok_lists,
+            method=self.config.fusion_method,
+            weights=ok_weights,
+            rrf_k=self.config.rrf_k,
+        )
+        fused = self._apply_scorers(query, fused)
+        return fused[:top_k]
+
+    def _apply_scorers(self, query: str, docs: list[Document]) -> list[Document]:
+        if not self.scorers or not docs:
+            return docs
+        base = np.asarray([d.score() for d in docs], np.float32)
+        lo, hi = float(base.min()), float(base.max())
+        mixed = (base - lo) / (hi - lo) if hi > lo else np.ones_like(base)
+        total_w = 1.0
+        for scorer in self.scorers:
+            try:
+                s = scorer.score(query, docs)
+            except Exception:
+                continue  # a broken plugin never kills retrieval
+            mixed = mixed + scorer.weight * np.asarray(s, np.float32)
+            total_w += scorer.weight
+        mixed = mixed / total_w
+        order = np.argsort(-mixed, kind="stable")
+        out = []
+        for rank, i in enumerate(order):
+            doc = docs[int(i)]
+            doc.metadata["hybrid_score"] = float(mixed[int(i)])
+            doc.metadata["score"] = float(mixed[int(i)])
+            out.append(doc)
+        return out
+
+
+def create_retriever(
+    settings: Optional[Settings] = None,
+    embedder=None,
+    dense_index: Optional[TpuDenseIndex] = None,
+    bm25_index: Optional[BM25Index] = None,
+    scorers: Optional[Sequence[ScorerPlugin]] = None,
+) -> BaseRetriever:
+    """Strategy registry (reference: retrievers/factory.py:21-196): ``dense``,
+    ``bm25``, or ``hybrid`` from config; hybrid tolerates a missing leg."""
+    settings = settings or get_settings()
+    strategy = settings.retrieval.strategy
+    dense = DenseRetriever(embedder, dense_index) if embedder is not None and dense_index is not None else None
+    sparse = SparseRetriever(bm25_index) if bm25_index is not None else None
+
+    if strategy == "dense":
+        if dense is None:
+            raise RetrieverError("dense strategy needs embedder + dense_index")
+        return dense
+    if strategy in ("bm25", "sparse"):
+        if sparse is None:
+            raise RetrieverError("bm25 strategy needs a BM25 index")
+        return sparse
+    if strategy == "hybrid":
+        legs = [r for r in (dense, sparse) if r is not None]
+        if not legs:
+            raise RetrieverError("hybrid strategy needs at least one leg")
+        return HybridRetriever(
+            retrievers=legs,
+            config=settings.retrieval,
+            scorers=scorers or (),
+        )
+    raise RetrieverError(f"unknown retrieval strategy {strategy!r}")

@@ -1,0 +1,151 @@
+"""Scorer plugins: post-fusion relevance signals layered on retrieval.
+
+Parity with /root/reference/src/core/retrievers/scorers.py:25-273 — keyword
+overlap, recency decay, semantic similarity, and MMR diversification — with
+the TPU-native difference called out in SURVEY.md §2.2: the reference
+re-embeds every document with one HTTP call each (N+1 calls) and runs an
+O(k²) Python cosine loop; here semantic + MMR ride ONE batched embed forward
+pass and vectorized numpy cosine matrices (k ≤ ~100 post-fusion, so the
+matrix math is host-trivial once embeddings are batched).
+
+Each scorer maps (query, docs) → score per doc in [0, 1]; the hybrid
+retriever mixes them into fused scores with per-scorer weights.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Protocol, Sequence
+
+import numpy as np
+
+from sentio_tpu.models.document import Document
+
+
+class ScorerPlugin(Protocol):
+    name: str
+    weight: float
+
+    def score(self, query: str, documents: Sequence[Document]) -> np.ndarray: ...
+
+
+def _doc_embeddings(embedder, query: str, documents: Sequence[Document]):
+    """One batched forward for query + all docs → (q_vec, doc_matrix)."""
+    texts = [query] + [d.content for d in documents]
+    vecs = embedder.embed_many(texts)
+    return vecs[0], vecs[1:]
+
+
+@dataclass
+class KeywordMatchScorer:
+    """Word-overlap fraction between query terms and document text."""
+
+    weight: float = 0.8
+    name: str = "keyword"
+
+    def score(self, query: str, documents: Sequence[Document]) -> np.ndarray:
+        q_terms = set(re.findall(r"\w+", query.lower()))
+        out = np.zeros(len(documents), np.float32)
+        if not q_terms:
+            return out
+        for i, doc in enumerate(documents):
+            d_terms = set(re.findall(r"\w+", doc.content.lower()))
+            out[i] = len(q_terms & d_terms) / len(q_terms)
+        return out
+
+
+@dataclass
+class RecencyScorer:
+    """Exponential decay on ``metadata['timestamp']`` (unix seconds); docs
+    without a timestamp score the neutral 0.5 (reference behavior)."""
+
+    weight: float = 0.2
+    half_life_days: float = 30.0
+    name: str = "recency"
+
+    def score(self, query: str, documents: Sequence[Document]) -> np.ndarray:
+        now = time.time()
+        out = np.full(len(documents), 0.5, np.float32)
+        half_life_s = self.half_life_days * 86_400.0
+        for i, doc in enumerate(documents):
+            ts = doc.metadata.get("timestamp")
+            if ts is None:
+                continue
+            try:
+                age = max(now - float(ts), 0.0)
+            except (TypeError, ValueError):
+                continue
+            out[i] = float(0.5 ** (age / half_life_s))
+        return out
+
+
+@dataclass
+class SemanticSimilarityScorer:
+    """Cosine(query, doc) via one batched embed (embeddings are unit-norm),
+    mapped from [-1, 1] to [0, 1]."""
+
+    embedder: object = None
+    weight: float = 0.5
+    name: str = "semantic"
+
+    def score(self, query: str, documents: Sequence[Document]) -> np.ndarray:
+        if self.embedder is None or not documents:
+            return np.zeros(len(documents), np.float32)
+        q_vec, doc_mat = _doc_embeddings(self.embedder, query, documents)
+        sims = doc_mat @ q_vec
+        return ((sims + 1.0) / 2.0).astype(np.float32)
+
+
+@dataclass
+class MMRScorer:
+    """Maximal Marginal Relevance: greedy λ·relevance − (1−λ)·redundancy.
+    Returns a rank-based score (first-selected highest) rather than reordering
+    in place, so it composes with the other scorers by weight."""
+
+    embedder: object = None
+    lambda_param: float = 0.7
+    weight: float = 0.5
+    name: str = "mmr"
+
+    def score(self, query: str, documents: Sequence[Document]) -> np.ndarray:
+        n = len(documents)
+        if self.embedder is None or n == 0:
+            return np.zeros(n, np.float32)
+        q_vec, doc_mat = _doc_embeddings(self.embedder, query, documents)
+        rel = doc_mat @ q_vec  # [n]
+        sim = doc_mat @ doc_mat.T  # [n, n] — one matrix, not an O(k²) loop
+        lam = self.lambda_param
+
+        selected: list[int] = []
+        remaining = set(range(n))
+        while remaining:
+            if not selected:
+                best = int(np.argmax([rel[i] for i in sorted(remaining)]))
+                best = sorted(remaining)[best]
+            else:
+                best, best_val = -1, -np.inf
+                sel = np.asarray(selected)
+                for i in remaining:
+                    val = lam * rel[i] - (1.0 - lam) * float(sim[i, sel].max())
+                    if val > best_val:
+                        best, best_val = i, val
+            selected.append(best)
+            remaining.discard(best)
+        out = np.zeros(n, np.float32)
+        for rank, idx in enumerate(selected):
+            out[idx] = 1.0 - rank / max(n, 1)
+        return out
+
+
+def default_scorer_stack(embedder, settings) -> list[ScorerPlugin]:
+    """The reference's default plugin stack and weights 0.8/0.2/0.5
+    (retrievers/factory.py:64-80 there), with MMR λ from config."""
+    r = settings.retrieval
+    return [
+        KeywordMatchScorer(weight=r.keyword_scorer_weight),
+        RecencyScorer(weight=r.recency_scorer_weight),
+        SemanticSimilarityScorer(embedder=embedder, weight=r.mmr_scorer_weight),
+        MMRScorer(embedder=embedder, lambda_param=r.mmr_lambda, weight=r.mmr_scorer_weight),
+    ]

@@ -146,9 +146,21 @@ class Batcher(Generic[T, R]):
 
 
 def bucket_size(n: int, buckets: Sequence[int]) -> int:
-    """Smallest bucket >= n (compile once per bucket, pad to it). Falls back
-    to the largest bucket if n exceeds them all."""
+    """Smallest bucket >= n (compile once per bucket, pad to it). When n
+    exceeds every bucket the result is n itself — callers pad by
+    ``bucket - n`` and that difference must never go negative; an exact-size
+    compile is correct, just uncached."""
     for b in sorted(buckets):
         if n <= b:
             return b
-    return max(buckets)
+    return n
+
+
+def floor_bucket(n: int, buckets: Sequence[int]) -> int:
+    """Largest bucket <= n (min(buckets) if none fit) — for quantities that
+    must round DOWN, like decode step counts bounded by cache headroom."""
+    best = min(buckets)
+    for b in sorted(buckets):
+        if b <= n:
+            best = b
+    return best

@@ -1,0 +1,315 @@
+"""GeneratorEngine: the in-process LLM serving runtime.
+
+This is what replaces the reference's four HTTP process boundaries
+(SURVEY.md §3.1): the model lives on the mesh, loaded ONCE at startup
+(inverting the reference's lazy first-request graph init, chat.py:38-87
+there), and requests become device dispatches:
+
+* **prefill** — bucketed prompt lengths ([B, bucket] padded), one compiled
+  program per (batch, bucket) pair, aligned cache write at slot 0;
+* **decode** — single fused step: forward(T=1) → sample → append, with
+  per-row positions/cache offsets (ragged batches from the coalescer);
+* **stream** — the host loop yields tokens as they land, feeding SSE.
+
+Two loops are provided: a host-stepped loop (streaming, early EOS exit) and
+a fully-jitted ``lax.while_loop`` bulk loop (no host round-trips — the bench
+path). Weights are TP-sharded via parallel/sharding rules when a mesh is
+given; the KV cache shards batch-on-dp / heads-on-tp from the same mesh.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from sentio_tpu.config import GeneratorConfig, get_settings
+from sentio_tpu.models.llama import LlamaConfig
+from sentio_tpu.parallel.batcher import bucket_size
+
+
+@dataclass
+class GenerationResult:
+    text: str
+    tokens: list[int]
+    prompt_tokens: int
+    finish_reason: str  # "stop" | "length"
+    latency_ms: float = 0.0
+
+
+class GeneratorEngine:
+    PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096)
+    BATCH_BUCKETS = (1, 2, 4, 8, 16)
+
+    def __init__(
+        self,
+        config: Optional[GeneratorConfig] = None,
+        model_config: Optional[LlamaConfig] = None,
+        params=None,
+        tokenizer=None,
+        mesh=None,
+        rng_seed: int = 0,
+    ) -> None:
+        import jax
+
+        from sentio_tpu.models.llama import init_llama
+        from sentio_tpu.models.tokenizer import ByteTokenizer
+
+        self.config = config or get_settings().generator
+        self.model_config = model_config or (
+            LlamaConfig.tiny() if self.config.model_preset == "tiny" else LlamaConfig.llama3_8b()
+        )
+        self.tokenizer = tokenizer or ByteTokenizer(self.model_config.vocab_size)
+        self.mesh = mesh
+        if params is None:
+            params = init_llama(jax.random.PRNGKey(rng_seed), self.model_config)
+        if mesh is not None:
+            from sentio_tpu.parallel.sharding import LLAMA_TP_RULES, shard_params
+
+            params = shard_params(params, mesh, LLAMA_TP_RULES)
+        self.params = params
+        self._rng = jax.random.PRNGKey(rng_seed + 17)
+        self._build_fns()
+
+    # ------------------------------------------------------------- compiled fns
+
+    def _build_fns(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from sentio_tpu.models.llama import llama_forward
+        from sentio_tpu.runtime.sampling import sample_tokens
+
+        cfg = self.model_config
+
+        @jax.jit
+        def prefill(params, ids, positions, cache):
+            logits, cache = llama_forward(
+                params, cfg, ids, positions=positions, cache=cache, cache_index=0
+            )
+            return logits, cache
+
+        @partial(jax.jit, static_argnames=("top_k",))
+        def decode_step(params, tok, lens, cache, rng, temperature, top_k):
+            # tok [B,1]; lens [B] = current absolute position per row
+            logits, cache = llama_forward(
+                params, cfg, tok, positions=lens[:, None], cache=cache, cache_index=lens
+            )
+            rng, sub = jax.random.split(rng)
+            nxt = sample_tokens(logits[:, -1], sub, temperature, top_k=top_k)
+            return nxt, cache, rng
+
+        @partial(jax.jit, static_argnames=("steps", "top_k", "eos_id"))
+        def decode_loop(params, first_tok, lens, cache, rng, temperature, steps, top_k, eos_id):
+            """Bulk loop, fully on device: scan over steps with done-masking."""
+            b = first_tok.shape[0]
+
+            def body(carry, _):
+                tok, lens, cache, rng, done = carry
+                logits, cache = llama_forward(
+                    params, cfg, tok[:, None], positions=lens[:, None],
+                    cache=cache, cache_index=lens,
+                )
+                rng, sub = jax.random.split(rng)
+                nxt = sample_tokens(logits[:, -1], sub, temperature, top_k=top_k)
+                nxt = jnp.where(done, eos_id, nxt)
+                done = done | (nxt == eos_id)
+                return (nxt, lens + 1, cache, rng, done), nxt
+
+            init = (first_tok, lens, cache, rng, jnp.zeros(b, bool))
+            (_, _, cache, _, _), toks = jax.lax.scan(body, init, None, length=steps)
+            return jnp.moveaxis(toks, 0, 1), cache  # [B, steps]
+
+        self._prefill = prefill
+        self._decode_step = decode_step
+        self._decode_loop = decode_loop
+
+    # --------------------------------------------------------------- helpers
+
+    def _encode_batch(self, prompts: Sequence[str]):
+        import jax.numpy as jnp
+
+        from sentio_tpu.models.llama import init_cache
+        from sentio_tpu.models.tokenizer import batch_encode
+
+        max_prompt = min(self.config.max_prompt_tokens, self.model_config.max_len)
+        ids, mask = batch_encode(self.tokenizer, prompts, max_len=max_prompt, add_bos=True)
+        lens = mask.sum(axis=1).astype(np.int32)
+        n = len(prompts)
+        rows = bucket_size(n, self.BATCH_BUCKETS)
+        width = bucket_size(ids.shape[1], self.PREFILL_BUCKETS)
+        ids = np.pad(ids, ((0, rows - n), (0, width - ids.shape[1])),
+                     constant_values=self.tokenizer.pad_id)
+        lens = np.pad(lens, (0, rows - n), constant_values=1)
+
+        window = min(
+            self.model_config.max_len,
+            bucket_size(width + self.config.max_new_tokens, self.PREFILL_BUCKETS + (self.model_config.max_len,)),
+        )
+        cache = init_cache(self.model_config, rows, window)
+        if self.mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from sentio_tpu.parallel.mesh import AXIS_DP, AXIS_TP
+
+            spec = NamedSharding(self.mesh, P(None, AXIS_DP, None, AXIS_TP, None))
+            cache = {k: jax.device_put(v, spec) for k, v in cache.items()}
+        positions = np.broadcast_to(np.arange(width, dtype=np.int32)[None, :], ids.shape)
+        return jnp.asarray(ids), jnp.asarray(positions.copy()), jnp.asarray(lens), cache, n, window
+
+    STEP_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+    def _stable_steps(self, requested: int, headroom: int) -> int:
+        """Static scan lengths must come from a small set or every distinct
+        clamped value recompiles the whole decode loop. The config value is
+        used as-is (stable across requests); a cache-headroom clamp rounds
+        DOWN to a step bucket (finish_reason becomes 'length')."""
+        from sentio_tpu.parallel.batcher import floor_bucket
+
+        headroom = max(headroom, 1)
+        if requested <= headroom:
+            return max(requested, 1)
+        return floor_bucket(headroom, self.STEP_BUCKETS)
+
+    # ----------------------------------------------------------------- public
+
+    def generate(
+        self,
+        prompts: Sequence[str],
+        max_new_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        top_k: int = 0,
+    ) -> list[GenerationResult]:
+        """Batched bulk generation through the on-device scan loop. Batches
+        larger than the biggest batch bucket are chunked transparently."""
+        import jax
+        import jax.numpy as jnp
+
+        max_batch = max(self.BATCH_BUCKETS)
+        if len(prompts) > max_batch:
+            out: list[GenerationResult] = []
+            for start in range(0, len(prompts), max_batch):
+                out.extend(
+                    self.generate(
+                        prompts[start : start + max_batch],
+                        max_new_tokens=max_new_tokens,
+                        temperature=temperature,
+                        top_k=top_k,
+                    )
+                )
+            return out
+
+        t0 = time.perf_counter()
+        max_new = max_new_tokens or self.config.max_new_tokens
+        temp = self.config.temperature() if temperature is None else temperature
+        ids, positions, lens, cache, n, window = self._encode_batch(prompts)
+        max_new = self._stable_steps(max_new, window - int(lens.max()))
+
+        logits, cache = self._prefill(self.params, ids, positions, cache)
+        last = jnp.take_along_axis(logits, (lens - 1)[:, None, None], axis=1)[:, 0]
+        self._rng, sub = jax.random.split(self._rng)
+        from sentio_tpu.runtime.sampling import sample_tokens
+
+        first = sample_tokens(last, sub, temp, top_k=top_k)
+
+        self._rng, sub = jax.random.split(self._rng)
+        toks, _ = self._decode_loop(
+            self.params, first, jnp.asarray(lens), cache, sub,
+            jnp.asarray(temp, jnp.float32), max_new - 1, top_k, self.tokenizer.eos_id,
+        )
+        toks = np.concatenate([np.asarray(first)[:, None], np.asarray(toks)], axis=1)
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+
+        out = []
+        for i in range(n):
+            row = toks[i].tolist()
+            if self.tokenizer.eos_id in row:
+                cut = row.index(self.tokenizer.eos_id)
+                row, reason = row[:cut], "stop"
+            else:
+                reason = "length"
+            out.append(
+                GenerationResult(
+                    text=self.tokenizer.decode(row),
+                    tokens=row,
+                    prompt_tokens=int(lens[i]),
+                    finish_reason=reason,
+                    latency_ms=dt_ms,
+                )
+            )
+        return out
+
+    def stream(
+        self,
+        prompt: str,
+        max_new_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        top_k: int = 0,
+    ) -> Iterator[str]:
+        """Host-stepped decode yielding decoded text increments (SSE feed).
+        UTF-8 safe: bytes are buffered until they decode cleanly."""
+        import jax
+        import jax.numpy as jnp
+
+        max_new = max_new_tokens or self.config.max_new_tokens
+        temp = self.config.temperature() if temperature is None else temperature
+        ids, positions, lens, cache, _, window = self._encode_batch([prompt])
+        max_new = self._stable_steps(max_new, window - int(lens.max()))
+
+        logits, cache = self._prefill(self.params, ids, positions, cache)
+        last = jnp.take_along_axis(logits, (lens - 1)[:, None, None], axis=1)[:, 0]
+        from sentio_tpu.runtime.sampling import sample_tokens
+
+        self._rng, sub = jax.random.split(self._rng)
+        tok = sample_tokens(last, sub, temp, top_k=top_k)
+        lens = jnp.asarray(lens)
+        emitted: list[int] = []
+        flushed = ""
+        for _ in range(max_new):
+            t = int(tok[0])
+            if t == self.tokenizer.eos_id:
+                break
+            emitted.append(t)
+            text = self.tokenizer.decode(emitted)
+            # only flush complete (replacement-char-free) tails
+            if not text.endswith("�") and len(text) > len(flushed):
+                yield text[len(flushed):]
+                flushed = text
+            tok, cache, self._rng = self._decode_step(
+                self.params, tok[:, None], lens, cache, self._rng,
+                jnp.asarray(temp, jnp.float32), top_k,
+            )
+            lens = lens + 1
+        final = self.tokenizer.decode(emitted)
+        if len(final) > len(flushed):
+            yield final[len(flushed):]
+
+    def device_stats(self) -> dict:
+        """Health-endpoint payload: device kind, count, mesh shape."""
+        import jax
+
+        devices = jax.devices()
+        stats = {
+            "platform": devices[0].platform if devices else "none",
+            "n_devices": len(devices),
+            "mesh": dict(self.mesh.shape) if self.mesh is not None else None,
+            "model": {
+                "layers": self.model_config.n_layers,
+                "dim": self.model_config.dim,
+                "vocab": self.model_config.vocab_size,
+            },
+        }
+        try:  # HBM headroom where the backend exposes it
+            m = devices[0].memory_stats()
+            if m:
+                stats["memory"] = {
+                    "bytes_in_use": m.get("bytes_in_use"),
+                    "bytes_limit": m.get("bytes_limit"),
+                }
+        except Exception:
+            pass
+        return stats

@@ -1,0 +1,45 @@
+"""Token sampling: greedy / temperature / top-k / top-p, jit-safe.
+
+All functions operate on a [B, V] float32 logits batch and are called inside
+jitted decode steps — no data-dependent Python control flow; temperature==0
+routes through ``lax.cond``-free masking (greedy is argmax; the temperature
+path divides by max(temp, eps) and greedy is selected by a boolean).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def sample_tokens(
+    logits: Array,
+    rng: Array,
+    temperature: Array | float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> Array:
+    """[B, V] → [B] int32. ``temperature`` may be a traced scalar; 0 = greedy.
+    top_k / top_p are static (compiled into the program)."""
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.asarray(temperature, jnp.float32)
+    scaled = logits / jnp.maximum(temp, 1e-6)
+
+    if top_k and top_k > 0:
+        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p (always >= 1 tok)
+        cutoff_idx = jnp.argmax(cum >= top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], axis=-1)
+        scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+
+    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temp <= 0.0, greedy, sampled)

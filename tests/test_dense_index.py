@@ -130,3 +130,20 @@ def test_compaction_bounds_dead_rows():
     assert total_rows <= 20 * 1.5  # compaction kept the table bounded
     hits = index.search(embs[4], top_k=1)
     assert hits[0][0].id == "d4"
+
+
+def test_duplicate_ids_in_one_add_batch():
+    index = TpuDenseIndex(dim=8, dtype="float32")
+    rng = np.random.default_rng(9)
+    embs = rng.standard_normal((3, 8)).astype(np.float32)
+    index.add(
+        [Document(text="first", id="x"), Document(text="second", id="x"),
+         Document(text="other", id="y")],
+        embs,
+    )
+    assert index.size == 2  # last write wins for 'x'
+    top = index.search(embs[1], top_k=1)[0]
+    assert top[0].text == "second"
+    assert index.delete(["x"]) == 1
+    assert index.size == 1
+    assert all(d.id == "y" for d, _ in index.search(embs[2], top_k=5))

@@ -165,7 +165,12 @@ class TestBatcher:
         asyncio.run(run())
 
     def test_bucket_size(self):
+        from sentio_tpu.parallel.batcher import floor_bucket
+
         assert bucket_size(1, [2, 4, 8]) == 2
         assert bucket_size(3, [2, 4, 8]) == 4
         assert bucket_size(8, [2, 4, 8]) == 8
-        assert bucket_size(9, [2, 4, 8]) == 8  # clamps to max
+        assert bucket_size(9, [2, 4, 8]) == 9  # exact size, never smaller
+        assert floor_bucket(9, [2, 4, 8]) == 8
+        assert floor_bucket(3, [2, 4, 8]) == 2
+        assert floor_bucket(1, [2, 4, 8]) == 2  # min bucket floor

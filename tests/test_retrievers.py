@@ -1,0 +1,255 @@
+import asyncio
+
+import numpy as np
+import pytest
+
+from sentio_tpu.config import EmbedderConfig, RetrievalConfig, Settings
+from sentio_tpu.models.document import Document
+from sentio_tpu.ops.bm25 import BM25Index
+from sentio_tpu.ops.dense_index import TpuDenseIndex
+from sentio_tpu.ops.embedder import HashEmbedder
+from sentio_tpu.ops.reranker import (
+    CrossEncoderReranker,
+    PassthroughReranker,
+    Reranker,
+    get_reranker,
+)
+from sentio_tpu.ops.retrievers import (
+    DenseRetriever,
+    HybridRetriever,
+    RetrieverError,
+    SparseRetriever,
+    create_retriever,
+)
+from sentio_tpu.ops.scorers import (
+    KeywordMatchScorer,
+    MMRScorer,
+    RecencyScorer,
+    SemanticSimilarityScorer,
+)
+
+
+@pytest.fixture()
+def stack(docs):
+    emb = HashEmbedder(EmbedderConfig(provider="hash", dim=64))
+    dense = TpuDenseIndex(dim=64, dtype="float32")
+    dense.add(docs, emb.embed_many([d.text for d in docs]))
+    sparse = BM25Index().build(docs)
+    return emb, dense, sparse
+
+
+class TestLegs:
+    def test_dense_retriever(self, stack, docs):
+        emb, dense, _ = stack
+        r = DenseRetriever(embedder=emb, index=dense)
+        out = r.retrieve(docs[1].text, top_k=3)
+        assert out[0].id == "d2"  # identical text embeds identically
+
+    def test_sparse_retriever(self, stack):
+        _, _, sparse = stack
+        r = SparseRetriever(index=sparse)
+        out = r.retrieve("systolic array", top_k=3)
+        assert out and out[0].id == "d2"
+
+    def test_async_wrapper(self, stack):
+        _, _, sparse = stack
+        r = SparseRetriever(index=sparse)
+        out = asyncio.run(r.aretrieve("fox dog", top_k=2))
+        assert len(out) == 2
+
+
+class TestHybrid:
+    def test_fuses_both_legs(self, stack):
+        emb, dense, sparse = stack
+        hybrid = HybridRetriever(
+            retrievers=[DenseRetriever(emb, dense), SparseRetriever(sparse)],
+            config=RetrievalConfig(fusion_method="rrf"),
+        )
+        out = hybrid.retrieve("quick brown fox", top_k=4)
+        assert out
+        assert all("hybrid_score" in d.metadata for d in out)
+        ids = [d.id for d in out]
+        assert len(ids) == len(set(ids))  # dedup across legs
+
+    def test_failed_leg_degrades(self, stack):
+        class BrokenRetriever(DenseRetriever):
+            def retrieve(self, query, top_k=10):
+                raise RuntimeError("device gone")
+
+        emb, dense, sparse = stack
+        hybrid = HybridRetriever(
+            retrievers=[BrokenRetriever(emb, dense), SparseRetriever(sparse)],
+            config=RetrievalConfig(),
+        )
+        out = hybrid.retrieve("fox", top_k=3)
+        assert out  # sparse leg alone still answers
+
+    def test_all_legs_failed_raises(self, stack):
+        class Broken(SparseRetriever):
+            def retrieve(self, query, top_k=10):
+                raise RuntimeError("nope")
+
+        _, _, sparse = stack
+        hybrid = HybridRetriever(retrievers=[Broken(sparse)], config=RetrievalConfig())
+        with pytest.raises(RetrieverError):
+            hybrid.retrieve("q")
+
+    def test_scorer_plugins_apply(self, stack, docs):
+        emb, dense, sparse = stack
+        hybrid = HybridRetriever(
+            retrievers=[SparseRetriever(sparse)],
+            config=RetrievalConfig(),
+            scorers=[KeywordMatchScorer(weight=2.0)],
+        )
+        out = hybrid.retrieve("systolic array matrix", top_k=3)
+        assert out[0].id == "d2"
+
+    def test_broken_scorer_ignored(self, stack):
+        class BadScorer:
+            name, weight = "bad", 1.0
+
+            def score(self, query, documents):
+                raise ValueError("boom")
+
+        _, _, sparse = stack
+        hybrid = HybridRetriever(
+            retrievers=[SparseRetriever(sparse)],
+            config=RetrievalConfig(),
+            scorers=[BadScorer()],
+        )
+        assert hybrid.retrieve("fox", top_k=2)
+
+
+class TestFactory:
+    def test_strategies(self, stack):
+        emb, dense, sparse = stack
+        s = Settings()
+        s.retrieval.strategy = "dense"
+        assert isinstance(create_retriever(s, emb, dense, sparse), DenseRetriever)
+        s.retrieval.strategy = "bm25"
+        assert isinstance(create_retriever(s, emb, dense, sparse), SparseRetriever)
+        s.retrieval.strategy = "hybrid"
+        r = create_retriever(s, emb, dense, sparse)
+        assert isinstance(r, HybridRetriever) and len(r.retrievers) == 2
+
+    def test_missing_components_raise(self, stack):
+        _, _, sparse = stack
+        s = Settings()
+        s.retrieval.strategy = "dense"
+        with pytest.raises(RetrieverError):
+            create_retriever(s, None, None, sparse)
+        s.retrieval.strategy = "weird"
+        with pytest.raises(RetrieverError):
+            create_retriever(s, None, None, sparse)
+
+
+class TestScorers:
+    def test_keyword_overlap(self, docs):
+        s = KeywordMatchScorer()
+        scores = s.score("quick brown fox", docs)
+        assert scores[0] == 1.0  # d1 contains all three
+        assert scores[1] == 0.0  # d2 contains none
+
+    def test_recency_decay(self):
+        import time
+
+        now = time.time()
+        docs = [
+            Document(text="new", metadata={"timestamp": now}),
+            Document(text="old", metadata={"timestamp": now - 365 * 86400}),
+            Document(text="unknown"),
+        ]
+        s = RecencyScorer(half_life_days=30)
+        scores = s.score("q", docs)
+        assert scores[0] > 0.99
+        assert scores[1] < 0.01
+        assert scores[2] == 0.5
+
+    def test_semantic_uses_one_batch(self, docs):
+        calls = []
+
+        class CountingEmbedder(HashEmbedder):
+            def embed_many(self, texts):
+                calls.append(len(texts))
+                return super().embed_many(texts)
+
+        emb = CountingEmbedder(EmbedderConfig(provider="hash", dim=64))
+        s = SemanticSimilarityScorer(embedder=emb)
+        scores = s.score("the quick brown fox", docs)
+        assert calls == [len(docs) + 1]  # one batched call, not N+1
+        assert scores.shape == (len(docs),)
+        assert (scores >= 0).all() and (scores <= 1).all()
+
+    def test_mmr_penalizes_duplicates(self):
+        emb = HashEmbedder(EmbedderConfig(provider="hash", dim=128))
+        docs = [
+            Document(text="the quick brown fox jumps over dogs", id="dup1"),
+            Document(text="the quick brown fox jumps over dogs", id="dup2"),
+            Document(text="the quick brown turtle swims in rivers", id="other"),
+        ]
+        s = MMRScorer(embedder=emb, lambda_param=0.5)
+        scores = s.score("the quick brown fox", docs)
+        # a duplicate wins on relevance, but its twin (redundancy 1.0) is
+        # pushed below the diverse doc by a clear margin
+        assert scores[2] > min(scores[0], scores[1])
+
+    def test_hash_embedder_cross_process_deterministic(self):
+        import subprocess
+        import sys
+
+        code = (
+            "import sys; sys.path.insert(0, '/root/repo');"
+            "from sentio_tpu.config import EmbedderConfig;"
+            "from sentio_tpu.ops.embedder import HashEmbedder;"
+            "v = HashEmbedder(EmbedderConfig(provider='hash', dim=16)).embed('a b c');"
+            "print(','.join(f'{x:.8f}' for x in v))"
+        )
+        outs = {
+            subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True,
+                env={"PYTHONHASHSEED": seed, "PATH": "/usr/bin:/bin",
+                     "JAX_PLATFORMS": "cpu"},
+            ).stdout.strip()
+            for seed in ("0", "1", "31337")
+        }
+        assert len(outs) == 1 and "" not in outs
+
+
+class TestRerankers:
+    def test_passthrough_keeps_order(self, docs):
+        r = PassthroughReranker()
+        result = r.rerank("q", docs[:4], top_k=3)
+        assert [d.id for d in result.documents] == [d.id for d in docs[:3]]
+        assert not result.fallback_used
+
+    def test_cross_encoder_scores_and_orders(self, docs):
+        r = CrossEncoderReranker()
+        result = r.rerank("systolic array", docs[:5], top_k=3)
+        assert len(result.documents) == 3
+        assert result.scores == sorted(result.scores, reverse=True)
+        assert all("rerank_score" in d.metadata for d in result.documents)
+
+    def test_failure_falls_back_to_original_order(self, docs):
+        class BrokenReranker(Reranker):
+            name = "broken"
+
+            def _score(self, query, documents):
+                raise RuntimeError("device OOM")
+
+        result = BrokenReranker().rerank("q", docs[:4], top_k=4)
+        assert result.fallback_used
+        assert [d.id for d in result.documents] == [d.id for d in docs[:4]]
+        np.testing.assert_allclose(result.scores, [1.0, 0.9, 0.8, 0.7])
+
+    def test_empty_docs(self):
+        assert PassthroughReranker().rerank("q", []).documents == []
+
+    def test_registry(self):
+        assert isinstance(get_reranker("passthrough"), PassthroughReranker)
+        with pytest.raises(ValueError):
+            get_reranker("bogus")
+
+    def test_async(self, docs):
+        result = asyncio.run(PassthroughReranker().arerank("q", docs[:2]))
+        assert len(result.documents) == 2
