@@ -1,0 +1,177 @@
+"""End-to-end RAG serving benchmark — runs on whatever jax.devices() offers
+(the driver runs it on one real TPU chip; CPU works for smoke tests).
+
+Measures p50 end-to-end latency of the full retrieve → rerank → select →
+generate → verify pipeline with EVERY model in-process on the device: the
+bi-encoder embeds the query, the exact dense index matmuls over an in-HBM
+corpus, BM25 scores host-side concurrently, the cross-encoder reranks, and
+the decoder generates + self-audits. This is the pipeline the reference
+serves over four remote HTTP hops (SURVEY.md §3.1).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is the speedup vs the only latency figure the reference
+ships — its 2000 ms p95 alerting target (deploy/kubernetes/monitoring.yaml
+there); >1.0 means faster. Details go to stderr.
+
+Env knobs: BENCH_FAST=1 (tiny models, quick smoke), BENCH_QUERIES=N,
+BENCH_CORPUS=N, BENCH_NEW_TOKENS=N.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REFERENCE_P95_TARGET_MS = 2000.0
+
+
+def log(*args) -> None:
+    print(*args, file=sys.stderr, flush=True)
+
+
+def build_corpus(n: int) -> list:
+    from sentio_tpu.models.document import Document
+
+    topics = [
+        ("tpu", "TPU v5e chips pair a 128x128 MXU systolic array with {i} MiB of VMEM; "
+                "matmul throughput peaks in bfloat16 when tiles stay MXU-aligned."),
+        ("jax", "JAX traces pure functions into XLA programs; version {i} introduced "
+                "sharding improvements for pjit and shard_map collectives."),
+        ("rag", "Retrieval augmented generation pipeline number {i} fuses BM25 with "
+                "dense retrieval and reranks candidates before generation."),
+        ("ir", "Classic information retrieval experiment {i} shows BM25 term "
+               "saturation controlled by k1 and length normalization by b."),
+        ("net", "Inter-chip interconnect study {i}: ring all-reduce bandwidth scales "
+                "with torus links while DCN hops dominate cross-slice latency."),
+    ]
+    docs = []
+    for i in range(n):
+        key, template = topics[i % len(topics)]
+        docs.append(
+            Document(
+                text=template.replace("{i}", str(i)),
+                id=f"{key}-{i}",
+                metadata={"source": f"{key}.md"},
+            )
+        )
+    return docs
+
+
+def main() -> None:
+    t_start = time.perf_counter()
+    fast = os.environ.get("BENCH_FAST") == "1"
+    n_queries = int(os.environ.get("BENCH_QUERIES", "12" if not fast else "4"))
+    n_corpus = int(os.environ.get("BENCH_CORPUS", "2048" if not fast else "64"))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "48" if not fast else "8"))
+
+    import jax
+
+    from sentio_tpu.config import EmbedderConfig, GeneratorConfig, RerankConfig, Settings
+    from sentio_tpu.graph.factory import GraphConfig, build_basic_graph
+    from sentio_tpu.graph.state import create_initial_state
+    from sentio_tpu.models.llama import LlamaConfig
+    from sentio_tpu.models.transformer import EncoderConfig
+    from sentio_tpu.ops.bm25 import BM25Index
+    from sentio_tpu.ops.dense_index import TpuDenseIndex
+    from sentio_tpu.ops.embedder import TpuEmbedder
+    from sentio_tpu.ops.generator import LLMGenerator, TpuProvider
+    from sentio_tpu.ops.reranker import CrossEncoderReranker
+    from sentio_tpu.ops.retrievers import DenseRetriever, HybridRetriever, SparseRetriever
+    from sentio_tpu.ops.verifier import AnswerVerifier
+    from sentio_tpu.runtime.engine import GeneratorEngine
+
+    devices = jax.devices()
+    log(f"devices: {len(devices)} x {devices[0].platform} ({devices[0].device_kind})")
+
+    if fast:
+        enc_cfg = EncoderConfig.tiny()
+        llm_cfg = LlamaConfig.tiny()
+    else:
+        # MXU-friendly mini models: dims multiples of 128, bf16
+        enc_cfg = EncoderConfig(
+            vocab_size=512, dim=512, n_layers=8, n_heads=8, mlp_dim=2048, max_len=512
+        )
+        llm_cfg = LlamaConfig(
+            vocab_size=512, dim=512, n_layers=12, n_heads=8, n_kv_heads=4,
+            mlp_dim=1536, max_len=2048, rope_theta=500_000.0,
+        )
+
+    settings = Settings()
+    settings.generator.max_new_tokens = new_tokens
+    settings.generator.context_token_budget = 1200
+
+    log("building corpus + indexes ...")
+    docs = build_corpus(n_corpus)
+    embedder = TpuEmbedder(
+        EmbedderConfig(provider="tpu", batch_size=128), model_config=enc_cfg
+    )
+    t0 = time.perf_counter()
+    corpus_vecs = embedder.embed_many([d.text for d in docs])
+    embed_s = time.perf_counter() - t0
+    log(f"embedded {n_corpus} docs in {embed_s:.1f}s "
+        f"({n_corpus / max(embed_s, 1e-9):.0f} docs/s)")
+
+    dense_index = TpuDenseIndex(dim=enc_cfg.dim)
+    dense_index.add(docs, corpus_vecs)
+    bm25 = BM25Index().build(docs)
+
+    retriever = HybridRetriever(
+        retrievers=[DenseRetriever(embedder, dense_index), SparseRetriever(bm25)],
+        config=settings.retrieval,
+    )
+    reranker = CrossEncoderReranker(
+        RerankConfig(batch_size=32), model_config=enc_cfg
+    )
+    engine = GeneratorEngine(
+        config=GeneratorConfig(model_preset="bench", max_new_tokens=new_tokens),
+        model_config=llm_cfg,
+    )
+    generator = LLMGenerator(provider=TpuProvider(engine=engine), config=settings.generator)
+    verifier = AnswerVerifier(generator=generator, config=settings.generator)
+
+    graph = build_basic_graph(
+        retriever, generator, reranker=reranker, verifier=verifier,
+        config=GraphConfig(settings=settings),
+    )
+
+    queries = [
+        "What does the MXU systolic array do in bfloat16?",
+        "How does JAX compile functions with XLA sharding?",
+        "Explain BM25 term saturation and length normalization.",
+        "How does ring all-reduce bandwidth scale across ICI?",
+        "What fuses sparse and dense retrieval before generation?",
+    ]
+
+    log("warmup (compilation) ...")
+    t0 = time.perf_counter()
+    graph.invoke(create_initial_state(queries[0], metadata={"mode": "fast"}))
+    log(f"warmup done in {time.perf_counter() - t0:.1f}s")
+
+    latencies = []
+    for i in range(n_queries):
+        q = queries[i % len(queries)]
+        t0 = time.perf_counter()
+        state = graph.invoke(create_initial_state(q, metadata={"mode": "fast"}))
+        dt = (time.perf_counter() - t0) * 1000.0
+        latencies.append(dt)
+        log(f"  q{i}: {dt:.0f} ms  path={state['metadata']['graph_path']}")
+
+    latencies.sort()
+    p50 = latencies[len(latencies) // 2]
+    p95 = latencies[min(int(len(latencies) * 0.95), len(latencies) - 1)]
+    total_s = time.perf_counter() - t_start
+    log(f"p50={p50:.0f}ms p95={p95:.0f}ms over {n_queries} queries; "
+        f"bench wall {total_s:.0f}s")
+
+    print(json.dumps({
+        "metric": "rag_chat_e2e_p50_latency",
+        "value": round(p50, 1),
+        "unit": "ms",
+        "vs_baseline": round(REFERENCE_P95_TARGET_MS / p50, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

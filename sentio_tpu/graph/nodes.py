@@ -12,6 +12,7 @@ the reference's "every stage degrades, nothing 500s" ladder.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import time
 from typing import Any, Optional
@@ -120,16 +121,21 @@ def create_document_selector_node(settings: Optional[Settings] = None):
 def create_generator_node(generator, settings: Optional[Settings] = None):
     settings = settings or get_settings()
 
-    def generate_node(state: RAGState) -> dict[str, Any]:
+    async def generate_node(state: RAGState) -> dict[str, Any]:
         docs = best_documents(state)
         meta = state.get("metadata", {})
         mode = meta.get("mode") or settings.generator.mode
         temperature = meta.get("temperature")
         t0 = time.perf_counter()
         try:
-            answer = generator.generate(
-                state["query"], docs, mode=mode,
-                temperature=temperature if temperature is None else float(temperature),
+            # device generation is the longest stage — keep it off the event
+            # loop so concurrent requests, streams, and health checks proceed
+            answer = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: generator.generate(
+                    state["query"], docs, mode=mode,
+                    temperature=temperature if temperature is None else float(temperature),
+                ),
             )
         except Exception as exc:  # noqa: BLE001
             logger.exception("generation failed")
@@ -149,13 +155,15 @@ def create_generator_node(generator, settings: Optional[Settings] = None):
 def create_verifier_node(verifier, settings: Optional[Settings] = None):
     settings = settings or get_settings()
 
-    def verify_node(state: RAGState) -> dict[str, Any]:
+    async def verify_node(state: RAGState) -> dict[str, Any]:
         answer = state.get("response", "")
         if not answer:
             return {"evaluation": {"verdict": "warn", "notes": ["empty answer"]}}
         docs = best_documents(state)
         t0 = time.perf_counter()
-        result = verifier.verify(state["query"], answer, docs)
+        result = await asyncio.get_running_loop().run_in_executor(
+            None, verifier.verify, state["query"], answer, docs
+        )
         update: dict[str, Any] = {
             "evaluation": result.to_dict(),
             "metadata": {

@@ -1,0 +1,288 @@
+"""Cache tiers: LRU+TTL memory cache, pluggable L2, typed manager, strategies.
+
+Parity with /root/reference/src/core/caching/ (memory_cache.py:36-360,
+cache_manager.py:25-381, strategies.py:16-343): an in-process LRU+TTL cache
+with pattern clear and stats, a manager with MEMORY / MULTI_TIER backends
+(L2 is a pluggable async interface — redis isn't in this image, so the slot
+ships with a null implementation and degrades to memory exactly like the
+reference degrades when redis is down), typed embedding/query helpers, and
+pluggable should-cache/TTL strategies including the adaptive hit-rate one.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Optional, Protocol
+
+from sentio_tpu.config import CacheConfig, get_settings
+
+
+class MemoryCache:
+    """Thread-safe LRU with per-entry TTL."""
+
+    def __init__(self, max_entries: int = 10_000, default_ttl_s: float = 3600.0) -> None:
+        self.max_entries = max_entries
+        self.default_ttl_s = default_ttl_s
+        self._store: OrderedDict[str, tuple[Any, float, float]] = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key: str) -> Optional[Any]:
+        with self._lock:
+            entry = self._store.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            value, stored_at, ttl = entry
+            if ttl > 0 and time.time() - stored_at > ttl:
+                del self._store[key]
+                self.misses += 1
+                return None
+            self._store.move_to_end(key)
+            self.hits += 1
+            return value
+
+    def set(self, key: str, value: Any, ttl_s: Optional[float] = None) -> None:
+        with self._lock:
+            ttl = self.default_ttl_s if ttl_s is None else ttl_s
+            if key in self._store:
+                self._store.move_to_end(key)
+            self._store[key] = (value, time.time(), ttl)
+            while len(self._store) > self.max_entries:
+                self._store.popitem(last=False)
+                self.evictions += 1
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            return self._store.pop(key, None) is not None
+
+    def clear(self, pattern: str = "*") -> int:
+        with self._lock:
+            if pattern == "*":
+                n = len(self._store)
+                self._store.clear()
+                return n
+            doomed = [k for k in self._store if fnmatch.fnmatch(k, pattern)]
+            for k in doomed:
+                del self._store[k]
+            return len(doomed)
+
+    def cleanup_expired(self) -> int:
+        now = time.time()
+        with self._lock:
+            doomed = [
+                k for k, (_, at, ttl) in self._store.items() if ttl > 0 and now - at > ttl
+            ]
+            for k in doomed:
+                del self._store[k]
+            return len(doomed)
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "entries": len(self._store),
+                "max_entries": self.max_entries,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "hit_rate": round(self.hits / total, 4) if total else 0.0,
+            }
+
+
+class L2Cache(Protocol):
+    """Async second tier (redis-shaped). Implementations must never raise
+    into the caller — the manager treats any exception as a miss."""
+
+    async def get(self, key: str) -> Optional[Any]: ...
+    async def set(self, key: str, value: Any, ttl_s: float) -> None: ...
+    async def delete(self, key: str) -> None: ...
+    async def ping(self) -> bool: ...
+
+
+class NullL2Cache:
+    """The no-redis placeholder: always a miss, always healthy=False."""
+
+    async def get(self, key: str) -> Optional[Any]:
+        return None
+
+    async def set(self, key: str, value: Any, ttl_s: float) -> None:
+        return None
+
+    async def delete(self, key: str) -> None:
+        return None
+
+    async def ping(self) -> bool:
+        return False
+
+
+# --------------------------------------------------------------------- strategies
+
+
+class CacheStrategy(Protocol):
+    def should_cache(self, key: str, value: Any) -> bool: ...
+    def ttl_for(self, key: str, value: Any) -> float: ...
+
+
+@dataclass
+class TTLStrategy:
+    ttl_s: float = 3600.0
+
+    def should_cache(self, key: str, value: Any) -> bool:
+        return value is not None
+
+    def ttl_for(self, key: str, value: Any) -> float:
+        return self.ttl_s
+
+
+@dataclass
+class SizeAwareStrategy:
+    """Skip caching oversized values (size estimated via repr length)."""
+
+    max_bytes: int = 256 * 1024
+    ttl_s: float = 3600.0
+
+    def should_cache(self, key: str, value: Any) -> bool:
+        if value is None:
+            return False
+        try:
+            return len(repr(value)) <= self.max_bytes
+        except Exception:
+            return False
+
+    def ttl_for(self, key: str, value: Any) -> float:
+        return self.ttl_s
+
+
+class AdaptiveStrategy:
+    """Learns per-prefix hit rates and extends TTL for hot prefixes,
+    shrinks it for cold ones (reference strategies.py adaptive variant)."""
+
+    def __init__(self, base_ttl_s: float = 3600.0) -> None:
+        self.base_ttl_s = base_ttl_s
+        self._hits: dict[str, int] = {}
+        self._misses: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _prefix(key: str) -> str:
+        return key.split(":", 1)[0]
+
+    def record(self, key: str, hit: bool) -> None:
+        p = self._prefix(key)
+        with self._lock:
+            table = self._hits if hit else self._misses
+            table[p] = table.get(p, 0) + 1
+
+    def hit_rate(self, key: str) -> float:
+        p = self._prefix(key)
+        with self._lock:
+            h, m = self._hits.get(p, 0), self._misses.get(p, 0)
+        return h / (h + m) if h + m else 0.5
+
+    def should_cache(self, key: str, value: Any) -> bool:
+        return value is not None
+
+    def ttl_for(self, key: str, value: Any) -> float:
+        rate = self.hit_rate(key)
+        return self.base_ttl_s * (0.25 + 1.5 * rate)
+
+
+# ----------------------------------------------------------------------- manager
+
+
+class CacheManager:
+    """MEMORY or MULTI_TIER (L1 memory + async L2 with promotion on L2 hit).
+    L2 failure degrades silently to memory-only, like the reference's
+    redis-down path (cache_manager.py:77-84 there)."""
+
+    def __init__(
+        self,
+        config: Optional[CacheConfig] = None,
+        l2: Optional[L2Cache] = None,
+        strategy: Optional[CacheStrategy] = None,
+    ) -> None:
+        self.config = config or get_settings().cache
+        self.l1 = MemoryCache(self.config.max_entries, self.config.default_ttl_s)
+        self.l2: L2Cache = l2 or NullL2Cache()
+        self.strategy: CacheStrategy = strategy or TTLStrategy(self.config.default_ttl_s)
+        self.enabled = self.config.backend != "off"
+        self.multi_tier = self.config.backend == "multi_tier"
+
+    # sync L1 surface
+    def get(self, key: str) -> Optional[Any]:
+        if not self.enabled:
+            return None
+        value = self.l1.get(key)
+        if isinstance(self.strategy, AdaptiveStrategy):
+            self.strategy.record(key, hit=value is not None)
+        return value
+
+    def set(self, key: str, value: Any, ttl_s: Optional[float] = None) -> None:
+        if not self.enabled or not self.strategy.should_cache(key, value):
+            return
+        self.l1.set(key, value, ttl_s if ttl_s is not None else self.strategy.ttl_for(key, value))
+
+    # async surface adds the L2 tier
+    async def aget(self, key: str) -> Optional[Any]:
+        value = self.get(key)
+        if value is not None or not self.multi_tier:
+            return value
+        try:
+            value = await self.l2.get(key)
+        except Exception:
+            return None
+        if value is not None:  # promote
+            self.l1.set(key, value)
+        return value
+
+    async def aset(self, key: str, value: Any, ttl_s: Optional[float] = None) -> None:
+        self.set(key, value, ttl_s)
+        if self.multi_tier and self.strategy.should_cache(key, value):
+            try:
+                await self.l2.set(
+                    key, value, ttl_s if ttl_s is not None else self.strategy.ttl_for(key, value)
+                )
+            except Exception:
+                pass
+
+    # typed helpers (reference cache_manager.py:296-341)
+    def get_query_response(self, query: str) -> Optional[dict]:
+        return self.get(f"query:{query.strip().lower()}")
+
+    def set_query_response(self, query: str, response: dict) -> None:
+        self.set(f"query:{query.strip().lower()}", response, self.config.query_cache_ttl_s)
+
+    def get_embedding(self, text_hash: str) -> Optional[Any]:
+        return self.get(f"emb:{text_hash}")
+
+    def set_embedding(self, text_hash: str, vec: Any) -> None:
+        self.set(f"emb:{text_hash}", vec)
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "backend": self.config.backend,
+            "l1": self.l1.stats(),
+            "multi_tier": self.multi_tier,
+        }
+
+
+_manager: Optional[CacheManager] = None
+
+
+def get_cache_manager() -> CacheManager:
+    global _manager
+    if _manager is None:
+        _manager = CacheManager()
+    return _manager
+
+
+def set_cache_manager(manager: Optional[CacheManager]) -> None:
+    global _manager
+    _manager = manager

@@ -1,0 +1,168 @@
+"""Typed exception hierarchy with wire-format serialization.
+
+Parity with /root/reference/src/utils/exceptions.py:21-419: an ``ErrorCode``
+enum, a base exception carrying code/status/details with ``to_dict``, typed
+subclasses per failure domain, and a central handler that turns any exception
+into a consistent JSON error body (framework-agnostic here — the serve layer
+maps it onto aiohttp responses).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from enum import Enum
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class ErrorCode(str, Enum):
+    # auth
+    UNAUTHORIZED = "UNAUTHORIZED"
+    FORBIDDEN = "FORBIDDEN"
+    TOKEN_EXPIRED = "TOKEN_EXPIRED"
+    ACCOUNT_LOCKED = "ACCOUNT_LOCKED"
+    # validation
+    VALIDATION_ERROR = "VALIDATION_ERROR"
+    INVALID_INPUT = "INVALID_INPUT"
+    PAYLOAD_TOO_LARGE = "PAYLOAD_TOO_LARGE"
+    # rate limiting
+    RATE_LIMITED = "RATE_LIMITED"
+    # resources
+    NOT_FOUND = "NOT_FOUND"
+    ALREADY_EXISTS = "ALREADY_EXISTS"
+    # services
+    SERVICE_UNAVAILABLE = "SERVICE_UNAVAILABLE"
+    CIRCUIT_OPEN = "CIRCUIT_OPEN"
+    TIMEOUT = "TIMEOUT"
+    # processing
+    RETRIEVAL_FAILED = "RETRIEVAL_FAILED"
+    EMBEDDING_FAILED = "EMBEDDING_FAILED"
+    RERANK_FAILED = "RERANK_FAILED"
+    GENERATION_FAILED = "GENERATION_FAILED"
+    INGEST_FAILED = "INGEST_FAILED"
+    # device / runtime
+    DEVICE_ERROR = "DEVICE_ERROR"
+    DEVICE_OOM = "DEVICE_OOM"
+    COMPILATION_FAILED = "COMPILATION_FAILED"
+    # system
+    INTERNAL_ERROR = "INTERNAL_ERROR"
+    NOT_IMPLEMENTED = "NOT_IMPLEMENTED"
+
+
+_DEFAULT_STATUS = {
+    ErrorCode.UNAUTHORIZED: 401,
+    ErrorCode.TOKEN_EXPIRED: 401,
+    ErrorCode.FORBIDDEN: 403,
+    ErrorCode.ACCOUNT_LOCKED: 423,
+    ErrorCode.VALIDATION_ERROR: 422,
+    ErrorCode.INVALID_INPUT: 400,
+    ErrorCode.PAYLOAD_TOO_LARGE: 413,
+    ErrorCode.RATE_LIMITED: 429,
+    ErrorCode.NOT_FOUND: 404,
+    ErrorCode.ALREADY_EXISTS: 409,
+    ErrorCode.SERVICE_UNAVAILABLE: 503,
+    ErrorCode.CIRCUIT_OPEN: 503,
+    ErrorCode.TIMEOUT: 504,
+    ErrorCode.DEVICE_OOM: 503,
+}
+
+
+class SentioError(Exception):
+    """Base error: code + http status + safe-to-serialize details."""
+
+    code: ErrorCode = ErrorCode.INTERNAL_ERROR
+
+    def __init__(
+        self,
+        message: str,
+        code: Optional[ErrorCode] = None,
+        status: Optional[int] = None,
+        details: Optional[dict[str, Any]] = None,
+        retryable: bool = False,
+    ) -> None:
+        super().__init__(message)
+        self.message = message
+        if code is not None:
+            self.code = code
+        self.status = status or _DEFAULT_STATUS.get(self.code, 500)
+        self.details = details or {}
+        self.retryable = retryable
+        self.error_id = str(uuid.uuid4())
+        self.timestamp = time.time()
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "error": {
+                "code": self.code.value,
+                "message": self.message,
+                "error_id": self.error_id,
+                "retryable": self.retryable,
+                "details": self.details,
+            }
+        }
+
+
+class AuthError(SentioError):
+    code = ErrorCode.UNAUTHORIZED
+
+
+class ForbiddenError(SentioError):
+    code = ErrorCode.FORBIDDEN
+
+
+class ValidationError(SentioError):
+    code = ErrorCode.VALIDATION_ERROR
+
+
+class RateLimitError(SentioError):
+    code = ErrorCode.RATE_LIMITED
+
+    def __init__(self, message: str = "rate limit exceeded", retry_after_s: float = 60.0, **kw):
+        super().__init__(message, **kw)
+        self.details.setdefault("retry_after_s", retry_after_s)
+
+
+class NotFoundError(SentioError):
+    code = ErrorCode.NOT_FOUND
+
+
+class ServiceUnavailableError(SentioError):
+    code = ErrorCode.SERVICE_UNAVAILABLE
+
+    def __init__(self, message: str, **kw):
+        kw.setdefault("retryable", True)
+        super().__init__(message, **kw)
+
+
+class CircuitOpenError(ServiceUnavailableError):
+    code = ErrorCode.CIRCUIT_OPEN
+
+
+class TimeoutError_(SentioError):
+    code = ErrorCode.TIMEOUT
+
+
+class ProcessingError(SentioError):
+    code = ErrorCode.GENERATION_FAILED
+
+
+class DeviceError(SentioError):
+    code = ErrorCode.DEVICE_ERROR
+
+
+class ErrorHandler:
+    """Central exception → (status, json body) mapping; unknown exceptions
+    become opaque 500s (internals never leak to clients)."""
+
+    @staticmethod
+    def handle(exc: Exception) -> tuple[int, dict[str, Any]]:
+        if isinstance(exc, SentioError):
+            if exc.status >= 500:
+                logger.error("server error %s: %s", exc.code.value, exc.message)
+            return exc.status, exc.to_dict()
+        logger.exception("unhandled exception")
+        wrapped = SentioError("internal server error")
+        return 500, wrapped.to_dict()

@@ -1,0 +1,224 @@
+"""Metrics: Prometheus counters/histograms/gauges with in-memory fallback,
+plus TPU device gauges the reference never needed.
+
+Parity with /root/reference/src/observability/metrics.py:46-514 — request/
+embedding/retrieval/LLM/system/breaker dimensions, context-manager tracking
+helpers, text-or-JSON export — extended with device telemetry: HBM bytes in
+use, batch occupancy, generated tokens/s (SURVEY.md §2.10 build column).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Optional
+
+try:
+    from prometheus_client import (
+        CollectorRegistry,
+        Counter,
+        Gauge,
+        Histogram,
+        generate_latest,
+    )
+
+    PROMETHEUS_AVAILABLE = True
+except ImportError:  # pragma: no cover - prometheus is in the image
+    PROMETHEUS_AVAILABLE = False
+
+
+class InMemoryMetrics:
+    """Fallback store mirroring the counter/histogram API shape."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counters: dict[str, float] = {}
+        self.histograms: dict[str, list[float]] = {}
+        self.gauges: dict[str, float] = {}
+
+    def inc(self, name: str, labels: tuple = (), value: float = 1.0) -> None:
+        key = f"{name}{labels}"
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0.0) + value
+
+    def observe(self, name: str, labels: tuple, value: float) -> None:
+        key = f"{name}{labels}"
+        with self._lock:
+            self.histograms.setdefault(key, []).append(value)
+            if len(self.histograms[key]) > 1000:
+                self.histograms[key] = self.histograms[key][-1000:]
+
+    def set_gauge(self, name: str, labels: tuple, value: float) -> None:
+        with self._lock:
+            self.gauges[f"{name}{labels}"] = value
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            histos = {
+                k: {
+                    "count": len(v),
+                    "p50": sorted(v)[len(v) // 2] if v else 0.0,
+                    "mean": sum(v) / len(v) if v else 0.0,
+                }
+                for k, v in self.histograms.items()
+            }
+            return {"counters": dict(self.counters), "histograms": histos, "gauges": dict(self.gauges)}
+
+
+class MetricsCollector:
+    """One instance per process. With prometheus_client present, metrics
+    register in an isolated registry (no default-registry collisions in
+    tests); the in-memory store is always maintained for JSON export."""
+
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = enabled
+        self.memory = InMemoryMetrics()
+        self.registry = None
+        self._prom: dict[str, Any] = {}
+        if PROMETHEUS_AVAILABLE and enabled:
+            self.registry = CollectorRegistry()
+            self._build_prom()
+
+    def _build_prom(self) -> None:
+        r = self.registry
+        self._prom = {
+            "requests": Counter(
+                "sentio_requests_total", "HTTP requests", ["endpoint", "status"], registry=r
+            ),
+            "request_latency": Histogram(
+                "sentio_request_latency_seconds", "request latency", ["endpoint"],
+                buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2, 5, 10), registry=r,
+            ),
+            "embeddings": Counter(
+                "sentio_embeddings_total", "texts embedded", ["provider"], registry=r
+            ),
+            "retrieval_latency": Histogram(
+                "sentio_retrieval_latency_seconds", "retrieval latency", ["strategy"], registry=r
+            ),
+            "llm_tokens": Counter(
+                "sentio_llm_tokens_total", "tokens generated", ["kind"], registry=r
+            ),
+            "llm_latency": Histogram(
+                "sentio_llm_latency_seconds", "LLM call latency", ["op"], registry=r
+            ),
+            "breaker_state": Gauge(
+                "sentio_circuit_breaker_state", "0 closed / 1 half-open / 2 open",
+                ["name"], registry=r,
+            ),
+            # TPU device dimension
+            "hbm_bytes": Gauge(
+                "sentio_tpu_hbm_bytes_in_use", "device memory in use", ["device"], registry=r
+            ),
+            "batch_occupancy": Histogram(
+                "sentio_tpu_batch_occupancy", "coalesced batch fill fraction", ["batcher"],
+                buckets=(0.125, 0.25, 0.5, 0.75, 1.0), registry=r,
+            ),
+            "tokens_per_s": Gauge(
+                "sentio_tpu_decode_tokens_per_second", "decode throughput", [], registry=r
+            ),
+        }
+
+    # ------------------------------------------------------------- recording
+
+    def record_request(self, endpoint: str, status: int, latency_s: float) -> None:
+        if not self.enabled:
+            return
+        self.memory.inc("requests", (endpoint, str(status)))
+        self.memory.observe("request_latency", (endpoint,), latency_s)
+        if self._prom:
+            self._prom["requests"].labels(endpoint, str(status)).inc()
+            self._prom["request_latency"].labels(endpoint).observe(latency_s)
+
+    def record_embeddings(self, provider: str, n_texts: int) -> None:
+        if not self.enabled:
+            return
+        self.memory.inc("embeddings", (provider,), n_texts)
+        if self._prom:
+            self._prom["embeddings"].labels(provider).inc(n_texts)
+
+    def record_retrieval(self, strategy: str, latency_s: float) -> None:
+        if not self.enabled:
+            return
+        self.memory.observe("retrieval_latency", (strategy,), latency_s)
+        if self._prom:
+            self._prom["retrieval_latency"].labels(strategy).observe(latency_s)
+
+    def record_llm(self, op: str, latency_s: float, tokens: int = 0) -> None:
+        if not self.enabled:
+            return
+        self.memory.observe("llm_latency", (op,), latency_s)
+        if tokens:
+            self.memory.inc("llm_tokens", (op,), tokens)
+            if latency_s > 0:
+                self.memory.set_gauge("tokens_per_s", (), tokens / latency_s)
+        if self._prom:
+            self._prom["llm_latency"].labels(op).observe(latency_s)
+            if tokens:
+                self._prom["llm_tokens"].labels(op).inc(tokens)
+                if latency_s > 0:
+                    self._prom["tokens_per_s"].set(tokens / latency_s)
+
+    def record_breaker(self, name: str, state: str) -> None:
+        value = {"closed": 0.0, "half_open": 1.0, "open": 2.0}.get(state, 0.0)
+        self.memory.set_gauge("breaker_state", (name,), value)
+        if self._prom:
+            self._prom["breaker_state"].labels(name).set(value)
+
+    def record_batch_occupancy(self, batcher: str, occupancy: float) -> None:
+        self.memory.observe("batch_occupancy", (batcher,), occupancy)
+        if self._prom:
+            self._prom["batch_occupancy"].labels(batcher).observe(occupancy)
+
+    def collect_device_memory(self) -> None:
+        """Poll jax device memory stats into the HBM gauge (best effort)."""
+        try:
+            import jax
+
+            for dev in jax.devices():
+                stats = dev.memory_stats()
+                if stats and "bytes_in_use" in stats:
+                    self.memory.set_gauge("hbm_bytes", (str(dev.id),), stats["bytes_in_use"])
+                    if self._prom:
+                        self._prom["hbm_bytes"].labels(str(dev.id)).set(stats["bytes_in_use"])
+        except Exception:
+            pass
+
+    # --------------------------------------------------------------- helpers
+
+    @contextmanager
+    def track_request(self, endpoint: str):
+        t0 = time.perf_counter()
+        status = 200
+        try:
+            yield
+        except Exception:
+            status = 500
+            raise
+        finally:
+            self.record_request(endpoint, status, time.perf_counter() - t0)
+
+    # ---------------------------------------------------------------- export
+
+    def export_prometheus(self) -> bytes:
+        if self.registry is not None:
+            return generate_latest(self.registry)
+        return b""
+
+    def export_json(self) -> dict[str, Any]:
+        return self.memory.snapshot()
+
+
+_collector: Optional[MetricsCollector] = None
+
+
+def get_metrics() -> MetricsCollector:
+    global _collector
+    if _collector is None:
+        _collector = MetricsCollector()
+    return _collector
+
+
+def set_metrics(collector: Optional[MetricsCollector]) -> None:
+    global _collector
+    _collector = collector

@@ -1,0 +1,165 @@
+"""Performance/resource monitoring: bounded metric history, threshold alerts,
+trend analysis, health verdicts.
+
+Parity with /root/reference/src/observability/monitoring.py:38-341: a
+``PerformanceMonitor`` with deque-bounded per-metric history and alert
+callbacks, system collection (psutil when present), and a
+``ResourceMonitor`` layering default thresholds, linear-regression trend
+analysis, and a health verdict with recommendations. Adds a TPU device
+collector (HBM occupancy via jax memory_stats).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+try:
+    import psutil
+
+    PSUTIL_AVAILABLE = True
+except ImportError:  # pragma: no cover
+    PSUTIL_AVAILABLE = False
+
+
+@dataclass
+class Alert:
+    metric: str
+    value: float
+    threshold: float
+    severity: str
+    at: float = field(default_factory=time.time)
+
+
+class PerformanceMonitor:
+    def __init__(self, history: int = 512) -> None:
+        self._history: dict[str, deque] = {}
+        self._history_len = history
+        self._thresholds: dict[str, tuple[float, str]] = {}
+        self._callbacks: list[Callable[[Alert], None]] = []
+        self._alerts: deque = deque(maxlen=256)
+        self._lock = threading.Lock()
+
+    def set_threshold(self, metric: str, threshold: float, severity: str = "warning") -> None:
+        self._thresholds[metric] = (threshold, severity)
+
+    def on_alert(self, callback: Callable[[Alert], None]) -> None:
+        self._callbacks.append(callback)
+
+    def record(self, metric: str, value: float) -> None:
+        with self._lock:
+            series = self._history.setdefault(metric, deque(maxlen=self._history_len))
+            series.append((time.time(), value))
+        threshold = self._thresholds.get(metric)
+        if threshold and value > threshold[0]:
+            alert = Alert(metric, value, threshold[0], threshold[1])
+            self._alerts.append(alert)
+            for cb in self._callbacks:
+                try:
+                    cb(alert)
+                except Exception:
+                    pass
+
+    def series(self, metric: str) -> list[tuple[float, float]]:
+        with self._lock:
+            return list(self._history.get(metric, ()))
+
+    def summary(self, metric: str) -> dict[str, float]:
+        values = [v for _, v in self.series(metric)]
+        if not values:
+            return {"count": 0}
+        ordered = sorted(values)
+        return {
+            "count": len(values),
+            "mean": sum(values) / len(values),
+            "p50": ordered[len(ordered) // 2],
+            "p95": ordered[min(int(len(ordered) * 0.95), len(ordered) - 1)],
+            "max": ordered[-1],
+        }
+
+    def trend(self, metric: str) -> dict[str, Any]:
+        """Least-squares slope over the history (reference's linear-regression
+        trend, monitoring.py:259-287)."""
+        points = self.series(metric)
+        if len(points) < 3:
+            return {"direction": "unknown", "slope": 0.0}
+        t0 = points[0][0]
+        xs = [t - t0 for t, _ in points]
+        ys = [v for _, v in points]
+        n = len(xs)
+        mean_x, mean_y = sum(xs) / n, sum(ys) / n
+        denom = sum((x - mean_x) ** 2 for x in xs) or 1e-9
+        slope = sum((x - mean_x) * (y - mean_y) for x, y in zip(xs, ys)) / denom
+        direction = "rising" if slope > 1e-9 else "falling" if slope < -1e-9 else "flat"
+        return {"direction": direction, "slope": slope}
+
+    def recent_alerts(self) -> list[Alert]:
+        return list(self._alerts)
+
+    def collect_system(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        if PSUTIL_AVAILABLE:
+            out["cpu_percent"] = psutil.cpu_percent(interval=None)
+            mem = psutil.virtual_memory()
+            out["memory_percent"] = mem.percent
+            out["memory_available_mb"] = mem.available / 1e6
+        try:
+            import jax
+
+            for dev in jax.devices():
+                stats = dev.memory_stats() or {}
+                if "bytes_in_use" in stats and stats.get("bytes_limit"):
+                    out[f"hbm_percent_dev{dev.id}"] = (
+                        100.0 * stats["bytes_in_use"] / stats["bytes_limit"]
+                    )
+        except Exception:
+            pass
+        for metric, value in out.items():
+            self.record(metric, value)
+        return out
+
+
+class ResourceMonitor:
+    """Default thresholds + health verdict + recommendations."""
+
+    DEFAULT_THRESHOLDS = {
+        "cpu_percent": (90.0, "warning"),
+        "memory_percent": (90.0, "critical"),
+        "request_latency_ms": (2000.0, "warning"),
+    }
+
+    def __init__(self, monitor: Optional[PerformanceMonitor] = None) -> None:
+        self.monitor = monitor or PerformanceMonitor()
+        for metric, (threshold, severity) in self.DEFAULT_THRESHOLDS.items():
+            self.monitor.set_threshold(metric, threshold, severity)
+
+    def health_verdict(self) -> dict[str, Any]:
+        system = self.monitor.collect_system()
+        alerts = self.monitor.recent_alerts()
+        recent = [a for a in alerts if time.time() - a.at < 300]
+        critical = [a for a in recent if a.severity == "critical"]
+        status = "unhealthy" if critical else "degraded" if recent else "healthy"
+        recommendations = []
+        if system.get("memory_percent", 0) > 80:
+            recommendations.append("host memory pressure: shrink caches or batch sizes")
+        for key, value in system.items():
+            if key.startswith("hbm_percent") and value > 85:
+                recommendations.append(
+                    f"{key}: HBM nearly full — reduce KV window, corpus shards, or batch"
+                )
+        latency_trend = self.monitor.trend("request_latency_ms")
+        if latency_trend["direction"] == "rising":
+            recommendations.append("request latency trending up")
+        return {
+            "status": status,
+            "system": system,
+            "recent_alerts": len(recent),
+            "recommendations": recommendations,
+        }
+
+
+performance_monitor = PerformanceMonitor()
+resource_monitor = ResourceMonitor(performance_monitor)

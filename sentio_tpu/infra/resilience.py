@@ -1,0 +1,372 @@
+"""Resilience primitives: circuit breakers, retries, timeouts, fallbacks.
+
+Parity with /root/reference/src/core/resilience/ (patterns.py:30-462,
+fallbacks.py:18-265, decorators.py:18-103): CLOSED/OPEN/HALF_OPEN breakers
+(sync + async) with stats, jittered exponential retry, a ResilientCall
+combinator (breaker + retry + timeout), periodic HealthChecker, and the
+3-tier degradation ladder's building blocks — disk-persisted response cache,
+deterministic hash embedding fallback, template LLM fallback. On TPU the
+breakers additionally guard device dispatch (OOM / compile / timeout), not
+just remote HTTP.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import hashlib
+import json
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from pathlib import Path
+from typing import Any, Awaitable, Callable, Optional, TypeVar
+
+from sentio_tpu.infra.exceptions import CircuitOpenError, TimeoutError_
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+
+class CircuitState(str, Enum):
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+
+@dataclass
+class BreakerStats:
+    calls: int = 0
+    failures: int = 0
+    successes: int = 0
+    rejected: int = 0
+    state_changes: int = 0
+    consecutive_failures: int = 0
+
+
+class CircuitBreaker:
+    """Thread-safe breaker: OPEN after ``failure_threshold`` consecutive
+    failures, HALF_OPEN probe after ``recovery_timeout_s``, re-CLOSED after
+    ``success_threshold`` probe successes."""
+
+    def __init__(
+        self,
+        name: str = "breaker",
+        failure_threshold: int = 5,
+        recovery_timeout_s: float = 30.0,
+        success_threshold: int = 2,
+    ) -> None:
+        self.name = name
+        self.failure_threshold = failure_threshold
+        self.recovery_timeout_s = recovery_timeout_s
+        self.success_threshold = success_threshold
+        self.state = CircuitState.CLOSED
+        self.stats = BreakerStats()
+        self._opened_at = 0.0
+        self._half_open_successes = 0
+        self._lock = threading.Lock()
+
+    def _transition(self, new_state: CircuitState) -> None:
+        if new_state != self.state:
+            logger.info("breaker %s: %s -> %s", self.name, self.state.value, new_state.value)
+            self.state = new_state
+            self.stats.state_changes += 1
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self.state == CircuitState.CLOSED:
+                return True
+            if self.state == CircuitState.OPEN:
+                if time.monotonic() - self._opened_at >= self.recovery_timeout_s:
+                    self._transition(CircuitState.HALF_OPEN)
+                    self._half_open_successes = 0
+                    return True
+                self.stats.rejected += 1
+                return False
+            return True  # HALF_OPEN probes flow
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.stats.calls += 1
+            self.stats.successes += 1
+            self.stats.consecutive_failures = 0
+            if self.state == CircuitState.HALF_OPEN:
+                self._half_open_successes += 1
+                if self._half_open_successes >= self.success_threshold:
+                    self._transition(CircuitState.CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.stats.calls += 1
+            self.stats.failures += 1
+            self.stats.consecutive_failures += 1
+            if self.state == CircuitState.HALF_OPEN or (
+                self.state == CircuitState.CLOSED
+                and self.stats.consecutive_failures >= self.failure_threshold
+            ):
+                self._transition(CircuitState.OPEN)
+                self._opened_at = time.monotonic()
+
+    def call(self, fn: Callable[..., T], *args, **kwargs) -> T:
+        if not self.allow():
+            raise CircuitOpenError(f"circuit {self.name} is open")
+        try:
+            result = fn(*args, **kwargs)
+        except Exception:
+            self.record_failure()
+            raise
+        self.record_success()
+        return result
+
+    async def acall(self, fn: Callable[..., Awaitable[T]], *args, **kwargs) -> T:
+        if not self.allow():
+            raise CircuitOpenError(f"circuit {self.name} is open")
+        try:
+            result = await fn(*args, **kwargs)
+        except Exception:
+            self.record_failure()
+            raise
+        self.record_success()
+        return result
+
+    def health(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "state": self.state.value,
+            "failures": self.stats.failures,
+            "successes": self.stats.successes,
+            "rejected": self.stats.rejected,
+            "consecutive_failures": self.stats.consecutive_failures,
+        }
+
+
+@dataclass
+class RetryPolicy:
+    """Jittered exponential backoff (reference AsyncRetry, patterns.py:403-462)."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.2
+    max_delay_s: float = 10.0
+    jitter: float = 0.25
+    retry_on: tuple[type[Exception], ...] = (Exception,)
+
+    def delay(self, attempt: int) -> float:
+        d = min(self.base_delay_s * (2**attempt), self.max_delay_s)
+        return d * (1.0 + random.uniform(-self.jitter, self.jitter))
+
+    def run(self, fn: Callable[..., T], *args, **kwargs) -> T:
+        last: Optional[Exception] = None
+        for attempt in range(self.max_attempts):
+            try:
+                return fn(*args, **kwargs)
+            except self.retry_on as exc:
+                last = exc
+                if attempt < self.max_attempts - 1:
+                    time.sleep(self.delay(attempt))
+        raise last  # type: ignore[misc]
+
+    async def arun(self, fn: Callable[..., Awaitable[T]], *args, **kwargs) -> T:
+        last: Optional[Exception] = None
+        for attempt in range(self.max_attempts):
+            try:
+                return await fn(*args, **kwargs)
+            except self.retry_on as exc:
+                last = exc
+                if attempt < self.max_attempts - 1:
+                    await asyncio.sleep(self.delay(attempt))
+        raise last  # type: ignore[misc]
+
+
+class ResilientCall:
+    """Breaker + retry + timeout combinator (reference ResilientClient,
+    patterns.py:145-249) for async callables."""
+
+    def __init__(
+        self,
+        name: str,
+        breaker: Optional[CircuitBreaker] = None,
+        retry: Optional[RetryPolicy] = None,
+        timeout_s: float = 30.0,
+    ) -> None:
+        self.name = name
+        self.breaker = breaker or CircuitBreaker(name=name)
+        self.retry = retry or RetryPolicy()
+        self.timeout_s = timeout_s
+
+    async def execute(self, fn: Callable[..., Awaitable[T]], *args, **kwargs) -> T:
+        async def bounded() -> T:
+            try:
+                return await asyncio.wait_for(fn(*args, **kwargs), timeout=self.timeout_s)
+            except asyncio.TimeoutError as exc:
+                raise TimeoutError_(f"{self.name} timed out after {self.timeout_s}s") from exc
+
+        async def guarded() -> T:
+            return await self.breaker.acall(bounded)
+
+        return await self.retry.arun(guarded)
+
+
+def with_circuit_breaker(breaker: CircuitBreaker):
+    def deco(fn):
+        if asyncio.iscoroutinefunction(fn):
+
+            @functools.wraps(fn)
+            async def awrapper(*args, **kwargs):
+                return await breaker.acall(fn, *args, **kwargs)
+
+            return awrapper
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return breaker.call(fn, *args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def with_retry(policy: Optional[RetryPolicy] = None):
+    policy = policy or RetryPolicy()
+
+    def deco(fn):
+        if asyncio.iscoroutinefunction(fn):
+
+            @functools.wraps(fn)
+            async def awrapper(*args, **kwargs):
+                return await policy.arun(fn, *args, **kwargs)
+
+            return awrapper
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return policy.run(fn, *args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+class HealthChecker:
+    """Periodic breaker/callback probe loop (reference patterns.py:252-306)."""
+
+    def __init__(self, interval_s: float = 30.0) -> None:
+        self.interval_s = interval_s
+        self._probes: dict[str, Callable[[], bool]] = {}
+        self._results: dict[str, dict[str, Any]] = {}
+        self._task: Optional[asyncio.Task] = None
+
+    def register(self, name: str, probe: Callable[[], bool]) -> None:
+        self._probes[name] = probe
+
+    async def _loop(self) -> None:
+        while True:
+            for name, probe in list(self._probes.items()):
+                try:
+                    ok = bool(probe())
+                except Exception as exc:  # noqa: BLE001
+                    ok = False
+                    self._results[name] = {"ok": False, "error": str(exc), "at": time.time()}
+                    continue
+                self._results[name] = {"ok": ok, "at": time.time()}
+            await asyncio.sleep(self.interval_s)
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    def results(self) -> dict[str, dict[str, Any]]:
+        return dict(self._results)
+
+
+# ---------------------------------------------------------------------------
+# fallback ladder components
+
+
+class FallbackResponseCache:
+    """Disk-persisted query→response cache, sha256 keys + TTL (reference
+    FallbackManager, fallbacks.py:18-159). Tier 1 of the degradation ladder:
+    a failing pipeline first replays the last good answer."""
+
+    def __init__(self, cache_dir: Optional[str] = None, ttl_s: float = 24 * 3600.0) -> None:
+        self.dir = Path(cache_dir or Path.home() / ".cache" / "sentio_tpu_fallback")
+        self.ttl_s = ttl_s
+        self._path = self.dir / "responses.json"
+        self._store: dict[str, dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        self._load()
+
+    @staticmethod
+    def _key(query: str) -> str:
+        return hashlib.sha256(query.strip().lower().encode()).hexdigest()
+
+    def _load(self) -> None:
+        try:
+            self._store = json.loads(self._path.read_text())
+        except (OSError, json.JSONDecodeError):
+            self._store = {}
+
+    def _persist(self) -> None:
+        try:
+            self.dir.mkdir(parents=True, exist_ok=True)
+            self._path.write_text(json.dumps(self._store))
+        except OSError:
+            logger.warning("fallback cache persist failed", exc_info=True)
+
+    def put(self, query: str, response: str) -> None:
+        with self._lock:
+            self._store[self._key(query)] = {"response": response, "at": time.time()}
+            self._persist()
+
+    def get(self, query: str) -> Optional[str]:
+        with self._lock:
+            entry = self._store.get(self._key(query))
+            if entry is None:
+                return None
+            if self.ttl_s > 0 and time.time() - entry["at"] > self.ttl_s:
+                del self._store[self._key(query)]
+                return None
+            return entry["response"]
+
+
+class LLMFallback:
+    """Tier 2: template answers from prompts/fallback_*.md (reference
+    fallbacks.py:205-259); tier 3 is the apology template."""
+
+    def __init__(self, prompts_dir: Optional[str] = None) -> None:
+        from sentio_tpu.ops.prompts import PromptBuilder
+
+        self._prompts = PromptBuilder(prompts_dir)
+
+    def no_retrieval(self, query: str) -> str:
+        return self._prompts.build("fallback_no_retrieval", query=query)
+
+    def no_llm(self, context: str) -> str:
+        return self._prompts.build("fallback_no_llm", context=context)
+
+    def apology(self) -> str:
+        return self._prompts.build("fallback_apology")
+
+
+def embedding_fallback(text: str, dim: int) -> "list[float]":
+    """Deterministic unit pseudo-embedding (reference EmbeddingFallback,
+    fallbacks.py:162-202) — retrieval stays alive when the device path dies."""
+    import numpy as np
+
+    seed = int.from_bytes(hashlib.md5(text.lower().encode()).digest()[:8], "little")
+    rng = np.random.default_rng(seed)
+    vec = rng.standard_normal(dim).astype(np.float32)
+    vec /= max(float(np.linalg.norm(vec)), 1e-9)
+    return vec.tolist()

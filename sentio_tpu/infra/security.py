@@ -1,0 +1,233 @@
+"""Security utilities: log sanitization, input validation, headers, tokens,
+per-IP rate windows, CSRF.
+
+Parity with /root/reference/src/utils/security.py:23-594: a ``LogSanitizer``
+regex filter installed on the root logger redacting keys/tokens globally, an
+``InputValidator`` for query/content/metadata, standard security headers, a
+``TokenGenerator``, an ``IPRateLimiter`` sliding window with an adaptive
+load factor, and CSRF token issue/check.
+"""
+
+from __future__ import annotations
+
+import hmac
+import html
+import logging
+import re
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from sentio_tpu.infra.exceptions import RateLimitError, ValidationError
+
+_REDACTION_PATTERNS = [
+    # key=value / key: value forms for credential-ish keys
+    re.compile(
+        r"(?i)\b(api[_-]?key|authorization|secret|token|password|bearer)"
+        r"([\"']?\s*[:=]\s*[\"']?)([^\s\"',;&]+)"
+    ),
+    re.compile(r"\bstk_[A-Za-z0-9_\-]{16,}\b"),  # our API keys
+    re.compile(r"\beyJ[A-Za-z0-9_\-]+\.[A-Za-z0-9_\-]+\.[A-Za-z0-9_\-]+\b"),  # JWTs
+]
+
+
+def sanitize_text(text: str) -> str:
+    out = text
+    out = _REDACTION_PATTERNS[0].sub(lambda m: f"{m.group(1)}{m.group(2)}[REDACTED]", out)
+    out = _REDACTION_PATTERNS[1].sub("[REDACTED_KEY]", out)
+    out = _REDACTION_PATTERNS[2].sub("[REDACTED_JWT]", out)
+    return out
+
+
+class LogSanitizer(logging.Filter):
+    """Root-logger filter redacting secrets from every record (reference
+    security.py:23-124, installed globally at :583-594)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        try:
+            message = record.getMessage()
+            sanitized = sanitize_text(message)
+            if sanitized != message:
+                record.msg = sanitized
+                record.args = ()
+        except Exception:
+            pass
+        return True
+
+
+_sanitizer_installed = False
+
+
+def setup_log_sanitization() -> None:
+    global _sanitizer_installed
+    if _sanitizer_installed:
+        return
+    logging.getLogger().addFilter(LogSanitizer())
+    for handler in logging.getLogger().handlers:
+        handler.addFilter(LogSanitizer())
+    _sanitizer_installed = True
+
+
+_CONTROL_CHARS = re.compile(r"[\x00-\x08\x0b\x0c\x0e-\x1f\x7f]")
+_SUSPICIOUS = re.compile(
+    r"(?i)(<script\b|javascript:|on\w+\s*=|\bunion\s+select\b|\bdrop\s+table\b)"
+)
+
+
+class InputValidator:
+    """Query/content/metadata validation (reference security.py:126-264)."""
+
+    def __init__(self, max_query_chars: int = 2000, max_content_chars: int = 50_000) -> None:
+        self.max_query_chars = max_query_chars
+        self.max_content_chars = max_content_chars
+
+    def validate_query(self, query: Any) -> str:
+        if not isinstance(query, str):
+            raise ValidationError("question must be a string")
+        query = _CONTROL_CHARS.sub("", query).strip()
+        if not query:
+            raise ValidationError("question must be non-empty")
+        if len(query) > self.max_query_chars:
+            raise ValidationError(
+                f"question exceeds {self.max_query_chars} characters"
+            )
+        if _SUSPICIOUS.search(query):
+            raise ValidationError("question contains disallowed content")
+        return query
+
+    def validate_content(self, content: Any) -> str:
+        if not isinstance(content, str):
+            raise ValidationError("content must be a string")
+        content = _CONTROL_CHARS.sub("", content)
+        if not content.strip():
+            raise ValidationError("content must be non-empty")
+        if len(content) > self.max_content_chars:
+            raise ValidationError(f"content exceeds {self.max_content_chars} characters")
+        return content
+
+    def validate_metadata(self, metadata: Any) -> dict[str, Any]:
+        if metadata is None:
+            return {}
+        if not isinstance(metadata, dict):
+            raise ValidationError("metadata must be an object")
+        if len(metadata) > 64:
+            raise ValidationError("metadata has too many keys")
+        out: dict[str, Any] = {}
+        for key, value in metadata.items():
+            if not isinstance(key, str) or len(key) > 128:
+                raise ValidationError("metadata keys must be short strings")
+            if isinstance(value, str):
+                if len(value) > 4096:
+                    raise ValidationError(f"metadata value for {key!r} too long")
+                out[key] = _CONTROL_CHARS.sub("", value)
+            elif isinstance(value, (int, float, bool)) or value is None:
+                out[key] = value
+            else:
+                raise ValidationError(f"metadata value for {key!r} must be scalar")
+        return out
+
+    @staticmethod
+    def sanitize_html(text: str) -> str:
+        return html.escape(text, quote=True)
+
+
+SECURITY_HEADERS = {
+    "X-Content-Type-Options": "nosniff",
+    "X-Frame-Options": "DENY",
+    "X-XSS-Protection": "1; mode=block",
+    "Referrer-Policy": "strict-origin-when-cross-origin",
+    "Cache-Control": "no-store",
+    "Content-Security-Policy": "default-src 'none'",
+}
+
+
+class TokenGenerator:
+    @staticmethod
+    def token(n_bytes: int = 32) -> str:
+        return secrets.token_urlsafe(n_bytes)
+
+    @staticmethod
+    def numeric_code(digits: int = 6) -> str:
+        return "".join(secrets.choice("0123456789") for _ in range(digits))
+
+
+@dataclass
+class RateLimitConfig:
+    per_minute: int = 100
+    burst: int = 20
+
+
+class IPRateLimiter:
+    """Per-IP sliding window with an adaptive load factor: under global load,
+    effective limits shrink (reference security.py:289-400, 401-560)."""
+
+    def __init__(self, default: Optional[RateLimitConfig] = None) -> None:
+        self.default = default or RateLimitConfig()
+        self.per_endpoint: dict[str, RateLimitConfig] = {}
+        self._events: dict[tuple[str, str], list[float]] = {}
+        self._lock = threading.Lock()
+        self._checks_since_sweep = 0
+        self.load_factor = 1.0  # <1.0 tightens limits under pressure
+
+    def _maybe_sweep(self, now: float) -> None:
+        """Drop idle (ip, endpoint) keys so rotating/spoofed IPs can't grow
+        the table without bound. Called under the lock."""
+        self._checks_since_sweep += 1
+        if self._checks_since_sweep < 1024 and len(self._events) < 16_384:
+            return
+        self._checks_since_sweep = 0
+        doomed = [k for k, w in self._events.items() if not w or now - w[-1] >= 60.0]
+        for k in doomed:
+            del self._events[k]
+
+    def configure(self, endpoint: str, per_minute: int, burst: Optional[int] = None) -> None:
+        self.per_endpoint[endpoint] = RateLimitConfig(
+            per_minute=per_minute, burst=burst or max(per_minute // 5, 1)
+        )
+
+    def check(self, ip: str, endpoint: str = "*") -> None:
+        cfg = self.per_endpoint.get(endpoint, self.default)
+        limit = max(int(cfg.per_minute * self.load_factor), 1)
+        now = time.time()
+        key = (ip, endpoint)
+        with self._lock:
+            self._maybe_sweep(now)
+            window = [t for t in self._events.get(key, []) if now - t < 60.0]
+            if len(window) >= limit:
+                retry = 60.0 - (now - window[0])
+                raise RateLimitError(
+                    f"rate limit {limit}/min exceeded for {endpoint}",
+                    retry_after_s=max(retry, 1.0),
+                )
+            window.append(now)
+            self._events[key] = window
+
+    def remaining(self, ip: str, endpoint: str = "*") -> int:
+        cfg = self.per_endpoint.get(endpoint, self.default)
+        limit = max(int(cfg.per_minute * self.load_factor), 1)
+        now = time.time()
+        with self._lock:
+            window = [t for t in self._events.get((ip, endpoint), []) if now - t < 60.0]
+        return max(limit - len(window), 0)
+
+
+class CSRFProtection:
+    def __init__(self, secret: Optional[str] = None) -> None:
+        self._secret = (secret or secrets.token_urlsafe(32)).encode()
+
+    def issue(self, session_id: str) -> str:
+        ts = str(int(time.time()))
+        mac = hmac.new(self._secret, f"{session_id}:{ts}".encode(), "sha256").hexdigest()
+        return f"{ts}.{mac}"
+
+    def verify(self, session_id: str, token: str, max_age_s: float = 3600.0) -> bool:
+        try:
+            ts, mac = token.split(".")
+            if time.time() - float(ts) > max_age_s:
+                return False
+        except ValueError:
+            return False
+        expected = hmac.new(self._secret, f"{session_id}:{ts}".encode(), "sha256").hexdigest()
+        return hmac.compare_digest(mac, expected)

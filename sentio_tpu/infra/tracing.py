@@ -1,0 +1,178 @@
+"""Tracing: OpenTelemetry spans with OTLP/console exporters, a no-op mock
+fallback, and JAX profiler correlation.
+
+Parity with /root/reference/src/observability/tracing.py:34-347 — a
+TracingManager with graceful degradation when OTel is absent, span context
+managers and decorators for sync+async code — plus the TPU addition from
+SURVEY.md §2.10: ``profile_step`` wraps a device batch step in a
+``jax.profiler.StepTraceAnnotation`` (and optionally a trace session dumping
+to ``observability.profiler_dir``) so request spans line up with XLA traces.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import logging
+import time
+from contextlib import contextmanager
+from typing import Any, Optional
+
+from sentio_tpu.config import ObservabilityConfig, get_settings
+
+logger = logging.getLogger(__name__)
+
+
+class MockSpan:
+    def set_attribute(self, key: str, value: Any) -> "MockSpan":
+        return self
+
+    def record_exception(self, exc: BaseException) -> None:
+        pass
+
+    def set_status(self, *a, **k) -> None:
+        pass
+
+    def __enter__(self) -> "MockSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+class TracingManager:
+    def __init__(self, config: Optional[ObservabilityConfig] = None) -> None:
+        self.config = config or get_settings().observability
+        self._tracer = None
+        self._provider = None
+        if self.config.tracing_enabled:
+            self._setup()
+
+    def _setup(self) -> None:
+        try:
+            from opentelemetry import trace
+            from opentelemetry.sdk.resources import Resource
+            from opentelemetry.sdk.trace import TracerProvider
+            from opentelemetry.sdk.trace.export import (
+                BatchSpanProcessor,
+                ConsoleSpanExporter,
+                SimpleSpanProcessor,
+            )
+
+            resource = Resource.create({"service.name": self.config.service_name})
+            provider = TracerProvider(resource=resource)
+            if self.config.otlp_endpoint:
+                try:
+                    from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
+                        OTLPSpanExporter,
+                    )
+
+                    provider.add_span_processor(
+                        BatchSpanProcessor(OTLPSpanExporter(endpoint=self.config.otlp_endpoint))
+                    )
+                except ImportError:
+                    logger.warning("OTLP exporter unavailable; skipping")
+            if self.config.console_exporter:
+                provider.add_span_processor(SimpleSpanProcessor(ConsoleSpanExporter()))
+            trace.set_tracer_provider(provider)
+            self._provider = provider
+            self._tracer = trace.get_tracer(self.config.service_name)
+            logger.info("tracing enabled for %s", self.config.service_name)
+        except ImportError:
+            logger.info("opentelemetry not installed; tracing is a no-op")
+            self._tracer = None
+
+    @contextmanager
+    def span(self, name: str, **attributes: Any):
+        if self._tracer is None:
+            span = MockSpan()
+            for k, v in attributes.items():
+                span.set_attribute(k, v)
+            yield span
+            return
+        with self._tracer.start_as_current_span(name) as span:
+            for k, v in attributes.items():
+                span.set_attribute(k, v)
+            yield span
+
+    @contextmanager
+    def profile_step(self, name: str, step: int = 0):
+        """Correlate a device dispatch with the XLA profiler timeline."""
+        try:
+            import jax
+
+            with jax.profiler.StepTraceAnnotation(name, step_num=step):
+                with self.span(f"tpu.{name}", step=step):
+                    yield
+        except Exception:
+            with self.span(f"tpu.{name}", step=step):
+                yield
+
+    def start_profiler(self) -> bool:
+        if not self.config.profiler_dir:
+            return False
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.config.profiler_dir)
+            return True
+        except Exception:
+            logger.warning("jax profiler start failed", exc_info=True)
+            return False
+
+    def stop_profiler(self) -> None:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+
+    def shutdown(self) -> None:
+        if self._provider is not None:
+            try:
+                self._provider.shutdown()
+            except Exception:
+                pass
+
+
+def trace_function(name: Optional[str] = None, manager: Optional[TracingManager] = None):
+    """Decorator for sync and async functions (reference tracing.py:181-265)."""
+
+    def deco(fn):
+        span_name = name or f"{fn.__module__}.{fn.__qualname__}"
+
+        if asyncio.iscoroutinefunction(fn):
+
+            @functools.wraps(fn)
+            async def awrapper(*args, **kwargs):
+                mgr = manager or get_tracing()
+                with mgr.span(span_name):
+                    return await fn(*args, **kwargs)
+
+            return awrapper
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            mgr = manager or get_tracing()
+            with mgr.span(span_name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+_tracing: Optional[TracingManager] = None
+
+
+def get_tracing() -> TracingManager:
+    global _tracing
+    if _tracing is None:
+        _tracing = TracingManager()
+    return _tracing
+
+
+def set_tracing(manager: Optional[TracingManager]) -> None:
+    global _tracing
+    _tracing = manager

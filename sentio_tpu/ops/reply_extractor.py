@@ -71,14 +71,56 @@ def _relaxed_parse(span: str) -> Optional[dict]:
     # nesting correctly where naive regex swapping cannot
     import ast
 
-    pyish = re.sub(r"\btrue\b", "True", fixed)
-    pyish = re.sub(r"\bfalse\b", "False", pyish)
-    pyish = re.sub(r"\bnull\b", "None", pyish)
-    try:
-        obj = ast.literal_eval(pyish)
-    except (ValueError, SyntaxError, MemoryError, RecursionError):
-        return None
-    return obj if isinstance(obj, dict) else None
+    for candidate in (fixed, _bare_words_to_python(fixed)):
+        try:
+            obj = ast.literal_eval(candidate)
+        except (ValueError, SyntaxError, MemoryError, RecursionError):
+            continue
+        if isinstance(obj, dict):
+            return obj
+    return None
+
+
+def _bare_words_to_python(span: str) -> str:
+    """Rewrite bare true/false/null to True/False/None OUTSIDE string
+    literals only — 'the claim is true' inside a value must stay untouched."""
+    out: list[str] = []
+    i = 0
+    quote: Optional[str] = None
+    replacements = {"true": "True", "false": "False", "null": "None"}
+    while i < len(span):
+        ch = span[i]
+        if quote is not None:
+            out.append(ch)
+            if ch == "\\" and i + 1 < len(span):
+                out.append(span[i + 1])
+                i += 2
+                continue
+            if ch == quote:
+                quote = None
+            i += 1
+            continue
+        if ch in ("'", '"'):
+            quote = ch
+            out.append(ch)
+            i += 1
+            continue
+        matched = False
+        for word, repl in replacements.items():
+            end = i + len(word)
+            if (
+                span[i:end] == word
+                and (i == 0 or not (span[i - 1].isalnum() or span[i - 1] == "_"))
+                and (end >= len(span) or not (span[end].isalnum() or span[end] == "_"))
+            ):
+                out.append(repl)
+                i = end
+                matched = True
+                break
+        if not matched:
+            out.append(ch)
+            i += 1
+    return "".join(out)
 
 
 def extract_json_block(text: str) -> JsonExtractResult:

@@ -59,6 +59,9 @@ class Reranker:
         for i in order:
             doc = documents[int(i)]
             meta = dict(doc.metadata)
+            # drop the fused score: Document.score() prefers hybrid_score, and
+            # a stale one would make downstream sort-by-score undo the rerank
+            meta.pop("hybrid_score", None)
             meta["rerank_score"] = float(scores[int(i)])
             meta["score"] = float(scores[int(i)])
             out_docs.append(Document(text=doc.text, metadata=meta, id=doc.id))
@@ -71,7 +74,9 @@ class Reranker:
         for i, doc in enumerate(documents[:top_k]):
             score = max(1.0 - 0.1 * i, 0.1)
             meta = dict(doc.metadata)
+            meta.pop("hybrid_score", None)
             meta["rerank_score"] = score
+            meta["score"] = score
             docs.append(Document(text=doc.text, metadata=meta, id=doc.id))
             scores.append(score)
         return RerankingResult(docs, scores, self.name, fallback_used=True)

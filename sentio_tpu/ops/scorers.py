@@ -31,11 +31,24 @@ class ScorerPlugin(Protocol):
     def score(self, query: str, documents: Sequence[Document]) -> np.ndarray: ...
 
 
+_EMBED_MEMO: dict = {"key": None, "value": None}
+
+
 def _doc_embeddings(embedder, query: str, documents: Sequence[Document]):
-    """One batched forward for query + all docs → (q_vec, doc_matrix)."""
+    """One batched forward for query + all docs → (q_vec, doc_matrix).
+
+    Memoizes the latest call so the semantic and MMR scorers (which run
+    back-to-back over the same candidates in the default stack) share a
+    single device dispatch instead of embedding everything twice."""
+    key = (id(embedder), query, tuple(d.id for d in documents))
+    if _EMBED_MEMO["key"] == key:
+        return _EMBED_MEMO["value"]
     texts = [query] + [d.content for d in documents]
     vecs = embedder.embed_many(texts)
-    return vecs[0], vecs[1:]
+    result = (vecs[0], vecs[1:])
+    _EMBED_MEMO["key"] = key
+    _EMBED_MEMO["value"] = result
+    return result
 
 
 @dataclass

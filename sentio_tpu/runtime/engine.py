@@ -129,13 +129,16 @@ class GeneratorEngine:
 
     # --------------------------------------------------------------- helpers
 
-    def _encode_batch(self, prompts: Sequence[str]):
+    def _encode_batch(self, prompts: Sequence[str], max_new: int):
         import jax.numpy as jnp
 
         from sentio_tpu.models.llama import init_cache
         from sentio_tpu.models.tokenizer import batch_encode
 
-        max_prompt = min(self.config.max_prompt_tokens, self.model_config.max_len)
+        # prompts always leave >= 8 decode slots in the window, even at the
+        # model's max_len — a prompt that fills the cache exactly would have
+        # its first generated token clamped onto the last prompt slot
+        max_prompt = min(self.config.max_prompt_tokens, self.model_config.max_len - 8)
         ids, mask = batch_encode(self.tokenizer, prompts, max_len=max_prompt, add_bos=True)
         lens = mask.sum(axis=1).astype(np.int32)
         n = len(prompts)
@@ -147,7 +150,7 @@ class GeneratorEngine:
 
         window = min(
             self.model_config.max_len,
-            bucket_size(width + self.config.max_new_tokens, self.PREFILL_BUCKETS + (self.model_config.max_len,)),
+            bucket_size(width + max_new, self.PREFILL_BUCKETS + (self.model_config.max_len,)),
         )
         cache = init_cache(self.model_config, rows, window)
         if self.mesh is not None:
@@ -170,10 +173,12 @@ class GeneratorEngine:
         DOWN to a step bucket (finish_reason becomes 'length')."""
         from sentio_tpu.parallel.batcher import floor_bucket
 
-        headroom = max(headroom, 1)
+        # _encode_batch truncates prompts to leave >= 8 slots, so headroom >= 8
+        # always holds in practice; the assert guards the invariant
+        assert headroom >= 1, f"no KV headroom ({headroom}); prompt truncation failed"
         if requested <= headroom:
             return max(requested, 1)
-        return floor_bucket(headroom, self.STEP_BUCKETS)
+        return max(min(floor_bucket(headroom, self.STEP_BUCKETS), headroom), 1)
 
     # ----------------------------------------------------------------- public
 
@@ -206,7 +211,7 @@ class GeneratorEngine:
         t0 = time.perf_counter()
         max_new = max_new_tokens or self.config.max_new_tokens
         temp = self.config.temperature() if temperature is None else temperature
-        ids, positions, lens, cache, n, window = self._encode_batch(prompts)
+        ids, positions, lens, cache, n, window = self._encode_batch(prompts, max_new)
         max_new = self._stable_steps(max_new, window - int(lens.max()))
 
         logits, cache = self._prefill(self.params, ids, positions, cache)
@@ -257,7 +262,7 @@ class GeneratorEngine:
 
         max_new = max_new_tokens or self.config.max_new_tokens
         temp = self.config.temperature() if temperature is None else temperature
-        ids, positions, lens, cache, _, window = self._encode_batch([prompt])
+        ids, positions, lens, cache, _, window = self._encode_batch([prompt], max_new)
         max_new = self._stable_steps(max_new, window - int(lens.max()))
 
         logits, cache = self._prefill(self.params, ids, positions, cache)

@@ -275,3 +275,16 @@ class TestReviewRegressions:
         assert engine._stable_steps(100, 1000) == 100  # config value passes through
         assert engine._stable_steps(1000, 700) == 512  # clamped -> bucket floor
         assert engine._stable_steps(1000, 1) == 1
+
+
+def test_relaxed_parse_preserves_true_inside_strings():
+    r = extract_json_block("{'verdict': 'fail', 'revised_answer': 'the claim is true'}")
+    assert r.ok
+    assert r.payload["revised_answer"] == "the claim is true"
+
+
+def test_per_call_max_new_tokens_respected(engine):
+    short = engine.generate(["count up"], max_new_tokens=4, temperature=0.0)[0]
+    longer = engine.generate(["count up"], max_new_tokens=24, temperature=0.0)[0]
+    assert len(short.tokens) <= 4
+    assert len(longer.tokens) > 4 or longer.finish_reason == "stop"

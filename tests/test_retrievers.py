@@ -253,3 +253,41 @@ class TestRerankers:
     def test_async(self, docs):
         result = asyncio.run(PassthroughReranker().arerank("q", docs[:2]))
         assert len(result.documents) == 2
+
+
+def test_rerank_overrides_stale_hybrid_score(docs):
+    """Reranked docs must sort by rerank order downstream — a leftover
+    hybrid_score would win in Document.score() and undo the rerank."""
+    from sentio_tpu.models.document import Document as D
+
+    scored = [
+        D(text=d.text, id=d.id, metadata={**d.metadata, "hybrid_score": 1.0 - 0.1 * i})
+        for i, d in enumerate(docs[:4])
+    ]
+
+    class ReverseReranker(Reranker):
+        name = "reverse"
+
+        def _score(self, query, documents):
+            return np.arange(len(documents), dtype=np.float32)  # reverse order
+
+    result = ReverseReranker().rerank("q", scored, top_k=4)
+    assert [d.id for d in result.documents] == [d.id for d in reversed(scored)]
+    resorted = sorted(result.documents, key=lambda d: d.score(), reverse=True)
+    assert [d.id for d in resorted] == [d.id for d in result.documents]
+
+
+def test_semantic_and_mmr_share_one_embed(docs):
+    calls = []
+
+    class CountingEmbedder(HashEmbedder):
+        def embed_many(self, texts):
+            calls.append(len(texts))
+            return super().embed_many(texts)
+
+    emb = CountingEmbedder(EmbedderConfig(provider="hash", dim=64))
+    sem = SemanticSimilarityScorer(embedder=emb)
+    mmr = MMRScorer(embedder=emb)
+    sem.score("shared query", docs)
+    mmr.score("shared query", docs)
+    assert calls == [len(docs) + 1]  # second scorer reused the memoized batch
