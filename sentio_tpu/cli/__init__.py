@@ -1,0 +1,107 @@
+"""Command-line interface: ingest / serve / bench / info.
+
+Parity with /root/reference/src/cli/ (Typer app with ``ingest``/``api``/
+``ui``/``run``/``studio`` sub-apps, __init__.py:17-23 there) on stdlib
+argparse — Typer isn't in the base image, and the UI is served by the API
+process itself (GET /), so ``serve`` covers the reference's ``api`` + ``ui``
++ ``run`` trio. ``python -m sentio_tpu.cli <cmd>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+__all__ = ["main"]
+
+
+def _cmd_ingest(args: argparse.Namespace) -> int:
+    from sentio_tpu.config import get_settings
+    from sentio_tpu.ops.ingest import DocumentIngestor
+
+    settings = get_settings()
+    ingestor = DocumentIngestor(settings=settings)
+    stats = ingestor.ingest_path(args.path, recursive=not args.no_recursive)
+    if args.save:
+        ingestor.dense_index.save(args.save)
+        print(f"index saved to {args.save}", file=sys.stderr)
+    print(json.dumps(stats.to_dict()))
+    return 0 if not stats.errors else 1
+
+
+def _cmd_serve(args: argparse.Namespace) -> int:
+    from sentio_tpu.config import get_settings
+    from sentio_tpu.serve.app import run_server
+
+    settings = get_settings()
+    if args.host:
+        settings.serve.host = args.host
+    if args.port:
+        settings.serve.port = args.port
+    run_server(settings)
+    return 0
+
+
+def _cmd_bench(args: argparse.Namespace) -> int:
+    import os
+    import runpy
+    from pathlib import Path
+
+    if args.fast:
+        os.environ["BENCH_FAST"] = "1"
+    bench = Path(__file__).resolve().parents[2] / "bench.py"
+    runpy.run_path(str(bench), run_name="__main__")
+    return 0
+
+
+def _cmd_info(args: argparse.Namespace) -> int:
+    import jax
+
+    import sentio_tpu
+    from sentio_tpu.config import get_settings
+
+    settings = get_settings()
+    devices = jax.devices()
+    print(json.dumps({
+        "version": sentio_tpu.__version__,
+        "devices": [{"platform": d.platform, "kind": d.device_kind} for d in devices],
+        "retrieval": settings.retrieval.strategy,
+        "generator": settings.generator.model_preset,
+        "mesh": {
+            "dp": settings.mesh.dp_size,
+            "tp": settings.mesh.tp_size,
+            "sp": settings.mesh.sp_size,
+        },
+    }, indent=2))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="sentio-tpu", description=__doc__)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_ingest = sub.add_parser("ingest", help="ingest a file or directory into the index")
+    p_ingest.add_argument("path")
+    p_ingest.add_argument("--no-recursive", action="store_true")
+    p_ingest.add_argument("--save", default="", help="persist the dense index to this path")
+    p_ingest.set_defaults(fn=_cmd_ingest)
+
+    p_serve = sub.add_parser("serve", help="run the API server (UI at /)")
+    p_serve.add_argument("--host", default="")
+    p_serve.add_argument("--port", type=int, default=0)
+    p_serve.set_defaults(fn=_cmd_serve)
+
+    p_bench = sub.add_parser("bench", help="run the end-to-end benchmark")
+    p_bench.add_argument("--fast", action="store_true")
+    p_bench.set_defaults(fn=_cmd_bench)
+
+    p_info = sub.add_parser("info", help="print version/device/config info")
+    p_info.set_defaults(fn=_cmd_info)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,3 @@
+from sentio_tpu.cli import main
+
+raise SystemExit(main())

@@ -266,6 +266,8 @@ class ServeConfig:
     max_embed_chars: int = 50_000
     top_k_max: int = 20
     cors_origins: str = "*"
+    # only honor X-Forwarded-For when deployed behind a trusted proxy
+    trust_proxy_headers: bool = False
     # request coalescing for the TPU batcher
     batch_deadline_ms: float = 8.0
     batch_max_size: int = 8

@@ -38,6 +38,17 @@ class CircuitState(str, Enum):
     HALF_OPEN = "half_open"
 
 
+_breakers: dict[str, "CircuitBreaker"] = {}
+_registry_lock = threading.Lock()
+
+
+def registered_breakers() -> dict[str, "CircuitBreaker"]:
+    """Live breaker registry for health reporting (the reference exposes
+    breaker states via get_health_status, jina_reranker.py:324-340 there)."""
+    with _registry_lock:
+        return dict(_breakers)
+
+
 @dataclass
 class BreakerStats:
     calls: int = 0
@@ -69,6 +80,8 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._half_open_successes = 0
         self._lock = threading.Lock()
+        with _registry_lock:
+            _breakers[name] = self
 
     def _transition(self, new_state: CircuitState) -> None:
         if new_state != self.state:

@@ -53,6 +53,11 @@ class TpuDenseIndex:
     def size(self) -> int:
         return int(self._alive.sum())
 
+    def documents(self) -> list[Document]:
+        """Live documents (the "collection scroll" the reference does against
+        Qdrant to hydrate BM25, retrievers/factory.py:83-133 there)."""
+        return [doc for doc, ok in zip(self._documents, self._alive) if ok]
+
     def add(self, documents: Sequence[Document], embeddings: np.ndarray) -> None:
         embeddings = np.asarray(embeddings, np.float32)
         if embeddings.ndim != 2 or embeddings.shape[1] != self.dim:

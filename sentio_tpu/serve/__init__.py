@@ -1,0 +1,18 @@
+"""HTTP serving layer: aiohttp app, handlers, DI container, schemas.
+
+Parity with /root/reference/src/api/ (app.py, handlers/) and
+src/core/dependencies.py — see the module docstrings for the line-level map.
+"""
+
+from sentio_tpu.serve.dependencies import DependencyContainer, get_container, set_container
+
+__all__ = ["DependencyContainer", "get_container", "set_container", "create_app", "run_server"]
+
+
+def __getattr__(name):
+    # lazy: importing the container shouldn't drag aiohttp in
+    if name in ("create_app", "run_server"):
+        from sentio_tpu.serve import app as _app
+
+        return getattr(_app, name)
+    raise AttributeError(name)

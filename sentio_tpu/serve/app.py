@@ -1,0 +1,411 @@
+"""HTTP serving surface on aiohttp.web.
+
+Parity with /root/reference/src/api/app.py:250-665 — endpoints ``/chat``
+(+SSE streaming), ``/embed``, ``/clear``, ``/health`` ×4, ``/info``,
+``/metrics`` + ``/metrics/performance``; per-IP sliding-window rate limits
+(10/min ``/embed``, 100/min default, :81-101 there), security-header
+middleware (:272-281), central exception handlers (:284-297), lifespan
+startup/shutdown (:206-246) — built on aiohttp instead of FastAPI (the only
+async HTTP server in the base image), with the TPU inversion: startup eagerly
+initializes mesh + weights + indexes via ``DependencyContainer.initialize_all``
+so first-request latency is flat.
+
+A minimal built-in chat page at ``/`` replaces the reference's separate
+Streamlit app (src/ui/streamlit_app.py there) without adding a dependency.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from sentio_tpu.config import Settings, get_settings
+from sentio_tpu.infra.exceptions import ErrorHandler, RateLimitError, SentioError
+from sentio_tpu.infra.metrics import get_metrics
+from sentio_tpu.infra.security import SECURITY_HEADERS, setup_log_sanitization
+from sentio_tpu.serve.dependencies import DependencyContainer, get_container, set_container
+from sentio_tpu.serve.schemas import SchemaError, parse_chat_request, parse_embed_request
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["create_app", "run_server"]
+
+_UI_PAGE = """<!doctype html><html><head><meta charset="utf-8">
+<title>sentio-tpu</title><style>
+body{font-family:system-ui,sans-serif;max-width:780px;margin:2rem auto;padding:0 1rem}
+#log{border:1px solid #ccc;border-radius:8px;padding:1rem;min-height:200px;white-space:pre-wrap}
+textarea{width:100%;box-sizing:border-box}
+.src{color:#666;font-size:.85em;margin-left:1em}
+</style></head><body>
+<h2>sentio-tpu</h2>
+<div id="log"></div>
+<p><textarea id="q" rows="3" placeholder="Ask a question..."></textarea>
+<button onclick="send()">Send</button></p>
+<script>
+async function send(){
+  const q=document.getElementById('q').value.trim(); if(!q)return;
+  const log=document.getElementById('log');
+  log.textContent+='\\n> '+q+'\\n';
+  const r=await fetch('/chat',{method:'POST',headers:{'Content-Type':'application/json'},
+    body:JSON.stringify({question:q})});
+  const d=await r.json();
+  log.textContent+=(d.answer||JSON.stringify(d))+'\\n';
+  (d.sources||[]).forEach((s,i)=>{log.textContent+='  ['+(i+1)+'] '+(s.metadata.source||s.id)+'\\n'});
+}
+</script></body></html>"""
+
+
+def _client_ip(request: web.Request, trust_proxy: bool = False) -> str:
+    """Socket peer address; X-Forwarded-For only when explicitly deployed
+    behind a trusted proxy — otherwise any client could mint a fresh IP per
+    request and walk straight past the per-IP rate limiter."""
+    peer = request.transport.get_extra_info("peername") if request.transport else None
+    ip = peer[0] if peer else "unknown"
+    if trust_proxy:
+        forwarded = request.headers.get("X-Forwarded-For", "").split(",")[0].strip()
+        if forwarded:
+            ip = forwarded
+    return ip
+
+
+async def _json_body(request: web.Request):
+    """Malformed JSON is a client error (422 with a field list), not a 500."""
+    if not request.can_read_body:
+        return {}
+    try:
+        return await request.json()
+    except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+        raise SchemaError([{"field": "body", "error": f"invalid JSON: {exc}"}]) from exc
+
+
+@web.middleware
+async def error_middleware(request: web.Request, handler):
+    """Central exception → JSON error mapping (reference app.py:284-297)."""
+    try:
+        return await handler(request)
+    except SchemaError as exc:
+        return web.json_response({"error": "validation_error", "details": exc.errors}, status=422)
+    except RateLimitError as exc:
+        resp = web.json_response(exc.to_dict(), status=exc.status)
+        retry = exc.details.get("retry_after_s")
+        if retry:
+            resp.headers["Retry-After"] = str(int(retry))
+        return resp
+    except SentioError as exc:
+        return web.json_response(exc.to_dict(), status=exc.status)
+    except web.HTTPException as exc:
+        # an HTTPException IS a response — returning it (rather than
+        # re-raising) lets the outer security-header middleware stamp it
+        return exc
+    except Exception as exc:  # noqa: BLE001
+        status, body = ErrorHandler.handle(exc)
+        return web.json_response(body, status=status)
+
+
+@web.middleware
+async def security_headers_middleware(request: web.Request, handler):
+    response = await handler(request)
+    for key, value in SECURITY_HEADERS.items():
+        response.headers.setdefault(key, value)
+    return response
+
+
+def _make_observability_middleware(container: DependencyContainer):
+    @web.middleware
+    async def observability_middleware(request: web.Request, handler):
+        """Rate limiting + request metrics (reference app.py:259-281)."""
+        path = request.path
+        if not path.startswith(("/health", "/metrics")) and path != "/":
+            endpoint = "/embed" if path == "/embed" else "*"
+            ip = _client_ip(request, trust_proxy=container.settings.serve.trust_proxy_headers)
+            container.rate_limiter.check(ip, endpoint)
+        t0 = time.perf_counter()
+        response = await handler(request)
+        get_metrics().record_request(path, response.status, time.perf_counter() - t0)
+        return response
+
+    return observability_middleware
+
+
+def _make_auth_middleware(container: DependencyContainer):
+    open_paths = ("/health", "/metrics", "/", "/auth/token")
+
+    @web.middleware
+    async def auth_middleware(request: web.Request, handler):
+        auth = container.auth_manager
+        if auth is None or request.path.startswith(open_paths[:2]) or request.path in open_paths:
+            return await handler(request)
+        header = request.headers.get("Authorization", "")
+        api_key = request.headers.get("X-API-Key", "")
+        try:
+            if header.startswith("Bearer "):
+                request["auth"] = auth.verify_token(header[7:])
+            elif api_key:
+                request["auth"] = auth.verify_api_key(api_key)
+            else:
+                raise web.HTTPUnauthorized(
+                    text=json.dumps({"error": "missing credentials"}),
+                    content_type="application/json",
+                )
+        except web.HTTPException:
+            raise
+        except Exception:  # noqa: BLE001 — invalid token/key
+            raise web.HTTPUnauthorized(
+                text=json.dumps({"error": "invalid credentials"}),
+                content_type="application/json",
+            )
+        return await handler(request)
+
+    return auth_middleware
+
+
+# ---------------------------------------------------------------- endpoints
+
+
+async def ui_page(request: web.Request) -> web.Response:
+    # the inline chat page needs its own CSP (the global default-src 'none'
+    # would block the inline script/style)
+    return web.Response(
+        text=_UI_PAGE,
+        content_type="text/html",
+        headers={
+            "Content-Security-Policy":
+                "default-src 'none'; script-src 'unsafe-inline'; "
+                "style-src 'unsafe-inline'; connect-src 'self'"
+        },
+    )
+
+
+async def chat(request: web.Request) -> web.Response:
+    container: DependencyContainer = request.app["container"]
+    body = await _json_body(request)
+    req = parse_chat_request(body, container.settings.serve)
+    if req.stream:
+        return await _chat_stream(request, container, req)
+    result = await container.chat_handler.process_chat_request(
+        question=req.question,
+        top_k=req.top_k,
+        temperature=req.temperature,
+        mode=req.mode,
+        thread_id=req.thread_id,
+    )
+    return web.json_response(result)
+
+
+async def _chat_stream(request: web.Request, container: DependencyContainer, req) -> web.StreamResponse:
+    """SSE token streaming (reference generator.py:298-333 / openai SSE).
+    Retrieval + selection run first (blocking stage on a thread), then the
+    generator's token iterator is pumped from a worker thread into the
+    response via a queue."""
+    response = web.StreamResponse(
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive",
+        }
+    )
+    await response.prepare(request)
+    loop = asyncio.get_running_loop()
+    queue: asyncio.Queue = asyncio.Queue(maxsize=256)
+
+    def put(item) -> None:
+        # blocking put from the worker thread: a slow SSE client backpressures
+        # the decode loop instead of silently dropping tokens (or losing the
+        # 'done' sentinel and hanging the response forever)
+        asyncio.run_coroutine_threadsafe(queue.put(item), loop).result()
+
+    def produce() -> None:
+        try:
+            gen = container.generator
+            docs = container.retriever.retrieve(
+                req.question, top_k=req.top_k or container.settings.retrieval.top_k
+            )
+            reranker = container.reranker
+            if reranker is not None and docs:
+                docs = reranker.rerank(req.question, docs, top_k=container.settings.rerank.top_k).documents
+            for piece in gen.stream(
+                req.question,
+                docs,
+                mode=req.mode,
+                temperature=req.temperature,
+            ):
+                put(("token", piece))
+            put(("done", ""))
+        except Exception as exc:  # noqa: BLE001
+            try:
+                put(("error", str(exc)))
+            except Exception:  # noqa: BLE001 — loop already closed
+                pass
+
+    task = loop.run_in_executor(None, produce)
+    try:
+        while True:
+            kind, payload = await queue.get()
+            if kind == "token":
+                await response.write(f"data: {json.dumps({'token': payload})}\n\n".encode())
+            elif kind == "error":
+                await response.write(f"data: {json.dumps({'error': payload})}\n\n".encode())
+                break
+            else:
+                await response.write(b"data: [DONE]\n\n")
+                break
+    finally:
+        await task
+    await response.write_eof()
+    return response
+
+
+async def embed(request: web.Request) -> web.Response:
+    container: DependencyContainer = request.app["container"]
+    body = await _json_body(request)
+    req = parse_embed_request(body, container.settings.serve)
+    stats = await asyncio.to_thread(container.ingestor.ingest_document, req.content, req.metadata)
+    get_metrics().record_embeddings(container.settings.embedder.provider, stats.chunks_embedded)
+    return web.json_response({"status": "ok", "stats": stats.to_dict()})
+
+
+async def clear(request: web.Request) -> web.Response:
+    container: DependencyContainer = request.app["container"]
+    n = await asyncio.to_thread(container.ingestor.clear)
+    return web.json_response({"status": "ok", "documents_removed": n})
+
+
+async def health(request: web.Request) -> web.Response:
+    return web.json_response(request.app["container"].health_handler.basic())
+
+
+async def health_detailed(request: web.Request) -> web.Response:
+    report = await request.app["container"].health_handler.detailed()
+    status = 200 if report["status"] == "healthy" else 503
+    return web.json_response(report, status=status)
+
+
+async def health_ready(request: web.Request) -> web.Response:
+    report = request.app["container"].health_handler.ready()
+    return web.json_response(report, status=200 if report["ready"] else 503)
+
+
+async def health_live(request: web.Request) -> web.Response:
+    return web.json_response(request.app["container"].health_handler.live())
+
+
+async def info(request: web.Request) -> web.Response:
+    container: DependencyContainer = request.app["container"]
+    settings = container.settings
+    engine = container.engine
+    return web.json_response(
+        {
+            "service": "sentio-tpu",
+            "version": __import__("sentio_tpu").__version__,
+            "retrieval": {
+                "strategy": settings.retrieval.strategy,
+                "fusion": settings.retrieval.fusion_method,
+                "top_k": settings.retrieval.top_k,
+                "corpus_size": container.dense_index.size,
+            },
+            "reranker": {"enabled": settings.rerank.enabled, "kind": settings.rerank.kind},
+            "generator": {
+                "provider": settings.generator.provider,
+                "preset": settings.generator.model_preset,
+                "verifier": settings.generator.use_verifier,
+            },
+            "device": engine.device_stats() if engine is not None else None,
+        }
+    )
+
+
+async def metrics_endpoint(request: web.Request) -> web.Response:
+    return web.Response(
+        body=get_metrics().export_prometheus(),
+        content_type="text/plain",
+        charset="utf-8",
+    )
+
+
+async def metrics_performance(request: web.Request) -> web.Response:
+    from sentio_tpu.infra.monitoring import performance_monitor, resource_monitor
+
+    return web.json_response(
+        {
+            "metrics": get_metrics().export_json(),
+            "system": performance_monitor.collect_system(),
+            "verdict": resource_monitor.health_verdict(),
+        }
+    )
+
+
+async def auth_token(request: web.Request) -> web.Response:
+    """Password → JWT pair (reference auth flow, utils/auth.py there)."""
+    container: DependencyContainer = request.app["container"]
+    auth = container.auth_manager
+    if auth is None:
+        raise web.HTTPNotFound(
+            text=json.dumps({"error": "auth disabled"}), content_type="application/json"
+        )
+    body = await _json_body(request)
+    username = body.get("username", "")
+    password = body.get("password", "")
+    tokens = auth.authenticate(username, password)
+    return web.json_response(tokens)
+
+
+# ------------------------------------------------------------------ assembly
+
+
+def create_app(
+    container: Optional[DependencyContainer] = None,
+    settings: Optional[Settings] = None,
+    initialize: bool = True,
+) -> web.Application:
+    setup_log_sanitization()
+    container = container or DependencyContainer(settings=settings or get_settings())
+    set_container(container)
+
+    # security headers outermost so even synthesized error responses carry
+    # them; error handling next so every inner exception becomes JSON
+    app = web.Application(
+        middlewares=[
+            security_headers_middleware,
+            error_middleware,
+            _make_observability_middleware(container),
+            _make_auth_middleware(container),
+        ]
+    )
+    app["container"] = container
+
+    app.router.add_get("/", ui_page)
+    app.router.add_post("/chat", chat)
+    app.router.add_post("/embed", embed)
+    app.router.add_post("/clear", clear)
+    app.router.add_get("/health", health)
+    app.router.add_get("/health/detailed", health_detailed)
+    app.router.add_get("/health/ready", health_ready)
+    app.router.add_get("/health/live", health_live)
+    app.router.add_get("/info", info)
+    app.router.add_get("/metrics", metrics_endpoint)
+    app.router.add_get("/metrics/performance", metrics_performance)
+    app.router.add_post("/auth/token", auth_token)
+
+    async def on_startup(app: web.Application) -> None:
+        if initialize:
+            await asyncio.to_thread(container.initialize_all)
+
+    async def on_cleanup(app: web.Application) -> None:
+        container.cleanup()
+        set_container(None)
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def run_server(settings: Optional[Settings] = None) -> None:
+    settings = settings or get_settings()
+    app = create_app(settings=settings)
+    logger.info("serving on %s:%d", settings.serve.host, settings.serve.port)
+    web.run_app(app, host=settings.serve.host, port=settings.serve.port, print=None)

@@ -1,0 +1,315 @@
+"""DependencyContainer — lazy singletons for every serving component.
+
+Parity with /root/reference/src/core/dependencies.py:24-392 (lazy component
+properties, ordered ``initialize_all`` under a lock, ``cleanup``, module
+singleton + accessors, ``check_dependency_health``) with the TPU-critical
+inversion (SURVEY.md §3.3): the expensive state — device mesh, model
+weights, corpus embeddings in HBM — is built ONCE at startup by
+``initialize_all``, so the first ``/chat`` pays no model cold start. The
+reference instead lazily builds its graph (and scrolls the whole Qdrant
+corpus) on the first request (chat.py:38-87 there).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Optional
+
+from sentio_tpu.config import Settings, get_settings
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DependencyContainer", "get_container", "set_container"]
+
+
+class DependencyContainer:
+    """Every component is a lazy cached property; ``initialize_all`` forces
+    construction in dependency order. Tests inject fakes via the
+    constructor-style ``overrides`` mapping (the reference's
+    ``dependency_overrides`` pattern, conftest there)."""
+
+    def __init__(self, settings: Optional[Settings] = None, **overrides: Any) -> None:
+        self.settings = settings or get_settings()
+        self._cache: dict[str, Any] = dict(overrides)
+        self._lock = threading.RLock()
+        self._initialized = False
+        self.started_at = time.time()
+
+    def _get(self, name: str, build) -> Any:
+        with self._lock:
+            if name not in self._cache:
+                self._cache[name] = build()
+            return self._cache[name]
+
+    def override(self, name: str, value: Any) -> None:
+        with self._lock:
+            self._cache[name] = value
+
+    # ------------------------------------------------------------ components
+
+    @property
+    def mesh(self):
+        def build():
+            cfg = self.settings.mesh
+            if cfg.dp_size == 0 and cfg.tp_size <= 1 and cfg.sp_size <= 1:
+                import jax
+
+                if len(jax.devices()) <= 1:
+                    return None  # single chip: skip mesh machinery entirely
+            from sentio_tpu.parallel.mesh import build_mesh
+
+            return build_mesh(cfg)
+
+        return self._get("mesh", build)
+
+    @property
+    def embedder(self):
+        def build():
+            from sentio_tpu.ops.embedder import get_embedder
+
+            return get_embedder(self.settings.embedder, mesh=self.mesh)
+
+        return self._get("embedder", build)
+
+    @property
+    def dense_index(self):
+        def build():
+            from sentio_tpu.ops.dense_index import TpuDenseIndex
+
+            return TpuDenseIndex(
+                dim=self.embedder.dimension,
+                mesh=self.mesh,
+                dtype=self.settings.generator.dtype,
+            )
+
+        return self._get("dense_index", build)
+
+    @property
+    def sparse_index(self):
+        def build():
+            from sentio_tpu.ops.bm25 import BM25Index, BM25Params
+
+            cfg = self.settings.retrieval
+            return BM25Index(params=BM25Params(k1=cfg.bm25_k1, b=cfg.bm25_b))
+
+        return self._get("sparse_index", build)
+
+    @property
+    def retriever(self):
+        def build():
+            from sentio_tpu.ops.retrievers import create_retriever
+
+            return create_retriever(
+                settings=self.settings,
+                embedder=self.embedder,
+                dense_index=self.dense_index,
+                bm25_index=self.sparse_index,
+            )
+
+        return self._get("retriever", build)
+
+    @property
+    def reranker(self):
+        def build():
+            if not self.settings.rerank.enabled:
+                return None
+            from sentio_tpu.ops.reranker import get_reranker
+
+            return get_reranker(self.settings.rerank.kind, config=self.settings.rerank, mesh=self.mesh)
+
+        return self._get("reranker", build)
+
+    @property
+    def engine(self):
+        def build():
+            cfg = self.settings.generator
+            if cfg.provider != "tpu":
+                return None
+            from sentio_tpu.models.llama import LlamaConfig
+            from sentio_tpu.runtime.engine import GeneratorEngine
+
+            model_cfg = LlamaConfig.tiny() if cfg.model_preset == "tiny" else None
+            return GeneratorEngine(config=cfg, model_config=model_cfg, mesh=self.mesh)
+
+        return self._get("engine", build)
+
+    @property
+    def generator(self):
+        def build():
+            from sentio_tpu.ops.generator import create_generator
+
+            return create_generator(settings=self.settings, engine=self.engine)
+
+        return self._get("generator", build)
+
+    @property
+    def verifier(self):
+        def build():
+            if not self.settings.generator.use_verifier:
+                return None
+            from sentio_tpu.ops.verifier import AnswerVerifier
+
+            return AnswerVerifier(generator=self.generator, config=self.settings.generator)
+
+        return self._get("verifier", build)
+
+    @property
+    def graph(self):
+        def build():
+            from sentio_tpu.graph.factory import GraphConfig, build_basic_graph
+
+            return build_basic_graph(
+                self.retriever,
+                self.generator,
+                reranker=self.reranker,
+                verifier=self.verifier,
+                config=GraphConfig.from_settings(self.settings),
+            )
+
+        return self._get("graph", build)
+
+    @property
+    def ingestor(self):
+        def build():
+            from sentio_tpu.ops.ingest import DocumentIngestor
+
+            return DocumentIngestor(
+                embedder=self.embedder,
+                dense_index=self.dense_index,
+                sparse_index=self.sparse_index,
+                settings=self.settings,
+            )
+
+        return self._get("ingestor", build)
+
+    @property
+    def cache_manager(self):
+        def build():
+            from sentio_tpu.infra.caching import CacheManager
+
+            return CacheManager(config=self.settings.cache)
+
+        return self._get("cache_manager", build)
+
+    @property
+    def auth_manager(self):
+        def build():
+            if not self.settings.auth.enabled:
+                return None
+            from sentio_tpu.infra.auth import AuthManager
+
+            return AuthManager(config=self.settings.auth)
+
+        return self._get("auth_manager", build)
+
+    @property
+    def rate_limiter(self):
+        def build():
+            from sentio_tpu.infra.security import IPRateLimiter, RateLimitConfig
+
+            limiter = IPRateLimiter(
+                default=RateLimitConfig(per_minute=self.settings.serve.rate_limit_default_per_min)
+            )
+            limiter.configure("/embed", self.settings.serve.rate_limit_embed_per_min)
+            return limiter
+
+        return self._get("rate_limiter", build)
+
+    @property
+    def metrics(self):
+        def build():
+            from sentio_tpu.infra.metrics import get_metrics
+
+            return get_metrics()
+
+        return self._get("metrics", build)
+
+    @property
+    def chat_handler(self):
+        def build():
+            from sentio_tpu.serve.handlers import ChatHandler
+
+            return ChatHandler(container=self)
+
+        return self._get("chat_handler", build)
+
+    @property
+    def health_handler(self):
+        def build():
+            from sentio_tpu.serve.handlers import HealthHandler
+
+            return HealthHandler(container=self)
+
+        return self._get("health_handler", build)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def initialize_all(self) -> None:
+        """Eagerly build the whole stack in dependency order: mesh → models
+        (weights onto HBM) → indexes → graph → handlers. Idempotent."""
+        with self._lock:
+            if self._initialized:
+                return
+            t0 = time.perf_counter()
+            order = [
+                "mesh", "embedder", "dense_index", "sparse_index", "retriever",
+                "reranker", "engine", "generator", "verifier", "graph",
+                "ingestor", "cache_manager", "auth_manager", "rate_limiter",
+                "metrics", "chat_handler", "health_handler",
+            ]
+            for name in order:
+                getattr(self, name)
+                logger.debug("container: %s ready", name)
+            self._initialized = True
+            logger.info("container initialized in %.1fs", time.perf_counter() - t0)
+
+    def cleanup(self) -> None:
+        with self._lock:
+            self._cache.clear()
+            self._initialized = False
+
+    def check_dependency_health(self) -> dict[str, Any]:
+        """DI-level health map (reference: dependencies.py:346-379 there)."""
+        out: dict[str, Any] = {}
+        try:
+            out["dense_index"] = {"healthy": True, "size": self.dense_index.size}
+        except Exception as exc:  # noqa: BLE001
+            out["dense_index"] = {"healthy": False, "error": str(exc)}
+        try:
+            out["sparse_index"] = {"healthy": True, "size": self.sparse_index.size}
+        except Exception as exc:  # noqa: BLE001
+            out["sparse_index"] = {"healthy": False, "error": str(exc)}
+        try:
+            vec = self.embedder.embed("health probe")
+            out["embedder"] = {"healthy": len(vec) == self.embedder.dimension}
+        except Exception as exc:  # noqa: BLE001
+            out["embedder"] = {"healthy": False, "error": str(exc)}
+        try:
+            engine = self.engine
+            out["engine"] = (
+                {"healthy": True, **engine.device_stats()} if engine is not None
+                else {"healthy": True, "provider": self.settings.generator.provider}
+            )
+        except Exception as exc:  # noqa: BLE001
+            out["engine"] = {"healthy": False, "error": str(exc)}
+        return out
+
+
+_container: Optional[DependencyContainer] = None
+_container_lock = threading.Lock()
+
+
+def get_container() -> DependencyContainer:
+    global _container
+    with _container_lock:
+        if _container is None:
+            _container = DependencyContainer()
+        return _container
+
+
+def set_container(container: Optional[DependencyContainer]) -> None:
+    global _container
+    with _container_lock:
+        _container = container

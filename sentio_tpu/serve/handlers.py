@@ -1,0 +1,200 @@
+"""Request handlers: chat (with the degradation ladder) and health.
+
+Parity with /root/reference/src/api/handlers/chat.py:25-274 and
+health.py:20-344: the chat handler builds pipeline state with per-request
+``user_top_k``/temperature metadata, invokes the graph, serializes cited
+sources, and on ANY failure walks the 3-tier ladder — cached response →
+template fallback → apology — so the endpoint never 500s on pipeline
+errors. The health handler runs component probes concurrently with an
+overall timeout and caches results for 10 s. TPU additions: device health
+(mesh, HBM headroom) rides the detailed report.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+import uuid
+from typing import Any, Optional
+
+from sentio_tpu.graph.state import create_initial_state
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ChatHandler", "HealthHandler"]
+
+
+class ChatHandler:
+    """Graph-invoking chat processor with soft-fail semantics."""
+
+    def __init__(self, container) -> None:
+        self.container = container
+        self.settings = container.settings
+        self._fallback = None
+
+    @property
+    def fallback(self):
+        if self._fallback is None:
+            from sentio_tpu.infra.resilience import FallbackResponseCache, LLMFallback
+
+            self._fallback = (FallbackResponseCache(), LLMFallback())
+        return self._fallback
+
+    # ----------------------------------------------------------------- sync
+
+    def process_chat_request_sync(
+        self,
+        question: str,
+        top_k: Optional[int] = None,
+        temperature: Optional[float] = None,
+        mode: str = "balanced",
+        thread_id: Optional[str] = None,
+    ) -> dict[str, Any]:
+        t0 = time.perf_counter()
+        query_id = thread_id or uuid.uuid4().hex[:12]
+        metadata: dict[str, Any] = {"query_id": query_id, "mode": mode}
+        if top_k is not None:
+            metadata["user_top_k"] = top_k
+        if temperature is not None:
+            metadata["temperature"] = temperature
+
+        cache = self.container.cache_manager
+        try:
+            state = self.container.graph.invoke(
+                create_initial_state(question, metadata=metadata),
+                config={"thread_id": query_id},
+            )
+            answer = state.get("response", "")
+            if not answer:
+                raise RuntimeError("pipeline produced an empty response")
+            result = {
+                "answer": answer,
+                "sources": self._serialize_sources(state),
+                "metadata": {
+                    **state.get("metadata", {}),
+                    "query_id": query_id,
+                    "latency_ms": round((time.perf_counter() - t0) * 1000.0, 1),
+                    "degraded": False,
+                },
+            }
+            if state.get("evaluation"):
+                result["metadata"]["evaluation"] = state["evaluation"]
+            cache.set_query_response(question, result)
+            disk_cache, _ = self.fallback
+            disk_cache.put(question, answer)
+            return result
+        except Exception as exc:  # noqa: BLE001 — ladder, never a 500
+            logger.warning("chat pipeline failed (%s); degrading", exc)
+            return self._degraded_response(question, query_id, str(exc), t0)
+
+    def _degraded_response(
+        self, question: str, query_id: str, error: str, t0: float
+    ) -> dict[str, Any]:
+        """cached → template → apology (reference chat.py:195-239 there)."""
+        meta = {
+            "query_id": query_id,
+            "degraded": True,
+            "error": error,
+            "latency_ms": round((time.perf_counter() - t0) * 1000.0, 1),
+        }
+        cached = self.container.cache_manager.get_query_response(question)
+        if cached and cached.get("answer"):
+            return {**cached, "metadata": {**cached.get("metadata", {}), **meta, "tier": "query_cache"}}
+        disk_cache, llm_fallback = self.fallback
+        disk_hit = disk_cache.get(question)
+        if disk_hit:
+            return {"answer": disk_hit, "sources": [], "metadata": {**meta, "tier": "disk_cache"}}
+        template = llm_fallback.no_llm(question)
+        if template:
+            return {"answer": template, "sources": [], "metadata": {**meta, "tier": "template"}}
+        return {"answer": llm_fallback.apology(), "sources": [], "metadata": {**meta, "tier": "apology"}}
+
+    @staticmethod
+    def _serialize_sources(state: dict) -> list[dict[str, Any]]:
+        """Cited sources from the best doc set (reference chat.py:158-166)."""
+        from sentio_tpu.graph.state import best_documents
+
+        out = []
+        for doc in best_documents(state):
+            out.append(
+                {
+                    "id": doc.id,
+                    "text": doc.text[:500],
+                    "score": doc.score(),
+                    "metadata": {
+                        k: v for k, v in doc.metadata.items()
+                        if k in ("source", "filename", "score", "hybrid_score", "rerank_score")
+                    },
+                }
+            )
+        return out
+
+    # ---------------------------------------------------------------- async
+
+    async def process_chat_request(self, **kwargs) -> dict[str, Any]:
+        """The pipeline is synchronous device dispatch; keep the event loop
+        free by running it on a worker thread."""
+        return await asyncio.to_thread(self.process_chat_request_sync, **kwargs)
+
+
+class HealthHandler:
+    """basic / detailed / ready / live with a 10 s result cache."""
+
+    CACHE_TTL_S = 10.0
+    PROBE_TIMEOUT_S = 30.0
+
+    def __init__(self, container) -> None:
+        self.container = container
+        self._cached: Optional[dict[str, Any]] = None
+        self._cached_at = 0.0
+        self._lock = asyncio.Lock()
+
+    def basic(self) -> dict[str, Any]:
+        return {
+            "status": "healthy",
+            "service": "sentio-tpu",
+            "uptime_s": round(time.time() - self.container.started_at, 1),
+        }
+
+    def live(self) -> dict[str, Any]:
+        return {"status": "alive"}
+
+    def ready(self) -> dict[str, Any]:
+        """Readiness = the container finished eager init (mesh + weights)."""
+        ready = self.container._initialized
+        return {"status": "ready" if ready else "initializing", "ready": ready}
+
+    async def detailed(self) -> dict[str, Any]:
+        async with self._lock:
+            now = time.time()
+            if self._cached is not None and now - self._cached_at < self.CACHE_TTL_S:
+                return {**self._cached, "cached": True}
+            try:
+                components = await asyncio.wait_for(
+                    asyncio.to_thread(self.container.check_dependency_health),
+                    timeout=self.PROBE_TIMEOUT_S,
+                )
+            except asyncio.TimeoutError:
+                components = {"error": {"healthy": False, "error": "health probe timeout"}}
+            components["breakers"] = self._breaker_states()
+            healthy = all(
+                c.get("healthy", True) for c in components.values() if isinstance(c, dict)
+            )
+            report = {
+                **self.basic(),
+                "status": "healthy" if healthy else "degraded",
+                "components": components,
+                "cached": False,
+            }
+            self._cached, self._cached_at = report, now
+            return report
+
+    @staticmethod
+    def _breaker_states() -> dict[str, Any]:
+        try:
+            from sentio_tpu.infra.resilience import registered_breakers
+
+            return {name: b.health() for name, b in registered_breakers().items()}
+        except ImportError:
+            return {}

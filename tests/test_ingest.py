@@ -1,0 +1,152 @@
+"""Ingestion pipeline tests: loaders, directory walk, chunk→embed→index.
+
+Mirrors the reference's ingest suite (src/tests/ingest/
+test_document_ingestor_comprehensive.py there) with the hash-embedder fake
+backend (SURVEY.md §4) — full pipeline, no device model needed.
+"""
+
+import json
+import zipfile
+
+import pytest
+
+from sentio_tpu.config import EmbedderConfig, Settings
+from sentio_tpu.models.document import Document
+from sentio_tpu.ops.bm25 import BM25Index
+from sentio_tpu.ops.dense_index import TpuDenseIndex
+from sentio_tpu.ops.embedder import HashEmbedder
+from sentio_tpu.ops.ingest import DocumentIngestor, IngestError, ingest_directory
+
+
+@pytest.fixture()
+def ingestor(settings):
+    settings.embedder = EmbedderConfig(provider="hash", dim=64)
+    embedder = HashEmbedder(settings.embedder)
+    return DocumentIngestor(
+        embedder=embedder,
+        dense_index=TpuDenseIndex(dim=64),
+        sparse_index=BM25Index(),
+        settings=settings,
+    )
+
+
+class TestLoaders:
+    def test_txt_and_md(self, ingestor, tmp_path):
+        (tmp_path / "a.txt").write_text("plain text body")
+        (tmp_path / "b.md").write_text("# Title\n\nmarkdown body")
+        docs = ingestor.load_directory(tmp_path)
+        assert {d.metadata["format"] for d in docs} == {"txt", "md"}
+        assert any("markdown body" in d.text for d in docs)
+
+    def test_html_strips_tags_and_scripts(self, ingestor, tmp_path):
+        (tmp_path / "page.html").write_text(
+            "<html><head><script>var x=1;</script><style>.c{}</style></head>"
+            "<body><h1>Heading</h1><p>visible text</p></body></html>"
+        )
+        [doc] = ingestor.load_file(tmp_path / "page.html")
+        assert "visible text" in doc.text and "Heading" in doc.text
+        assert "var x" not in doc.text and ".c{}" not in doc.text
+
+    def test_json_extracts_string_leaves(self, ingestor, tmp_path):
+        (tmp_path / "d.json").write_text(json.dumps(
+            {"title": "doc title", "nested": {"body": ["part one", "part two"]}, "n": 7}
+        ))
+        [doc] = ingestor.load_file(tmp_path / "d.json")
+        assert "doc title" in doc.text and "part two" in doc.text and "7" not in doc.text
+
+    def test_jsonl(self, ingestor, tmp_path):
+        (tmp_path / "d.jsonl").write_text('{"text": "line one"}\n{"text": "line two"}\n')
+        [doc] = ingestor.load_file(tmp_path / "d.jsonl")
+        assert "line one" in doc.text and "line two" in doc.text
+
+    def test_yaml(self, ingestor, tmp_path):
+        (tmp_path / "c.yaml").write_text("title: yaml title\nitems:\n  - alpha\n  - beta\n")
+        [doc] = ingestor.load_file(tmp_path / "c.yaml")
+        assert "yaml title" in doc.text and "beta" in doc.text
+
+    def test_csv_tsv(self, ingestor, tmp_path):
+        (tmp_path / "t.csv").write_text("name,role\nada,engineer\n")
+        [doc] = ingestor.load_file(tmp_path / "t.csv")
+        assert "ada engineer" in doc.text
+
+    def test_docx_via_zipfile(self, ingestor, tmp_path):
+        path = tmp_path / "w.docx"
+        xml = (
+            '<?xml version="1.0"?><w:document><w:body>'
+            "<w:p><w:r><w:t>first paragraph</w:t></w:r></w:p>"
+            "<w:p><w:r><w:t>second</w:t></w:r><w:r><w:t> half</w:t></w:r></w:p>"
+            "</w:body></w:document>"
+        )
+        with zipfile.ZipFile(path, "w") as zf:
+            zf.writestr("word/document.xml", xml)
+        [doc] = ingestor.load_file(path)
+        assert doc.text == "first paragraph\nsecond half"
+
+    def test_bad_docx_raises(self, ingestor, tmp_path):
+        path = tmp_path / "bad.docx"
+        path.write_bytes(b"not a zip")
+        with pytest.raises(IngestError):
+            ingestor.load_file(path)
+
+    def test_pdf_gated_with_clear_error(self, ingestor, tmp_path):
+        path = tmp_path / "x.pdf"
+        path.write_bytes(b"%PDF-1.4")
+        with pytest.raises(IngestError, match="PyPDF2"):
+            ingestor.load_file(path)
+
+    def test_unknown_suffix_skipped_in_directory(self, ingestor, tmp_path):
+        (tmp_path / "keep.txt").write_text("keep me")
+        (tmp_path / "skip.bin").write_bytes(b"\x00\x01")
+        docs = ingestor.load_directory(tmp_path)
+        assert len(docs) == 1
+        assert ingestor.stats.files_skipped == 1
+
+    def test_recursive_walk(self, ingestor, tmp_path):
+        sub = tmp_path / "nested" / "deep"
+        sub.mkdir(parents=True)
+        (sub / "leaf.md").write_text("deep leaf")
+        assert len(ingestor.load_directory(tmp_path)) == 1
+        assert len(ingestor.load_directory(tmp_path, recursive=False)) == 0
+
+
+class TestIngestPipeline:
+    def test_chunks_embedded_and_indexed(self, ingestor):
+        text = "sentence about tpus. " * 200  # forces multiple chunks
+        stats = ingestor.ingest_documents([Document(text=text, metadata={"source": "mem"})])
+        assert stats.chunks_created > 1
+        assert stats.chunks_stored == stats.chunks_created
+        assert ingestor.dense_index.size == stats.chunks_stored
+        # sparse index rebuilt over the same corpus
+        assert ingestor._sparse_index.size == stats.chunks_stored
+
+    def test_single_document_path(self, ingestor):
+        stats = ingestor.ingest_document("short body", {"source": "api"})
+        assert stats.chunks_stored == 1
+        [doc] = ingestor.dense_index.documents()
+        assert doc.metadata["source"] == "api"
+        assert doc.metadata["parent_id"]
+
+    def test_empty_chunks_dropped(self, ingestor):
+        stats = ingestor.ingest_documents([Document(text="   \n  ")])
+        assert stats.chunks_stored == 0
+
+    def test_retrieval_after_ingest(self, ingestor):
+        ingestor.ingest_documents([
+            Document(text="jax compiles to xla for tpus", id="d1"),
+            Document(text="bm25 ranks by term frequency", id="d2"),
+        ])
+        hits = ingestor._sparse_index.retrieve("term frequency ranking bm25", top_k=1)
+        assert hits and hits[0].metadata["parent_id"] == "d2"
+
+    def test_clear(self, ingestor):
+        ingestor.ingest_document("whatever", {})
+        removed = ingestor.clear()
+        assert removed == 1
+        assert ingestor.dense_index.size == 0
+        assert ingestor._sparse_index.size == 0
+
+    def test_ingest_directory_helper(self, settings, tmp_path):
+        settings.embedder = EmbedderConfig(provider="hash", dim=32)
+        (tmp_path / "doc.txt").write_text("directory helper body")
+        stats = ingest_directory(tmp_path, settings=settings)
+        assert stats.documents_loaded == 1 and stats.chunks_stored >= 1

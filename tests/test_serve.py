@@ -1,0 +1,259 @@
+"""HTTP surface tests over the real ASGI-equivalent aiohttp app.
+
+Mirrors the reference's API test pattern (src/tests/api/conftest.py there:
+TestClient over the app with fake backends injected) — here the fakes are
+the hash embedder + echo generator, so the WHOLE stack runs: middleware,
+validation, rate limits, handlers, graph, indexes.
+"""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from sentio_tpu.config import (
+    AuthConfig,
+    EmbedderConfig,
+    GeneratorConfig,
+    RerankConfig,
+    ServeConfig,
+    Settings,
+)
+from sentio_tpu.serve.app import create_app
+from sentio_tpu.serve.dependencies import DependencyContainer
+
+
+def fast_settings(**over) -> Settings:
+    s = Settings(
+        embedder=EmbedderConfig(provider="hash", dim=32),
+        generator=GeneratorConfig(provider="echo", use_verifier=False, max_new_tokens=32),
+        rerank=RerankConfig(enabled=True, kind="passthrough"),
+    )
+    for key, value in over.items():
+        setattr(s, key, value)
+    return s
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def with_client(settings, fn, container=None):
+    container = container or DependencyContainer(settings=settings)
+    app = create_app(container=container)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client, container)
+    finally:
+        await client.close()
+
+
+async def seed(client, texts):
+    for text in texts:
+        resp = await client.post("/embed", json={"content": text})
+        assert resp.status == 200, await resp.text()
+
+
+class TestChatEndpoint:
+    def test_chat_happy_path(self):
+        async def body(client, container):
+            await seed(client, ["jax compiles python to xla", "tpus have a systolic mxu"])
+            resp = await client.post("/chat", json={"question": "what compiles to xla?"})
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["answer"]
+            assert isinstance(data["sources"], list) and data["sources"]
+            assert data["metadata"]["degraded"] is False
+            assert "latency_ms" in data["metadata"]
+
+        run(with_client(fast_settings(), body))
+
+    def test_chat_validation_errors(self):
+        async def body(client, container):
+            for payload, field in [
+                ({}, "question"),
+                ({"question": ""}, "question"),
+                ({"question": "x" * 3000}, "question"),
+                ({"question": "ok", "top_k": 0}, "top_k"),
+                ({"question": "ok", "top_k": 99}, "top_k"),
+                ({"question": "ok", "temperature": 3.0}, "temperature"),
+                ({"question": "ok", "mode": "bogus"}, "mode"),
+            ]:
+                resp = await client.post("/chat", json=payload)
+                assert resp.status == 422, (payload, resp.status)
+                data = await resp.json()
+                assert any(e["field"] == field for e in data["details"])
+
+        run(with_client(fast_settings(), body))
+
+    def test_chat_user_top_k_respected(self):
+        async def body(client, container):
+            await seed(client, [f"fact number {i} about topic" for i in range(8)])
+            resp = await client.post("/chat", json={"question": "facts about topic", "top_k": 2})
+            data = await resp.json()
+            assert len(data["sources"]) <= 2
+
+        run(with_client(fast_settings(), body))
+
+    def test_degradation_ladder_never_500s(self):
+        class Boom:
+            def invoke(self, *a, **k):
+                raise RuntimeError("device on fire")
+
+        async def body(client, container):
+            container.override("graph", Boom())
+            resp = await client.post("/chat", json={"question": "anything at all"})
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["metadata"]["degraded"] is True
+            assert data["metadata"]["tier"] in ("query_cache", "disk_cache", "template", "apology")
+            assert data["answer"]
+
+        run(with_client(fast_settings(), body))
+
+    def test_chat_stream_sse(self):
+        async def body(client, container):
+            await seed(client, ["streaming tokens over sse"])
+            resp = await client.post(
+                "/chat", json={"question": "stream me an answer", "stream": True}
+            )
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/event-stream")
+            raw = (await resp.read()).decode()
+            assert "data:" in raw and "[DONE]" in raw
+
+        run(with_client(fast_settings(), body))
+
+
+class TestEmbedAndClear:
+    def test_embed_validates_and_indexes(self):
+        async def body(client, container):
+            resp = await client.post("/embed", json={"content": "a document body"})
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["stats"]["chunks_stored"] == 1
+            assert container.dense_index.size == 1
+
+            resp = await client.post("/embed", json={"content": ""})
+            assert resp.status == 422
+
+        run(with_client(fast_settings(), body))
+
+    def test_embed_rate_limited(self):
+        settings = fast_settings(
+            serve=ServeConfig(rate_limit_embed_per_min=3, rate_limit_default_per_min=100)
+        )
+
+        async def body(client, container):
+            statuses = []
+            for i in range(5):
+                resp = await client.post("/embed", json={"content": f"doc {i}"})
+                statuses.append(resp.status)
+            assert statuses[:3] == [200, 200, 200]
+            assert 429 in statuses[3:]
+            limited = await client.post("/embed", json={"content": "one more"})
+            assert limited.headers.get("Retry-After")
+
+        run(with_client(settings, body))
+
+    def test_clear(self):
+        async def body(client, container):
+            await seed(client, ["to be deleted"])
+            resp = await client.post("/clear")
+            assert resp.status == 200
+            assert (await resp.json())["documents_removed"] == 1
+            assert container.dense_index.size == 0
+
+        run(with_client(fast_settings(), body))
+
+
+class TestHealthAndInfo:
+    def test_health_suite(self):
+        async def body(client, container):
+            basic = await client.get("/health")
+            assert basic.status == 200
+            assert (await basic.json())["status"] == "healthy"
+
+            live = await client.get("/health/live")
+            assert (await live.json())["status"] == "alive"
+
+            ready = await client.get("/health/ready")
+            assert ready.status == 200  # create_app initializes eagerly
+
+            detailed = await client.get("/health/detailed")
+            assert detailed.status == 200
+            report = await detailed.json()
+            assert report["components"]["embedder"]["healthy"]
+            assert report["components"]["dense_index"]["healthy"]
+            # second call inside the 10s window is served from cache
+            again = await (await client.get("/health/detailed")).json()
+            assert again["cached"] is True
+
+        run(with_client(fast_settings(), body))
+
+    def test_info(self):
+        async def body(client, container):
+            resp = await client.get("/info")
+            data = await resp.json()
+            assert data["service"] == "sentio-tpu"
+            assert data["retrieval"]["strategy"] == "hybrid"
+            assert data["generator"]["provider"] == "echo"
+
+        run(with_client(fast_settings(), body))
+
+    def test_metrics_endpoints(self):
+        async def body(client, container):
+            await client.post("/chat", json={"question": "count this request"})
+            prom = await client.get("/metrics")
+            assert prom.status == 200
+            assert "requests" in (await prom.text())
+            perf = await client.get("/metrics/performance")
+            assert perf.status == 200
+            assert "metrics" in await perf.json()
+
+        run(with_client(fast_settings(), body))
+
+    def test_security_headers(self):
+        async def body(client, container):
+            resp = await client.get("/health")
+            assert resp.headers["X-Content-Type-Options"] == "nosniff"
+            assert resp.headers["X-Frame-Options"] == "DENY"
+
+        run(with_client(fast_settings(), body))
+
+    def test_ui_page(self):
+        async def body(client, container):
+            resp = await client.get("/")
+            assert resp.status == 200
+            assert "sentio-tpu" in await resp.text()
+
+        run(with_client(fast_settings(), body))
+
+
+class TestAuth:
+    def test_auth_flow(self):
+        settings = fast_settings(auth=AuthConfig(enabled=True, jwt_secret="s" * 32))
+
+        async def body(client, container):
+            # protected endpoint rejects anonymous
+            resp = await client.post("/chat", json={"question": "who goes there"})
+            assert resp.status == 401
+            # health stays open
+            assert (await client.get("/health")).status == 200
+
+            container.auth_manager.create_user("ada", "Correct-Horse-Battery-9", role="admin")
+            tok = await client.post(
+                "/auth/token", json={"username": "ada", "password": "Correct-Horse-Battery-9"}
+            )
+            assert tok.status == 200
+            access = (await tok.json())["access_token"]
+
+            ok = await client.post(
+                "/chat",
+                json={"question": "authorized now"},
+                headers={"Authorization": f"Bearer {access}"},
+            )
+            assert ok.status == 200
+
+        run(with_client(settings, body))
