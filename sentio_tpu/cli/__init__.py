@@ -39,6 +39,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         settings.serve.host = args.host
     if args.port:
         settings.serve.port = args.port
+    if args.index:
+        settings.retrieval.index_path = args.index
     run_server(settings)
     return 0
 
@@ -90,6 +92,7 @@ def main(argv: list[str] | None = None) -> int:
     p_serve = sub.add_parser("serve", help="run the API server (UI at /)")
     p_serve.add_argument("--host", default="")
     p_serve.add_argument("--port", type=int, default=0)
+    p_serve.add_argument("--index", default="", help="load a persisted dense index (from ingest --save)")
     p_serve.set_defaults(fn=_cmd_serve)
 
     p_bench = sub.add_parser("bench", help="run the end-to-end benchmark")

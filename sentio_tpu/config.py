@@ -109,6 +109,9 @@ class RetrievalConfig:
     # dense index
     index_backend: str = "tpu"  # tpu | qdrant
     collection_name: str = "sentio"
+    # persisted TpuDenseIndex to load at startup ("" = start empty); BM25
+    # rehydrates from the loaded documents
+    index_path: str = ""
 
     @classmethod
     def from_env(cls) -> "RetrievalConfig":
@@ -129,6 +132,7 @@ class RetrievalConfig:
             bm25_backend=_env_str(["BM25_BACKEND"], "auto"),
             index_backend=_env_str(["INDEX_BACKEND", "VECTOR_STORE"], "tpu"),
             collection_name=_env_str(["COLLECTION_NAME", "QDRANT_COLLECTION"], "sentio"),
+            index_path=_env_str(["INDEX_PATH"], ""),
         )
 
 

@@ -1,0 +1,50 @@
+"""Pallas/TPU kernels: flash attention, ring (sequence-parallel) attention.
+
+Every kernel has an XLA fallback (models/layers.py:attention) so the whole
+framework runs on CPU; the kernels take over on TPU where the problem size
+pays for them. ``flash_attn_fn`` is the adapter signature models accept
+(``llama_forward(..., attn_fn=...)``): (q, k, v, kv_lens) → [B, T, H, D]
+with causal semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from sentio_tpu.kernels.flash_attention import attention_auto, flash_attention
+from sentio_tpu.kernels.ring_attention import ring_attention, ring_attention_sharded
+
+__all__ = [
+    "flash_attention",
+    "attention_auto",
+    "ring_attention",
+    "ring_attention_sharded",
+    "flash_attn_fn",
+    "make_ring_attn_fn",
+    "default_attn_fn",
+]
+
+
+def flash_attn_fn(q, k, v, kv_lens=None):
+    """Causal flash attention adapter for ``llama_forward(attn_fn=...)``."""
+    interpret = jax.default_backend() != "tpu"
+    return flash_attention(q, k, v, kv_lens, causal=True, interpret=interpret)
+
+
+def make_ring_attn_fn(axis_name: str):
+    """Ring-attention adapter for use INSIDE shard_map over ``axis_name``
+    (sequence axis). kv_lens unsupported: SP serves long, unpadded contexts."""
+
+    def fn(q, k, v, kv_lens=None):
+        if kv_lens is not None:
+            raise ValueError("ring attention path expects unpadded sequences")
+        return ring_attention(q, k, v, axis_name=axis_name, causal=True)
+
+    return fn
+
+
+def default_attn_fn():
+    """Flash on TPU, None (XLA fallback) elsewhere."""
+    if jax.default_backend() == "tpu":
+        return flash_attn_fn
+    return None

@@ -1,0 +1,202 @@
+"""Pallas flash attention for TPU: blockwise online-softmax, O(T) memory.
+
+The reference never runs attention at all — its models are remote HTTP
+services (SURVEY.md §0). In this framework attention is the dominant FLOP
+consumer of the generator/verifier (models/llama.py) and the encoders, so
+the prefill/scoring path gets a proper TPU kernel:
+
+* grid ``(B*H, T/block_q, S/block_k)``; the k dimension is sequential
+  ("arbitrary"), carrying running max ``m``, normalizer ``l`` and the
+  accumulator in fp32 VMEM scratch across k-blocks — the classic
+  flash-attention recurrence, never materializing the [T, S] score matrix;
+* q·kᵀ and p·v land on the MXU in the input dtype (bf16) with fp32
+  accumulation (``preferred_element_type``);
+* causal block skipping: k-blocks strictly above the diagonal are masked
+  wholesale (their contribution is exp(-inf)=0) — and the per-element mask
+  handles the diagonal blocks;
+* variable-length rows via ``kv_lens`` [B]: key positions ≥ len score -inf
+  (the prefill padding mask).
+
+On CPU (tests, dev) the same kernel runs in Pallas interpret mode;
+:func:`attention_auto` picks kernel vs. the XLA fallback by platform and
+problem size.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float(np.finfo(np.float32).min)
+
+__all__ = ["flash_attention", "attention_auto"]
+
+
+def _flash_kernel(
+    lens_ref,  # [1] int32 in SMEM — this row's valid kv length
+    q_ref,     # [block_q, d]
+    k_ref,     # [block_k, d]
+    v_ref,     # [block_k, d]
+    o_ref,     # [block_q, d]
+    m_ref,     # [block_q, 1] scratch fp32
+    l_ref,     # [block_q, 1] scratch fp32
+    acc_ref,   # [block_q, d] scratch fp32
+    *,
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # block-level causal skip: the whole k-block is in the future
+    run = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[:]
+        k = k_ref[:]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [bq, bk]
+
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = k_pos < lens_ref[0]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            valid = valid & (k_pos <= q_pos)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be exp(0)=1
+        p = jnp.exp(jnp.where(m_new > NEG_INF / 2, s - m_new, NEG_INF))
+        alpha = jnp.exp(jnp.where(m_new > NEG_INF / 2, m_prev - m_new, 0.0))
+
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_new
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_ref[:]
+        o_ref[:] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def _pad_to(x: jax.Array, size: int, axis: int) -> jax.Array:
+    pad = size - x.shape[axis]
+    if pad <= 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_lens: Optional[jax.Array] = None,
+    *,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """q [B,T,H,D], k/v [B,S,H,D] (kv heads already expanded) → [B,T,H,D].
+
+    ``kv_lens`` [B] int32 limits each row's attendable keys (padding).
+    Head dim is padded to a lane multiple (128) for the MXU; T/S pad to the
+    block sizes. All padding is sliced away on return.
+    """
+    b, t, h, d = q.shape
+    s = k.shape[1]
+    sm_scale = 1.0 / float(np.sqrt(d))
+    if kv_lens is None:
+        kv_lens = jnp.full((b,), s, jnp.int32)
+
+    block_q_eff = min(block_q, max(t, 16))
+    block_k_eff = min(block_k, max(s, 16))
+    t_pad = int(np.ceil(t / block_q_eff)) * block_q_eff
+    s_pad = int(np.ceil(s / block_k_eff)) * block_k_eff
+    d_pad = max(int(np.ceil(d / 128)) * 128, d) if not interpret else d
+
+    # [B,T,H,D] → [B*H, T, D] rows of independent attention problems
+    def to_rows(x, length):
+        x = _pad_to(_pad_to(x, length, 1), d_pad, 3)
+        return x.transpose(0, 2, 1, 3).reshape(b * h, length, d_pad)
+
+    qr, kr, vr = to_rows(q, t_pad), to_rows(k, s_pad), to_rows(v, s_pad)
+    lens_rows = jnp.repeat(kv_lens.astype(jnp.int32), h)  # [B*H]
+
+    grid = (b * h, t_pad // block_q_eff, s_pad // block_k_eff)
+    kernel = functools.partial(
+        _flash_kernel,
+        causal=causal,
+        sm_scale=sm_scale,
+        block_q=block_q_eff,
+        block_k=block_k_eff,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1,), lambda bh, qi, ki: (bh,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((None, block_q_eff, d_pad), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((None, block_k_eff, d_pad), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, block_k_eff, d_pad), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q_eff, d_pad), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t_pad, d_pad), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q_eff, 1), jnp.float32),   # running max m
+            pltpu.VMEM((block_q_eff, 1), jnp.float32),   # normalizer l
+            pltpu.VMEM((block_q_eff, d_pad), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(lens_rows, qr, kr, vr)
+
+    out = out.reshape(b, h, t_pad, d_pad).transpose(0, 2, 1, 3)
+    return out[:, :t, :, :d]
+
+
+def attention_auto(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask=None,
+    *,
+    causal: bool = True,
+    kv_lens: Optional[jax.Array] = None,
+    dtype=jnp.bfloat16,
+    min_seq_for_kernel: int = 256,
+):
+    """Pick the Pallas kernel on TPU for long sequences, XLA elsewhere."""
+    from sentio_tpu.models.layers import attention as xla_attention
+
+    platform = q.devices().pop().platform if hasattr(q, "devices") else "cpu"
+    t, s = q.shape[1], k.shape[1]
+    if platform == "tpu" and t >= min_seq_for_kernel and mask is None:
+        return flash_attention(q, k, v, kv_lens, causal=causal)
+    return xla_attention(q, k, v, mask, dtype)

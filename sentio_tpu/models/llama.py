@@ -122,6 +122,7 @@ def _attn(
     cache: Optional[Cache],
     cache_index: Array,
     pad_mask: Optional[Array],
+    attn_fn=None,
 ) -> tuple[Array, Optional[Cache]]:
     dt = cfg.jdtype
     b, t, d = x.shape
@@ -132,6 +133,12 @@ def _attn(
     v = L.dense(lp["wv"], x, dt).reshape(b, t, hkv, hd)
     q = L.apply_rope(q, positions, cos, sin)
     k = L.apply_rope(k, positions, cos, sin)
+
+    # kernels (flash/ring) apply for multi-token causal attention where the
+    # query block starts at position 0 (prefill writes at slot 0, training has
+    # no cache) — exactly when positions == arange(t); decode (t == 1) and
+    # ragged offsets use the masked XLA path
+    use_kernel = attn_fn is not None and t > 1
 
     if cache is not None:
         # write this step's k/v into the cache window at cache_index, which is
@@ -146,16 +153,22 @@ def _attn(
         kj = jnp.arange(s)[None, None, None, :]
         mask = kj <= positions[:, None, :, None]  # [B,1,T,S]
         k_full, v_full = k_cache, v_cache
+        kv_lens = None  # causal mask already hides the uninitialized tail
     else:
         s = t
         mask = L.causal_mask(t)
         if pad_mask is not None:
             mask = mask & pad_mask[:, None, None, :]
         k_full, v_full = k, v
+        # right-padded batches → per-row valid lengths for the kernel
+        kv_lens = pad_mask.sum(axis=1).astype(jnp.int32) if pad_mask is not None else None
 
     k_full = L.repeat_kv(k_full, h // hkv)
     v_full = L.repeat_kv(v_full, h // hkv)
-    out = L.attention(q, k_full, v_full, mask, dt).reshape(b, t, d)
+    if use_kernel:
+        out = attn_fn(q, k_full, v_full, kv_lens).reshape(b, t, d)
+    else:
+        out = L.attention(q, k_full, v_full, mask, dt).reshape(b, t, d)
     return L.dense(lp["wo"], out, dt), cache
 
 
@@ -174,6 +187,7 @@ def llama_forward(
     cache: Optional[Cache] = None,
     cache_index: Array | int = 0,
     pad_mask: Optional[Array] = None,
+    attn_fn=None,
 ) -> tuple[Array, Optional[Cache]]:
     """ids [B, T] → logits [B, T, vocab] (float32) and the updated cache.
 
@@ -182,6 +196,8 @@ def llama_forward(
     * Decode: T == 1, ``positions = [[cur]]``, ``cache_index = cur``; with a
       ragged batch, ``positions = lens[:, None]`` and ``cache_index = lens``
       ([B] vector) so each row writes/reads at its own offset.
+    * ``attn_fn`` (see sentio_tpu.kernels): flash/ring kernel used for the
+      multi-token causal paths (training + prefill); decode stays XLA.
     """
     dt = cfg.jdtype
     b, t = ids.shape
@@ -197,7 +213,7 @@ def llama_forward(
         lp = params[f"layers_{i}"]
         attn_out, cache = _attn(
             lp["attn"], cfg, L.rmsnorm(lp["attn_norm"], x, cfg.norm_eps),
-            positions, cos, sin, i, cache, cache_index, pad_mask,
+            positions, cos, sin, i, cache, cache_index, pad_mask, attn_fn,
         )
         x = x + attn_out
         x = x + _mlp(lp["mlp"], cfg, L.rmsnorm(lp["mlp_norm"], x, cfg.norm_eps))

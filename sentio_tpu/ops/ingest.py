@@ -364,8 +364,15 @@ class DocumentIngestor:
 
     def ingest_path(self, path: str | Path, recursive: bool = True) -> IngestStats:
         path = Path(path)
+        # loader failures land on the lifetime stats; snapshot around the load
+        # so THIS call's stats carry its own errors/skips (CLI exit code and
+        # /embed responses depend on per-call accuracy)
+        err0, skip0 = len(self.stats.errors), self.stats.files_skipped
         docs = self.load_directory(path, recursive=recursive) if path.is_dir() else self.load_file(path)
-        return self.ingest_documents(docs)
+        call = self.ingest_documents(docs)
+        call.errors = self.stats.errors[err0:]
+        call.files_skipped = self.stats.files_skipped - skip0
+        return call
 
     def clear(self) -> int:
         """Drop everything from both indexes; returns prior doc count."""

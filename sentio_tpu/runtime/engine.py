@@ -84,11 +84,20 @@ class GeneratorEngine:
         from sentio_tpu.runtime.sampling import sample_tokens
 
         cfg = self.model_config
+        # Pallas flash attention for the prefill pass on TPU (the multi-token
+        # causal block); decode (T=1) keeps the fused XLA path. With a TP mesh
+        # the heads are sharded — a bare pallas_call under jit would force
+        # gathers, so the kernel is single-chip-only until it runs in
+        # shard_map (ring_attention covers the sharded long-context path).
+        from sentio_tpu.kernels import default_attn_fn
+
+        attn_fn = default_attn_fn() if self.mesh is None else None
 
         @jax.jit
         def prefill(params, ids, positions, cache):
             logits, cache = llama_forward(
-                params, cfg, ids, positions=positions, cache=cache, cache_index=0
+                params, cfg, ids, positions=positions, cache=cache, cache_index=0,
+                attn_fn=attn_fn,
             )
             return logits, cache
 

@@ -17,8 +17,10 @@ Streamlit app (src/ui/streamlit_app.py there) without adding a dependency.
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import json
 import logging
+import threading
 import time
 from typing import Optional
 
@@ -118,16 +120,32 @@ async def security_headers_middleware(request: web.Request, handler):
 def _make_observability_middleware(container: DependencyContainer):
     @web.middleware
     async def observability_middleware(request: web.Request, handler):
-        """Rate limiting + request metrics (reference app.py:259-281)."""
+        """Rate limiting + request metrics (reference app.py:259-281).
+        Error responses are synthesized in the OUTER error middleware, so
+        metrics are recorded in a finally with the mapped status — error
+        rates must be visible in /metrics, not just 2xx traffic."""
         path = request.path
-        if not path.startswith(("/health", "/metrics")) and path != "/":
-            endpoint = "/embed" if path == "/embed" else "*"
-            ip = _client_ip(request, trust_proxy=container.settings.serve.trust_proxy_headers)
-            container.rate_limiter.check(ip, endpoint)
         t0 = time.perf_counter()
-        response = await handler(request)
-        get_metrics().record_request(path, response.status, time.perf_counter() - t0)
-        return response
+        status = 500
+        try:
+            if not path.startswith(("/health", "/metrics")) and path != "/":
+                endpoint = "/embed" if path == "/embed" else "*"
+                ip = _client_ip(request, trust_proxy=container.settings.serve.trust_proxy_headers)
+                container.rate_limiter.check(ip, endpoint)
+            response = await handler(request)
+            status = response.status
+            return response
+        except SchemaError:
+            status = 422
+            raise
+        except (RateLimitError, SentioError) as exc:
+            status = exc.status
+            raise
+        except web.HTTPException as exc:
+            status = exc.status
+            raise
+        finally:
+            get_metrics().record_request(path, status, time.perf_counter() - t0)
 
     return observability_middleware
 
@@ -212,35 +230,34 @@ async def _chat_stream(request: web.Request, container: DependencyContainer, req
     await response.prepare(request)
     loop = asyncio.get_running_loop()
     queue: asyncio.Queue = asyncio.Queue(maxsize=256)
+    stop = threading.Event()
 
-    def put(item) -> None:
-        # blocking put from the worker thread: a slow SSE client backpressures
-        # the decode loop instead of silently dropping tokens (or losing the
-        # 'done' sentinel and hanging the response forever)
-        asyncio.run_coroutine_threadsafe(queue.put(item), loop).result()
+    def put(item) -> bool:
+        # blocking put with backpressure AND a disconnect escape hatch: when
+        # the consumer stops draining (client gone), `stop` is set and the
+        # producer exits instead of blocking a pool thread forever
+        while not stop.is_set():
+            fut = asyncio.run_coroutine_threadsafe(queue.put(item), loop)
+            try:
+                fut.result(timeout=0.5)
+                return True
+            except concurrent.futures.TimeoutError:
+                fut.cancel()
+            except Exception:  # noqa: BLE001 — loop closed / cancelled
+                return False
+        return False
 
     def produce() -> None:
-        try:
-            gen = container.generator
-            docs = container.retriever.retrieve(
-                req.question, top_k=req.top_k or container.settings.retrieval.top_k
-            )
-            reranker = container.reranker
-            if reranker is not None and docs:
-                docs = reranker.rerank(req.question, docs, top_k=container.settings.rerank.top_k).documents
-            for piece in gen.stream(
-                req.question,
-                docs,
-                mode=req.mode,
-                temperature=req.temperature,
-            ):
-                put(("token", piece))
-            put(("done", ""))
-        except Exception as exc:  # noqa: BLE001
-            try:
-                put(("error", str(exc)))
-            except Exception:  # noqa: BLE001 — loop already closed
-                pass
+        # pipeline + degradation live in the handler, mirroring /chat
+        for piece in container.chat_handler.stream_chat_sync(
+            question=req.question,
+            top_k=req.top_k,
+            temperature=req.temperature,
+            mode=req.mode,
+        ):
+            if not put(("token", piece)):
+                return
+        put(("done", ""))
 
     task = loop.run_in_executor(None, produce)
     try:
@@ -248,13 +265,14 @@ async def _chat_stream(request: web.Request, container: DependencyContainer, req
             kind, payload = await queue.get()
             if kind == "token":
                 await response.write(f"data: {json.dumps({'token': payload})}\n\n".encode())
-            elif kind == "error":
-                await response.write(f"data: {json.dumps({'error': payload})}\n\n".encode())
-                break
             else:
                 await response.write(b"data: [DONE]\n\n")
                 break
     finally:
+        stop.set()
+        # drain so a producer blocked mid-put resolves, then join it
+        while not queue.empty():
+            queue.get_nowait()
         await task
     await response.write_eof()
     return response

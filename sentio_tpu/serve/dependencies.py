@@ -76,8 +76,17 @@ class DependencyContainer:
     @property
     def dense_index(self):
         def build():
+            from pathlib import Path
+
             from sentio_tpu.ops.dense_index import TpuDenseIndex
 
+            path = self.settings.retrieval.index_path
+            # save() writes <path>.npz + <path>.json — check the metadata file
+            if path and Path(path).with_suffix(".json").exists():
+                logger.info("loading dense index from %s", path)
+                return TpuDenseIndex.load(
+                    path, mesh=self.mesh, dtype=self.settings.generator.dtype
+                )
             return TpuDenseIndex(
                 dim=self.embedder.dimension,
                 mesh=self.mesh,
@@ -92,7 +101,11 @@ class DependencyContainer:
             from sentio_tpu.ops.bm25 import BM25Index, BM25Params
 
             cfg = self.settings.retrieval
-            return BM25Index(params=BM25Params(k1=cfg.bm25_k1, b=cfg.bm25_b))
+            index = BM25Index(params=BM25Params(k1=cfg.bm25_k1, b=cfg.bm25_b))
+            docs = self.dense_index.documents()
+            if docs:  # rehydrate from a persisted dense index
+                index.build(docs)
+            return index
 
         return self._get("sparse_index", build)
 

@@ -130,6 +130,34 @@ class ChatHandler:
             )
         return out
 
+    def stream_chat_sync(
+        self,
+        question: str,
+        top_k: Optional[int] = None,
+        temperature: Optional[float] = None,
+        mode: str = "balanced",
+    ):
+        """Token-stream generator for SSE: retrieve → rerank → stream decode.
+        The pipeline wiring lives HERE (next to the non-streaming path) so
+        the two can't drift; failures degrade to the fallback text instead
+        of surfacing raw errors to the stream (reference's ladder contract)."""
+        try:
+            docs = self.container.retriever.retrieve(
+                question, top_k=top_k or self.settings.retrieval.top_k
+            )
+            reranker = self.container.reranker
+            if reranker is not None and docs:
+                docs = reranker.rerank(
+                    question, docs, top_k=self.settings.rerank.top_k
+                ).documents
+            yield from self.container.generator.stream(
+                question, docs, mode=mode, temperature=temperature
+            )
+        except Exception as exc:  # noqa: BLE001 — ladder, never a raw error
+            logger.warning("stream pipeline failed (%s); degrading", exc)
+            result = self._degraded_response(question, "stream", str(exc), time.perf_counter())
+            yield result["answer"]
+
     # ---------------------------------------------------------------- async
 
     async def process_chat_request(self, **kwargs) -> dict[str, Any]:

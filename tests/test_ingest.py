@@ -150,3 +150,34 @@ class TestIngestPipeline:
         (tmp_path / "doc.txt").write_text("directory helper body")
         stats = ingest_directory(tmp_path, settings=settings)
         assert stats.documents_loaded == 1 and stats.chunks_stored >= 1
+
+
+class TestPersistence:
+    def test_per_call_stats_carry_loader_errors(self, ingestor, tmp_path):
+        (tmp_path / "good.txt").write_text("fine body")
+        (tmp_path / "bad.docx").write_bytes(b"not a zip")
+        stats = ingestor.ingest_path(tmp_path)
+        assert stats.chunks_stored >= 1
+        assert stats.files_skipped == 1
+        assert any("bad.docx" in e for e in stats.errors)
+
+    def test_saved_index_rehydrates_container(self, settings, tmp_path):
+        from sentio_tpu.serve.dependencies import DependencyContainer
+
+        settings.embedder = EmbedderConfig(provider="hash", dim=32)
+        ingestor = DocumentIngestor(
+            embedder=HashEmbedder(settings.embedder),
+            dense_index=TpuDenseIndex(dim=32),
+            settings=settings,
+        )
+        ingestor.ingest_document("persisted corpus entry about rings", {"source": "s"})
+        path = tmp_path / "idx"
+        ingestor.dense_index.save(path)
+
+        settings.retrieval.index_path = str(path)
+        container = DependencyContainer(settings=settings)
+        assert container.dense_index.size == 1
+        # BM25 rehydrated from the loaded documents
+        assert container.sparse_index.size == 1
+        hits = container.sparse_index.retrieve("rings", top_k=1)
+        assert hits and "rings" in hits[0].text
