@@ -1,0 +1,158 @@
+"""Pallas paged attention (decode): attention over a page-table KV cache.
+
+The continuous-batching engine (runtime/paged.py) stores KV in a pool of
+fixed-size pages; at decode each row attends over its own scattered page
+list. The XLA fallback gathers pages into a contiguous window first — an
+HBM round-trip proportional to the whole window. This kernel instead walks
+the page table directly:
+
+* the page table and row lengths ride **scalar prefetch**
+  (``pltpu.PrefetchScalarGridSpec``), so the BlockSpec index_map picks the
+  *physical* page to DMA for grid step (row b, logical block i) —
+  ``page_table[b, i]`` — and only pages the row actually owns ever leave
+  HBM;
+* grid ``(B, NB)`` with the page axis sequential, carrying the classic
+  online-softmax (m, l, acc) recurrence in fp32 VMEM scratch;
+* GQA stays folded: q is viewed [Hkv, rep, D] and both dots batch over the
+  kv-head axis, so pages are never expanded to query heads;
+* pages past a row's length are skipped wholesale (``pl.when``), the
+  current page masks per-position (key pos ≤ len — the new token's KV was
+  scattered at index ``len`` before the call).
+
+Runs in interpret mode on CPU (tests); on TPU it is the decode fast path
+once windows are long enough to beat the fused XLA gather.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float(np.finfo(np.float32).min)
+
+__all__ = ["paged_attention", "make_paged_attn_impl"]
+
+
+def _paged_kernel(
+    pt_ref,    # [B, NB] int32 scalar-prefetch — page table
+    lens_ref,  # [B] int32 scalar-prefetch — current token index per row
+    q_ref,     # [Hkv, rep, D]
+    k_ref,     # [page, Hkv, D] — the physical page chosen by index_map
+    v_ref,     # [page, Hkv, D]
+    o_ref,     # [Hkv, rep, D]
+    m_ref,     # [Hkv, rep, 1] fp32 scratch
+    l_ref,     # [Hkv, rep, 1] fp32 scratch
+    acc_ref,   # [Hkv, rep, D] fp32 scratch
+    *,
+    page: int,
+    sm_scale: float,
+):
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    cur = lens_ref[b]  # the new token sits at absolute index ``cur``
+
+    @pl.when(i * page <= cur)
+    def _block():
+        q = q_ref[:]  # [Hkv, rep, D]
+        k = k_ref[:]  # [page, Hkv, D]
+        # s[g, r, p] = q[g, r, :] · k[p, g, :]
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (1,))), preferred_element_type=jnp.float32
+        ) * sm_scale  # [Hkv, rep, page]
+
+        pos = i * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(pos <= cur, s, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        p = jnp.exp(jnp.where(m_new > NEG_INF / 2, s - m_new, NEG_INF))
+        alpha = jnp.exp(jnp.where(m_new > NEG_INF / 2, m_prev - m_new, 0.0))
+
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=2, keepdims=True)
+        # acc[g, r, :] += p[g, r, :] @ v[:, g, :]
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[:], (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_new
+
+    @pl.when(i == pl.num_programs(1) - 1)
+    def _finalize():
+        l = l_ref[:]
+        o_ref[:] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_attention(
+    q: jax.Array,           # [B, H, D] — one decode token per row
+    k_pages: jax.Array,     # [P, page, Hkv, D] — one layer's page pool
+    v_pages: jax.Array,     # [P, page, Hkv, D]
+    page_table: jax.Array,  # [B, NB] int32 physical page ids
+    lens: jax.Array,        # [B] int32 — index of the current token
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Decode attention over the paged pool → [B, H, D]."""
+    b, h, d = q.shape
+    _, page, hkv, _ = k_pages.shape
+    rep = h // hkv
+    nb = page_table.shape[1]
+    sm_scale = 1.0 / float(np.sqrt(d))
+
+    # [B, H, D] → [B, Hkv, rep, D]: group query heads under their kv head
+    q4 = q.reshape(b, hkv, rep, d)
+
+    kernel = functools.partial(_paged_kernel, page=page, sm_scale=sm_scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nb),
+        in_specs=[
+            pl.BlockSpec((None, hkv, rep, d), lambda bb, i, pt, ln: (bb, 0, 0, 0)),
+            pl.BlockSpec((None, page, hkv, d), lambda bb, i, pt, ln: (pt[bb, i], 0, 0, 0)),
+            pl.BlockSpec((None, page, hkv, d), lambda bb, i, pt, ln: (pt[bb, i], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, hkv, rep, d), lambda bb, i, pt, ln: (bb, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, rep, 1), jnp.float32),
+            pltpu.VMEM((hkv, rep, 1), jnp.float32),
+            pltpu.VMEM((hkv, rep, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rep, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lens.astype(jnp.int32), q4, k_pages, v_pages)
+    return out.reshape(b, h, d)
+
+
+def make_paged_attn_impl(interpret: bool | None = None):
+    """Adapter with the ``paged_decode_forward(attn_impl=...)`` signature:
+    (q [B,1,H,D], k_pages_l, v_pages_l, page_table, lens, n_rep) → [B,1,H,D].
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def impl(q, k_pages_l, v_pages_l, page_table, lens, n_rep):
+        out = paged_attention(
+            q[:, 0], k_pages_l, v_pages_l, page_table, lens, interpret=interpret
+        )
+        return out[:, None]
+
+    return impl

@@ -42,8 +42,9 @@ __all__ = ["ring_attention", "ring_attention_sharded"]
 
 def _chunk_attend(q, k, v, q_offset, k_offset, causal: bool, sm_scale: float):
     """Scores of local q [B,T,H,D] against one k/v chunk, with the global
-    causal mask derived from the two chunk offsets. Returns (m, p, pv) of
-    the online-softmax update, all fp32."""
+    causal mask derived from the two chunk offsets. Returns the raw masked
+    score matrix [B,H,T,S] in fp32; the online-softmax recurrence over
+    chunks lives in the caller's ring step."""
     s = jnp.einsum("bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32)
     s = s * sm_scale
     if causal:

@@ -1,0 +1,514 @@
+"""Paged KV cache + continuous batching — the long-context serving core.
+
+The reference caps context at ~2000 tokens and serves one request per HTTP
+call (/root/reference/src/core/graph/nodes.py:296-338, factory.py:90); its
+"batching" is a connection pool. Here the KV cache is *paged*: HBM holds one
+pool of fixed-size pages ([L, P, page, Hkv, D]) and every live sequence owns
+a page table mapping logical blocks to physical pages. That buys:
+
+* **continuous batching** — requests join and leave decode slots without
+  recompiling or re-laying-out anyone else's cache; one compiled decode
+  program serves the whole lifetime of the server;
+* **long contexts without fragmentation** — a 8K-token sequence and a
+  50-token sequence coexist in the same pool, each paying only for the
+  pages it touches;
+* **instant reclaim** — finishing a request frees integer page ids, not
+  device memory.
+
+Device side is pure-functional: ``paged_decode_step`` threads the page pool
+through jit with donated buffers (the pool is updated in place, never
+copied). Host side, ``PageAllocator`` is a free-list and ``ContinuousBatchingEngine``
+owns slot admission / EOS retirement, mirroring the reference's resilience
+stance (a failing request fails alone, SURVEY.md §5).
+
+Page 0 is reserved as a scratch page: free slots' page tables point at it,
+so masked lanes in the fused decode step write garbage somewhere harmless.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional, Sequence
+
+import numpy as np
+
+from sentio_tpu.models.llama import LlamaConfig
+from sentio_tpu.parallel.batcher import bucket_size
+
+Array = object  # jax.Array — jax imported lazily
+
+
+# --------------------------------------------------------------------- pool
+
+
+@dataclass
+class PagedPool:
+    """Device-side page pool. k/v: [L, P, page, Hkv, D]; page id 0 = scratch."""
+
+    k: Array
+    v: Array
+    page_size: int
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+
+def init_pool(cfg: LlamaConfig, num_pages: int, page_size: int) -> PagedPool:
+    import jax.numpy as jnp
+
+    shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    return PagedPool(
+        k=jnp.zeros(shape, cfg.jdtype), v=jnp.zeros(shape, cfg.jdtype), page_size=page_size
+    )
+
+
+class PageAllocator:
+    """Host free-list over page ids 1..P-1 (0 is the shared scratch page)."""
+
+    def __init__(self, num_pages: int) -> None:
+        if num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is reserved scratch)")
+        self._free: list[int] = list(range(num_pages - 1, 0, -1))
+        self.num_pages = num_pages
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise MemoryError(f"paged KV pool exhausted: need {n}, have {len(self._free)}")
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, ids: Sequence[int]) -> None:
+        for pid in ids:
+            if pid == 0:
+                continue
+            self._free.append(pid)
+
+
+# ------------------------------------------------------------ device kernels
+
+
+def _paged_attn_xla(q, k_pages_l, v_pages_l, page_table, lens, n_rep):
+    """Decode attention over a page table, XLA gather path.
+
+    q [B,1,H,D]; k/v_pages_l [P,page,Hkv,D]; page_table [B,NB]; lens [B].
+    Gathers each row's pages into a contiguous [B, NB*page, Hkv, D] window —
+    XLA fuses the gather into the attention when the window is modest; the
+    Pallas kernel in kernels/paged_attention.py walks the table in VMEM
+    instead and is preferred on TPU for large windows.
+    """
+    import jax.numpy as jnp
+
+    from sentio_tpu.models import layers as L
+
+    b, nb = page_table.shape
+    page = k_pages_l.shape[1]
+    kc = k_pages_l[page_table].reshape(b, nb * page, *k_pages_l.shape[2:])
+    vc = v_pages_l[page_table].reshape(b, nb * page, *v_pages_l.shape[2:])
+    kc = L.repeat_kv(kc, n_rep)
+    vc = L.repeat_kv(vc, n_rep)
+    kj = jnp.arange(nb * page)[None, None, None, :]
+    mask = kj <= lens[:, None, None, None]  # new token sits at index lens
+    return L.attention(q, kc, vc, mask, q.dtype)
+
+
+def paged_decode_forward(params, cfg: LlamaConfig, tok, lens, page_table, k_pages, v_pages,
+                         attn_impl=None):
+    """One decode step over the paged pool.
+
+    tok [B] int32 (last sampled token per slot); lens [B] absolute position
+    the new token occupies; page_table [B, NB]. Returns (logits [B, V],
+    k_pages, v_pages) with this step's k/v scattered into each row's current
+    page. Masked/free slots must point their page table at scratch page 0.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from sentio_tpu.models import layers as L
+
+    dt = cfg.jdtype
+    b = tok.shape[0]
+    h, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    page = k_pages.shape[2]
+    positions = lens[:, None]  # [B,1]
+    window = page_table.shape[1] * page
+    cos, sin = L.rope_frequencies(hd, max(window, cfg.max_len), cfg.rope_theta)
+
+    page_ids = jnp.take_along_axis(page_table, (lens // page)[:, None], axis=1)[:, 0]
+    offsets = lens % page
+
+    x = L.embed(params["embed_tokens"], tok[:, None], dt)  # [B,1,d]
+    for i in range(cfg.n_layers):
+        lp = params[f"layers_{i}"]
+        xn = L.rmsnorm(lp["attn_norm"], x, cfg.norm_eps)
+        q = L.dense(lp["attn"]["wq"], xn, dt).reshape(b, 1, h, hd)
+        k = L.dense(lp["attn"]["wk"], xn, dt).reshape(b, 1, hkv, hd)
+        v = L.dense(lp["attn"]["wv"], xn, dt).reshape(b, 1, hkv, hd)
+        q = L.apply_rope(q, positions, cos, sin)
+        k = L.apply_rope(k, positions, cos, sin)
+
+        k_pages = k_pages.at[i, page_ids, offsets].set(k[:, 0].astype(dt))
+        v_pages = v_pages.at[i, page_ids, offsets].set(v[:, 0].astype(dt))
+
+        impl = attn_impl or _paged_attn_xla
+        out = impl(q, k_pages[i], v_pages[i], page_table, lens, h // hkv)
+        x = x + L.dense(lp["attn"]["wo"], out.reshape(b, 1, cfg.dim), dt)
+
+        xm = L.rmsnorm(lp["mlp_norm"], x, cfg.norm_eps)
+        gate = jax.nn.silu(L.dense(lp["mlp"]["w_gate"], xm, dt))
+        x = x + L.dense(lp["mlp"]["w_down"], gate * L.dense(lp["mlp"]["w_up"], xm, dt), dt)
+
+    x = L.rmsnorm(params["final_norm"], x, cfg.norm_eps)
+    logits = L.dense(params["lm_head"], x, dt)[:, 0]
+    return logits.astype(jnp.float32), k_pages, v_pages
+
+
+def scatter_prefill(k_pages, v_pages, k_cache, v_cache, page_table):
+    """Copy a contiguous prefill cache into the pool.
+
+    k/v_cache [L, B, S, Hkv, D] (S a multiple of page size), page_table
+    [B, S/page]. Blocks past a row's prompt length should map to scratch
+    page 0 in the table — their garbage lands there.
+    """
+    lcount, b, s, hkv, hd = k_cache.shape
+    page = k_pages.shape[2]
+    nb = s // page
+    kr = k_cache.reshape(lcount, b, nb, page, hkv, hd)
+    vr = v_cache.reshape(lcount, b, nb, page, hkv, hd)
+    # dims 1 of pages indexed by [B, NB] table → scatter [L, B, NB, page, H, D]
+    k_pages = k_pages.at[:, page_table].set(kr)
+    v_pages = v_pages.at[:, page_table].set(vr)
+    return k_pages, v_pages
+
+
+# ---------------------------------------------------------------- the engine
+
+
+@dataclass
+class _Slot:
+    request_id: int = -1
+    pages: list[int] = field(default_factory=list)
+    length: int = 0          # tokens currently in cache (prompt + generated)
+    prompt_tokens: int = 0
+    max_new: int = 0
+    temperature: float = 0.0
+    emitted: list[int] = field(default_factory=list)
+    active: bool = False
+
+
+@dataclass
+class _Request:
+    request_id: int
+    prompt: str
+    max_new: int
+    temperature: float
+
+
+@dataclass
+class PagedResult:
+    request_id: int
+    text: str
+    tokens: list[int]
+    prompt_tokens: int
+    finish_reason: str  # "stop" | "length"
+
+
+class ContinuousBatchingEngine:
+    """Slot-based continuous batching over the paged pool.
+
+    A fixed decode batch of ``max_slots`` lanes runs one fused decode step
+    per tick; requests are admitted into free lanes (prefill → scatter into
+    pages) and retired on EOS / length, freeing their pages. The decode
+    program compiles ONCE for the server's lifetime — admission changes
+    only array *contents* (page tables, lengths, masks), never shapes.
+
+    Single-threaded step() core so tests/bench drive it deterministically;
+    serve/ wraps it in an asyncio pump.
+    """
+
+    PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+    def __init__(
+        self,
+        model_config: Optional[LlamaConfig] = None,
+        params=None,
+        tokenizer=None,
+        max_slots: int = 8,
+        page_size: int = 16,
+        num_pages: Optional[int] = None,
+        max_pages_per_seq: int = 16,
+        rng_seed: int = 0,
+        use_pallas: Optional[bool] = None,
+    ) -> None:
+        import jax
+
+        from sentio_tpu.models.llama import init_llama
+        from sentio_tpu.models.tokenizer import ByteTokenizer
+
+        self.cfg = model_config or LlamaConfig.tiny()
+        self.tokenizer = tokenizer or ByteTokenizer(self.cfg.vocab_size)
+        self.params = params if params is not None else init_llama(
+            jax.random.PRNGKey(rng_seed), self.cfg
+        )
+        self.max_slots = max_slots
+        self.page_size = page_size
+        self.max_pages_per_seq = max_pages_per_seq
+        if num_pages is None:
+            num_pages = 1 + max_slots * max_pages_per_seq
+        self.pool = init_pool(self.cfg, num_pages, page_size)
+        self.allocator = PageAllocator(num_pages)
+
+        self.slots = [_Slot() for _ in range(max_slots)]
+        self._queue: list[_Request] = []
+        self._finished_buffer: list[PagedResult] = []
+        self._next_id = itertools.count()
+        self._rng = jax.random.PRNGKey(rng_seed + 1)
+        # host mirrors of device state, re-uploaded when admission changes them
+        self._page_table = np.zeros((max_slots, max_pages_per_seq), np.int32)
+        self._lens = np.zeros(max_slots, np.int32)
+        self._temps = np.zeros(max_slots, np.float32)
+        self._last_tok = np.zeros(max_slots, np.int32)
+        # Pallas paged-attention kernel walks page tables in VMEM on TPU;
+        # the XLA gather path is the universal fallback (and CPU test path)
+        if use_pallas is None:
+            use_pallas = jax.default_backend() == "tpu"
+        self._attn_impl = None
+        if use_pallas:
+            from sentio_tpu.kernels.paged_attention import make_paged_attn_impl
+
+            self._attn_impl = make_paged_attn_impl()
+        self._build_fns()
+
+    # ------------------------------------------------------------- compiled
+
+    def _build_fns(self) -> None:
+        import jax
+
+        cfg = self.cfg
+        attn_impl = self._attn_impl
+
+        @partial(jax.jit, donate_argnums=(4, 5))
+        def step(params, tok, lens, page_table, k_pages, v_pages, rng, temps):
+            from sentio_tpu.runtime.sampling import sample_tokens
+
+            logits, k_pages, v_pages = paged_decode_forward(
+                params, cfg, tok, lens, page_table, k_pages, v_pages,
+                attn_impl=attn_impl,
+            )
+            rng, sub = jax.random.split(rng)
+            nxt = sample_tokens(logits, sub, temps)
+            return nxt, k_pages, v_pages, rng
+
+        self._step = step
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def do_scatter(k_pages, v_pages, k_cache, v_cache, page_table):
+            return scatter_prefill(k_pages, v_pages, k_cache, v_cache, page_table)
+
+        self._scatter = do_scatter
+
+        @jax.jit
+        def prefill(params, ids, positions, cache):
+            from sentio_tpu.models.llama import llama_forward
+
+            logits, cache = llama_forward(
+                params, cfg, ids, positions=positions, cache=cache, cache_index=0
+            )
+            return logits, cache
+
+        self._prefill = prefill
+
+    # --------------------------------------------------------------- public
+
+    def submit(self, prompt: str, max_new_tokens: int = 64, temperature: float = 0.0) -> int:
+        rid = next(self._next_id)
+        self._queue.append(_Request(rid, prompt, max_new_tokens, temperature))
+        return rid
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._queue) or any(s.active for s in self.slots)
+
+    def run_all(
+        self, prompts: Sequence[str], max_new_tokens: int = 64, temperature: float = 0.0
+    ) -> list[PagedResult]:
+        """Submit-and-drain convenience used by tests and bench."""
+        ids = [self.submit(p, max_new_tokens, temperature) for p in prompts]
+        done: dict[int, PagedResult] = {}
+        while self.has_work:
+            for r in self.step():
+                done[r.request_id] = r
+        return [done[i] for i in ids]
+
+    def step(self) -> list[PagedResult]:
+        """One engine tick: admit waiting requests, one fused decode step,
+        retire finished slots. Returns results completed this tick."""
+        self._admit()
+        out, self._finished_buffer = self._finished_buffer, []
+        if any(s.active for s in self.slots):
+            out.extend(self._decode_tick())
+        return out
+
+    # -------------------------------------------------------------- private
+
+    def _free_slot_indices(self) -> list[int]:
+        return [i for i, s in enumerate(self.slots) if not s.active]
+
+    def _admit(self) -> None:
+        import jax.numpy as jnp
+
+        free = self._free_slot_indices()
+        if not free or not self._queue:
+            return
+
+        batch: list[tuple[int, _Request, list[int]]] = []
+        while self._queue and free:
+            req = self._queue[0]
+            tok_ids = self.tokenizer.encode(req.prompt, add_bos=True)
+            max_prompt = self.max_pages_per_seq * self.page_size - 8
+            tok_ids = tok_ids[:max_prompt]
+            need_now = (len(tok_ids) + self.page_size - 1) // self.page_size
+            need_total = min(
+                (len(tok_ids) + req.max_new + self.page_size - 1) // self.page_size,
+                self.max_pages_per_seq,
+            )
+            if need_total > self.allocator.free_pages:
+                break  # head-of-line blocks until pages free up (no starvation)
+            pages = self.allocator.alloc(need_total)
+            slot_idx = free.pop(0)
+            self._queue.pop(0)
+            batch.append((slot_idx, req, tok_ids))
+            slot = self.slots[slot_idx]
+            slot.request_id = req.request_id
+            slot.pages = pages
+            slot.prompt_tokens = len(tok_ids)
+            slot.length = len(tok_ids)
+            slot.max_new = req.max_new
+            slot.temperature = req.temperature
+            slot.emitted = []
+            slot.active = True
+            row = np.zeros(self.max_pages_per_seq, np.int32)
+            row[: len(pages)] = pages
+            self._page_table[slot_idx] = row
+            self._lens[slot_idx] = len(tok_ids)
+            self._temps[slot_idx] = req.temperature
+
+        if not batch:
+            return
+
+        # one prefill per admitted row: width-bucketed contiguous forward,
+        # then scatter the cache into that row's pages. Rows are prefilled
+        # individually (B=1) so each (width) bucket compiles once.
+        from sentio_tpu.models.llama import init_cache
+        from sentio_tpu.runtime.sampling import sample_tokens
+
+        import jax
+
+        for slot_idx, req, tok_ids in batch:
+            width = bucket_size(
+                max(len(tok_ids), self.page_size), tuple(
+                    b for b in self.PREFILL_BUCKETS if b % self.page_size == 0
+                ) or (self.page_size,),
+            )
+            width = ((width + self.page_size - 1) // self.page_size) * self.page_size
+            ids = np.full((1, width), self.tokenizer.pad_id, np.int32)
+            ids[0, : len(tok_ids)] = tok_ids
+            positions = np.arange(width, dtype=np.int32)[None, :]
+            cache = init_cache(self.cfg, 1, width)
+            logits, cache = self._prefill(
+                self.params, jnp.asarray(ids), jnp.asarray(positions), cache
+            )
+            # table for the scatter: blocks holding prompt → this row's pages,
+            # padding blocks → scratch 0
+            nb = width // self.page_size
+            used = (len(tok_ids) + self.page_size - 1) // self.page_size
+            scat = np.zeros((1, nb), np.int32)
+            scat[0, :used] = self.slots[slot_idx].pages[:used]
+            self.pool.k, self.pool.v = self._scatter(
+                self.pool.k, self.pool.v, cache["k"], cache["v"], jnp.asarray(scat)
+            )
+            # first generated token comes from the prefill logits
+            self._rng, sub = jax.random.split(self._rng)
+            first = sample_tokens(
+                logits[:, len(tok_ids) - 1], sub, req.temperature
+            )
+            self._last_tok[slot_idx] = int(first[0])
+
+        # freshly admitted rows already have token 0 sampled; emit it now so
+        # EOS-as-first-token retires before wasting a decode tick
+        self._finished_buffer.extend(self._post_sample({i for i, _, _ in batch}))
+
+    def _decode_tick(self) -> list[PagedResult]:
+        import jax
+        import jax.numpy as jnp
+
+        nxt, self.pool.k, self.pool.v, self._rng = self._step(
+            self.params,
+            jnp.asarray(self._last_tok),
+            jnp.asarray(self._lens),
+            jnp.asarray(self._page_table),
+            self.pool.k,
+            self.pool.v,
+            self._rng,
+            jnp.asarray(self._temps),
+        )
+        nxt = np.asarray(nxt)
+        for i, slot in enumerate(self.slots):
+            if not slot.active:
+                continue
+            slot.length += 1
+            self._lens[i] = slot.length
+            self._last_tok[i] = nxt[i]
+        return self._post_sample(set(range(self.max_slots)))
+
+    def _post_sample(self, rows: set) -> list[PagedResult]:
+        """Fold the freshly sampled token of each row in ``rows`` into its
+        slot; retire rows that hit EOS or their token budget."""
+        finished: list[PagedResult] = []
+        for i in sorted(rows):
+            slot = self.slots[i]
+            if not slot.active:
+                continue
+            tok = int(self._last_tok[i])
+            hit_eos = tok == self.tokenizer.eos_id
+            if not hit_eos:
+                slot.emitted.append(tok)
+            hit_len = len(slot.emitted) >= slot.max_new
+            out_of_pages = slot.length + 1 >= len(slot.pages) * self.page_size
+            if hit_eos or hit_len or out_of_pages:
+                finished.append(
+                    PagedResult(
+                        request_id=slot.request_id,
+                        text=self.tokenizer.decode(slot.emitted),
+                        tokens=list(slot.emitted),
+                        prompt_tokens=slot.prompt_tokens,
+                        finish_reason="stop" if hit_eos else "length",
+                    )
+                )
+                self.allocator.free(slot.pages)
+                slot.active = False
+                slot.pages = []
+                self._page_table[i] = 0
+                self._lens[i] = 0
+                self._temps[i] = 0.0
+                self._last_tok[i] = 0
+        return finished
+
+    # ---------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        active = sum(s.active for s in self.slots)
+        return {
+            "active_slots": active,
+            "max_slots": self.max_slots,
+            "queued": len(self._queue),
+            "free_pages": self.allocator.free_pages,
+            "total_pages": self.allocator.num_pages,
+            "page_size": self.page_size,
+        }

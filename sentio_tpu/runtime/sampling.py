@@ -21,13 +21,15 @@ def sample_tokens(
     top_k: int = 0,
     top_p: float = 1.0,
 ) -> Array:
-    """[B, V] → [B] int32. ``temperature`` may be a traced scalar; 0 = greedy.
-    top_k / top_p are static (compiled into the program)."""
+    """[B, V] → [B] int32. ``temperature`` may be a traced scalar or a [B]
+    vector (continuous batching mixes generator/verifier rows at different
+    temperatures); 0 = greedy. top_k / top_p are static (compiled in)."""
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     temp = jnp.asarray(temperature, jnp.float32)
-    scaled = logits / jnp.maximum(temp, 1e-6)
+    temp_col = temp[:, None] if temp.ndim == 1 else temp
+    scaled = logits / jnp.maximum(temp_col, 1e-6)
 
     if top_k and top_k > 0:
         kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
@@ -42,4 +44,4 @@ def sample_tokens(
         scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
 
     sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
-    return jnp.where(temp <= 0.0, greedy, sampled)
+    return jnp.where(jnp.broadcast_to(temp, greedy.shape) <= 0.0, greedy, sampled)

@@ -242,7 +242,11 @@ async def _chat_stream(request: web.Request, container: DependencyContainer, req
                 fut.result(timeout=0.5)
                 return True
             except concurrent.futures.TimeoutError:
-                fut.cancel()
+                # cancel() False = the put actually completed in the race
+                # window — treat as delivered or the token would be enqueued
+                # twice on retry
+                if not fut.cancel():
+                    return True
             except Exception:  # noqa: BLE001 — loop closed / cancelled
                 return False
         return False

@@ -84,9 +84,17 @@ class DependencyContainer:
             # save() writes <path>.npz + <path>.json — check the metadata file
             if path and Path(path).with_suffix(".json").exists():
                 logger.info("loading dense index from %s", path)
-                return TpuDenseIndex.load(
+                index = TpuDenseIndex.load(
                     path, mesh=self.mesh, dtype=self.settings.generator.dtype
                 )
+                want = self.embedder.dimension
+                if index.dim != want:
+                    raise ValueError(
+                        f"persisted dense index at {path} has dim={index.dim} but the "
+                        f"configured embedder produces dim={want} — re-ingest with the "
+                        "current embedder or point SENTIO_INDEX_PATH elsewhere"
+                    )
+                return index
             return TpuDenseIndex(
                 dim=self.embedder.dimension,
                 mesh=self.mesh,
