@@ -1,0 +1,145 @@
+// Native BM25 scoring core — the host-side hot loop of sparse retrieval.
+//
+// The reference delegates million-doc sparse retrieval to Lucene via
+// Pyserini (/root/reference/src/core/retrievers/sparse.py:206-276, a JVM
+// dependency); this is the equivalent native backend for the TPU VM host,
+// scoring a CSR postings index (built by sentio_tpu/ops/bm25.py, which owns
+// tokenization and vocab so Python and native scores agree bit-for-bit on
+// the same inputs).
+//
+// The index arrays are BORROWED from numpy (zero-copy): the Python wrapper
+// keeps them alive for the handle's lifetime. C ABI throughout — consumed
+// via ctypes, no pybind11.
+//
+// Scoring math (mirrors BM25Index.scores):
+//   contrib = idf[t] * (tf * (k1 + 1) / (tf + norm[doc]) + delta)
+// accumulated over query-term occurrences; norm[d] = k1*(1-b+b*dl/avgdl)
+// is precomputed Python-side.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+struct SBm25 {
+  int32_t n_docs;
+  int32_t n_terms;
+  const int64_t* term_offsets;  // [n_terms + 1]
+  const int32_t* post_docs;     // [nnz]
+  const float* post_tfs;        // [nnz]
+  const float* idf;             // [n_terms]
+  const float* norm;            // [n_docs]
+  float k1;
+  float delta;
+  // scratch reused across queries (one allocation per handle)
+  std::vector<float> acc;
+  std::vector<int32_t> touched;  // docs with nonzero score this query
+  std::vector<int32_t> cand;     // top-k selection workspace
+  std::vector<uint8_t> seen;
+};
+
+void* sbm25_create(int32_t n_docs, int32_t n_terms, const int64_t* term_offsets,
+                   const int32_t* post_docs, const float* post_tfs,
+                   const float* idf, const float* norm, float k1, float delta) {
+  auto* h = new SBm25();
+  h->n_docs = n_docs;
+  h->n_terms = n_terms;
+  h->term_offsets = term_offsets;
+  h->post_docs = post_docs;
+  h->post_tfs = post_tfs;
+  h->idf = idf;
+  h->norm = norm;
+  h->k1 = k1;
+  h->delta = delta;
+  h->acc.assign(static_cast<size_t>(n_docs), 0.0f);
+  h->seen.assign(static_cast<size_t>(n_docs), 0);
+  h->touched.reserve(1024);
+  return h;
+}
+
+void sbm25_destroy(void* handle) { delete static_cast<SBm25*>(handle); }
+
+// Accumulate scores for one query (term ids WITH repeats, matching the
+// Python np.add.at semantics) into the handle's scratch. Returns the number
+// of touched docs. Internal helper shared by the entry points below.
+static int64_t score_into_scratch(SBm25* h, const int32_t* qids, int32_t n_q) {
+  h->touched.clear();
+  const float k1p1 = h->k1 + 1.0f;
+  for (int32_t qi = 0; qi < n_q; ++qi) {
+    const int32_t t = qids[qi];
+    if (t < 0 || t >= h->n_terms) continue;
+    const int64_t start = h->term_offsets[t];
+    const int64_t end = h->term_offsets[t + 1];
+    const float idf_t = h->idf[t];
+    for (int64_t p = start; p < end; ++p) {
+      const int32_t d = h->post_docs[p];
+      const float tf = h->post_tfs[p];
+      const float contrib = idf_t * (tf * k1p1 / (tf + h->norm[d]) + h->delta);
+      if (!h->seen[d]) {
+        h->seen[d] = 1;
+        h->touched.push_back(d);
+        h->acc[d] = contrib;
+      } else {
+        h->acc[d] += contrib;
+      }
+    }
+  }
+  return static_cast<int64_t>(h->touched.size());
+}
+
+static void clear_scratch(SBm25* h) {
+  for (const int32_t d : h->touched) {
+    h->acc[d] = 0.0f;
+    h->seen[d] = 0;
+  }
+}
+
+// Dense score vector over the whole corpus (parity/fusion path).
+void sbm25_scores(void* handle, const int32_t* qids, int32_t n_q, float* out) {
+  auto* h = static_cast<SBm25*>(handle);
+  std::memset(out, 0, sizeof(float) * static_cast<size_t>(h->n_docs));
+  score_into_scratch(h, qids, n_q);
+  for (const int32_t d : h->touched) out[d] = h->acc[d];
+  clear_scratch(h);
+}
+
+// Top-k by score (descending, ties broken by ascending doc id for
+// determinism). Only docs with score > 0 are returned. Returns the count
+// written into out_idx/out_scores (<= top_k).
+int32_t sbm25_search(void* handle, const int32_t* qids, int32_t n_q,
+                     int32_t top_k, int32_t* out_idx, float* out_scores) {
+  auto* h = static_cast<SBm25*>(handle);
+  score_into_scratch(h, qids, n_q);
+
+  // select on a copy — ``touched`` must stay intact for scratch cleanup
+  h->cand.assign(h->touched.begin(), h->touched.end());
+  auto& docs = h->cand;
+  const auto cmp = [h](int32_t a, int32_t b) {
+    const float sa = h->acc[a], sb = h->acc[b];
+    if (sa != sb) return sa > sb;
+    return a < b;
+  };
+  const size_t k = std::min(static_cast<size_t>(top_k), docs.size());
+  if (k > 0 && k < docs.size()) {
+    std::nth_element(docs.begin(), docs.begin() + static_cast<int64_t>(k) - 1,
+                     docs.end(), cmp);
+    docs.resize(k);
+  }
+  std::sort(docs.begin(), docs.end(), cmp);
+
+  int32_t written = 0;
+  for (const int32_t d : docs) {
+    if (written >= top_k || h->acc[d] <= 0.0f) break;
+    out_idx[written] = d;
+    out_scores[written] = h->acc[d];
+    ++written;
+  }
+  clear_scratch(h);
+  return written;
+}
+
+int32_t sbm25_version() { return 1; }
+
+}  // extern "C"

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import re
+import threading
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Callable, Optional, Sequence
@@ -145,13 +146,20 @@ class BM25Index:
         return out
 
     def search(self, query: str, top_k: int = 10) -> list[tuple[int, float]]:
+        """Top-k under the total order (score desc, doc id asc) — the
+        deterministic tie-break the native core uses, so backends agree."""
         scores = self.scores(query)
         k = min(top_k, self.size)
         if k == 0:
             return []
         idx = np.argpartition(-scores, k - 1)[:k]
-        idx = idx[np.argsort(-scores[idx], kind="stable")]
-        return [(int(i), float(scores[i])) for i in idx if scores[i] > 0.0]
+        kth = scores[idx].min()
+        # re-include boundary ties; scores>0 keeps a sparse match set (kth is
+        # 0 whenever fewer than k docs match — without it this would lexsort
+        # the whole corpus)
+        cand = np.nonzero((scores >= kth) & (scores > 0.0))[0]
+        cand = cand[np.lexsort((cand, -scores[cand]))][:k]
+        return [(int(i), float(scores[i])) for i in cand]
 
     def retrieve(self, query: str, top_k: int = 10) -> list[Document]:
         out = []
@@ -221,3 +229,137 @@ class BM25Index:
         index.idf = arrays["idf"]
         index._finalize_norm()
         return index
+
+
+class NativeBM25Index(BM25Index):
+    """BM25Index scored by the C++ core (sentio_tpu/native/bm25.cpp).
+
+    Python keeps tokenization, vocab, and the CSR build (so persistence and
+    scores are identical to the numpy path); the per-query hot loop —
+    postings traversal, accumulation, top-k selection — runs native. The
+    index buffers are shared zero-copy; the handle borrows them, so they
+    are pinned on the instance for its lifetime. If the native library is
+    unavailable (no toolchain), every call transparently degrades to the
+    numpy implementation.
+    """
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self._handle: Optional[int] = None
+        self._lib = None
+        self._pinned: tuple = ()
+        # the C++ handle carries per-query scratch (acc/seen/touched), so
+        # native calls AND handle lifecycle must serialize: the server's
+        # thread-pool retrievers hit one index from many threads, and /embed
+        # rebuilds it mid-flight (a destroy during a search would be
+        # use-after-free)
+        self._native_lock = threading.Lock()
+
+    # build() swaps the CSR arrays out from under a live handle — drop it
+    def build(self, documents: Sequence[Document]) -> "NativeBM25Index":
+        with self._native_lock:
+            self._detach_locked()
+            super().build(documents)
+        return self
+
+    def _detach_locked(self) -> None:
+        if self._handle is not None and self._lib is not None:
+            self._lib.sbm25_destroy(self._handle)
+        self._handle = None
+        self._pinned = ()
+
+    def __del__(self) -> None:  # noqa: D105
+        try:
+            self._detach_locked()  # no surviving threads at gc time
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    def _ensure_handle_locked(self) -> bool:
+        if self._handle is not None:
+            return True
+        if self.size == 0 or self._norm is None:
+            return False
+        from sentio_tpu import native
+
+        lib = native.load_bm25()
+        if lib is None:
+            return False
+        import ctypes as C
+
+        to = np.ascontiguousarray(self.term_offsets, dtype=np.int64)
+        pd = np.ascontiguousarray(self.post_docs, dtype=np.int32)
+        pt = np.ascontiguousarray(self.post_tfs, dtype=np.float32)
+        idf = np.ascontiguousarray(self.idf, dtype=np.float32)
+        norm = np.ascontiguousarray(self._norm, dtype=np.float32)
+        self._pinned = (to, pd, pt, idf, norm)  # handle borrows these
+        self._lib = lib
+        self._handle = lib.sbm25_create(
+            self.size, len(self.vocab),
+            to.ctypes.data_as(C.POINTER(C.c_int64)),
+            pd.ctypes.data_as(C.POINTER(C.c_int32)),
+            pt.ctypes.data_as(C.POINTER(C.c_float)),
+            idf.ctypes.data_as(C.POINTER(C.c_float)),
+            norm.ctypes.data_as(C.POINTER(C.c_float)),
+            self.params.k1, self.params.delta,
+        )
+        return self._handle is not None
+
+    def _query_ids(self, query: str) -> np.ndarray:
+        """Vocab ids of query tokens, repeats preserved (np.add.at parity)."""
+        ids = [self.vocab[t] for t in self.tokenizer(query) if t in self.vocab]
+        return np.asarray(ids, dtype=np.int32)
+
+    def scores(self, query: str) -> np.ndarray:
+        import ctypes as C
+
+        with self._native_lock:
+            if not self._ensure_handle_locked():
+                return super().scores(query)
+            qids = self._query_ids(query)
+            out = np.zeros(self.size, dtype=np.float32)
+            self._lib.sbm25_scores(
+                self._handle, qids.ctypes.data_as(C.POINTER(C.c_int32)), len(qids),
+                out.ctypes.data_as(C.POINTER(C.c_float)),
+            )
+            return out
+
+    def search(self, query: str, top_k: int = 10) -> list[tuple[int, float]]:
+        import ctypes as C
+
+        with self._native_lock:
+            if not self._ensure_handle_locked():
+                return super().search(query, top_k)
+            qids = self._query_ids(query)
+            k = min(top_k, self.size)
+            if k == 0:
+                return []
+            idx = np.zeros(k, dtype=np.int32)
+            sc = np.zeros(k, dtype=np.float32)
+            n = self._lib.sbm25_search(
+                self._handle, qids.ctypes.data_as(C.POINTER(C.c_int32)), len(qids), k,
+                idx.ctypes.data_as(C.POINTER(C.c_int32)),
+                sc.ctypes.data_as(C.POINTER(C.c_float)),
+            )
+            return [(int(idx[i]), float(sc[i])) for i in range(n)]
+
+
+def make_bm25_index(
+    params: BM25Params | None = None,
+    tokenizer: Callable[[str], list[str]] = default_tokenizer,
+    backend: str = "auto",
+) -> BM25Index:
+    """BM25 factory honoring ``retrieval.bm25_backend``: ``native`` requires
+    the C++ core (raises if the toolchain can't produce it), ``numpy`` forces
+    pure Python, ``auto`` uses native when it builds and numpy otherwise."""
+    if backend not in ("auto", "numpy", "native"):
+        raise ValueError(f"unknown bm25 backend {backend!r}")
+    if backend == "numpy":
+        return BM25Index(params=params, tokenizer=tokenizer)
+    from sentio_tpu import native
+
+    available = native.load_bm25() is not None
+    if backend == "native" and not available:
+        raise RuntimeError("bm25_backend=native but the C++ core failed to build/load")
+    if available:
+        return NativeBM25Index(params=params, tokenizer=tokenizer)
+    return BM25Index(params=params, tokenizer=tokenizer)

@@ -106,10 +106,13 @@ class DependencyContainer:
     @property
     def sparse_index(self):
         def build():
-            from sentio_tpu.ops.bm25 import BM25Index, BM25Params
+            from sentio_tpu.ops.bm25 import BM25Params, make_bm25_index
 
             cfg = self.settings.retrieval
-            index = BM25Index(params=BM25Params(k1=cfg.bm25_k1, b=cfg.bm25_b))
+            index = make_bm25_index(
+                params=BM25Params(k1=cfg.bm25_k1, b=cfg.bm25_b),
+                backend=cfg.bm25_backend,
+            )
             docs = self.dense_index.documents()
             if docs:  # rehydrate from a persisted dense index
                 index.build(docs)
