@@ -1,0 +1,313 @@
+"""HuggingFace checkpoint → framework param-tree conversion.
+
+SURVEY.md §7 lists "weight sourcing/conversion for the three models into
+Flax checkpoints" as a hard part: the reference outsources all model compute
+to hosted APIs (jina.py:33, jina_reranker.py:120, openai.py:117 under
+/root/reference/src/core/), so it never touches weights. Here the three
+model families (decoder LM for generate+verify, bi-encoder embedder,
+cross-encoder reranker) run in-process, and this module maps the public
+torch checkpoints onto our explicit pytrees:
+
+* Llama-family ``*ForCausalLM`` → :func:`convert_llama` (rotate-half RoPE,
+  GQA, SwiGLU — conventions match ``models/llama.py`` exactly).
+* BERT / XLM-RoBERTa encoders → :func:`convert_encoder` (post-LN blocks,
+  learned positions + token types — ``models/transformer.py``). XLM-R's
+  2-slot position offset (padding_idx+1) is folded in here so runtime code
+  uses plain 0-based positions.
+* bge-reranker-class ``*ForSequenceClassification`` → :func:`convert_cross_encoder`.
+
+Everything is host-side numpy: torch tensors are detached to np.float32 and
+the resulting tree is device_put by the caller (optionally through
+``parallel.sharding.shard_params`` for the TP layout). Layout rule: HF
+``nn.Linear`` stores ``weight[out, in]``; our ``layers.dense`` computes
+``x @ kernel`` with ``kernel[in, out]`` → every linear transposes once at
+conversion time and never again at runtime.
+
+No network: loaders accept a local directory only (``local_files_only``),
+mirroring the zero-egress deployment posture.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Mapping
+
+import numpy as np
+
+from sentio_tpu.models.llama import LlamaConfig
+from sentio_tpu.models.transformer import EncoderConfig
+
+
+class ConversionError(Exception):
+    pass
+
+
+def _np(t: Any) -> np.ndarray:
+    """torch.Tensor | np.ndarray → float32 numpy (host)."""
+    if isinstance(t, np.ndarray):
+        return t.astype(np.float32)
+    try:  # torch tensor without importing torch at module scope
+        return t.detach().to("cpu").to(dtype=_torch().float32).numpy()
+    except AttributeError as e:
+        raise ConversionError(f"cannot convert tensor of type {type(t)!r}") from e
+
+
+def _torch():
+    import torch
+
+    return torch
+
+
+def _linear(sd: Mapping[str, Any], prefix: str, with_bias: bool = True) -> dict:
+    out = {"kernel": _np(sd[f"{prefix}.weight"]).T.copy()}
+    if with_bias and f"{prefix}.bias" in sd:
+        out["bias"] = _np(sd[f"{prefix}.bias"])
+    return out
+
+
+# ---------------------------------------------------------------- Llama LM
+
+
+def llama_config_from_hf(hf_cfg: Any, dtype: str = "bfloat16") -> LlamaConfig:
+    """transformers.LlamaConfig (or compatible) → LlamaConfig."""
+    return LlamaConfig(
+        vocab_size=hf_cfg.vocab_size,
+        dim=hf_cfg.hidden_size,
+        n_layers=hf_cfg.num_hidden_layers,
+        n_heads=hf_cfg.num_attention_heads,
+        n_kv_heads=getattr(hf_cfg, "num_key_value_heads", hf_cfg.num_attention_heads),
+        mlp_dim=hf_cfg.intermediate_size,
+        max_len=getattr(hf_cfg, "max_position_embeddings", 8192),
+        rope_theta=getattr(hf_cfg, "rope_theta", 10_000.0),
+        dtype=dtype,
+        norm_eps=getattr(hf_cfg, "rms_norm_eps", 1e-5),
+    )
+
+
+def convert_llama(state_dict: Mapping[str, Any], cfg: LlamaConfig) -> dict:
+    """``LlamaForCausalLM.state_dict()`` → params for ``llama_forward``.
+
+    Handles tied lm_head (falls back to embed weights when the checkpoint
+    omits ``lm_head.weight``, as Llama-3.2-class models do).
+    """
+    sd = state_dict
+    embed = _np(sd["model.embed_tokens.weight"])
+    if "lm_head.weight" in sd:
+        lm_head = _np(sd["lm_head.weight"]).T.copy()
+    else:  # tied embeddings
+        lm_head = embed.T.copy()
+    params: dict = {
+        "embed_tokens": {"embedding": embed},
+        "lm_head": {"kernel": lm_head},
+        "final_norm": {"scale": _np(sd["model.norm.weight"])},
+    }
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}"
+        params[f"layers_{i}"] = {
+            "attn_norm": {"scale": _np(sd[f"{p}.input_layernorm.weight"])},
+            "attn": {
+                "wq": _linear(sd, f"{p}.self_attn.q_proj", with_bias=False),
+                "wk": _linear(sd, f"{p}.self_attn.k_proj", with_bias=False),
+                "wv": _linear(sd, f"{p}.self_attn.v_proj", with_bias=False),
+                "wo": _linear(sd, f"{p}.self_attn.o_proj", with_bias=False),
+            },
+            "mlp_norm": {"scale": _np(sd[f"{p}.post_attention_layernorm.weight"])},
+            "mlp": {
+                "w_gate": _linear(sd, f"{p}.mlp.gate_proj", with_bias=False),
+                "w_up": _linear(sd, f"{p}.mlp.up_proj", with_bias=False),
+                "w_down": _linear(sd, f"{p}.mlp.down_proj", with_bias=False),
+            },
+        }
+    _check_shapes_llama(params, cfg)
+    return params
+
+
+def _check_shapes_llama(params: dict, cfg: LlamaConfig) -> None:
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+    want = {
+        ("embed_tokens", "embedding"): (cfg.vocab_size, cfg.dim),
+        ("lm_head", "kernel"): (cfg.dim, cfg.vocab_size),
+    }
+    for path, shape in want.items():
+        got = params[path[0]][path[1]].shape
+        if tuple(got) != shape:
+            raise ConversionError(f"{'.'.join(path)}: shape {got}, expected {shape}")
+    wk = params["layers_0"]["attn"]["wk"]["kernel"].shape
+    if wk != (cfg.dim, kv_dim):
+        raise ConversionError(f"layers_0.attn.wk: shape {wk}, expected {(cfg.dim, kv_dim)}")
+
+
+# ------------------------------------------------------- BERT/XLM-R encoder
+
+
+def encoder_config_from_hf(hf_cfg: Any, dtype: str = "bfloat16") -> EncoderConfig:
+    # XLM-R reserves two position slots (pad + offset); expose the usable span
+    offset = _position_offset(hf_cfg)
+    return EncoderConfig(
+        vocab_size=hf_cfg.vocab_size,
+        dim=hf_cfg.hidden_size,
+        n_layers=hf_cfg.num_hidden_layers,
+        n_heads=hf_cfg.num_attention_heads,
+        mlp_dim=hf_cfg.intermediate_size,
+        max_len=hf_cfg.max_position_embeddings - offset,
+        n_types=max(getattr(hf_cfg, "type_vocab_size", 1), 1),
+        dtype=dtype,
+    )
+
+
+def _position_offset(hf_cfg: Any) -> int:
+    """RoBERTa-family checkpoints index positions from padding_idx+1 = 2;
+    BERT from 0. Folding the offset into the converted table lets runtime
+    code use arange(T) everywhere."""
+    model_type = getattr(hf_cfg, "model_type", "")
+    if model_type in ("roberta", "xlm-roberta", "camembert"):
+        return getattr(hf_cfg, "pad_token_id", 1) + 1 if getattr(hf_cfg, "pad_token_id", 1) is not None else 2
+    return 0
+
+
+def convert_encoder(
+    state_dict: Mapping[str, Any], cfg: EncoderConfig, position_offset: int = 0
+) -> dict:
+    """BERT/XLM-R ``*Model.state_dict()`` → params for ``encoder_forward``.
+
+    Accepts both bare (``embeddings.…``) and prefixed (``bert.embeddings.…``/
+    ``roberta.…``) key layouts so task-head checkpoints convert unchanged.
+    """
+    sd = _strip_encoder_prefix(state_dict)
+    pos = _np(sd["embeddings.position_embeddings.weight"])
+    if position_offset:
+        pos = pos[position_offset:]
+    if "embeddings.token_type_embeddings.weight" in sd:
+        types = _np(sd["embeddings.token_type_embeddings.weight"])
+    else:  # RoBERTa variants ship a single (or no) type row
+        types = np.zeros((cfg.n_types, cfg.dim), np.float32)
+    if types.shape[0] < cfg.n_types:  # pad missing type rows with zeros
+        types = np.concatenate(
+            [types, np.zeros((cfg.n_types - types.shape[0], cfg.dim), np.float32)]
+        )
+    params: dict = {
+        "embed_tokens": {"embedding": _np(sd["embeddings.word_embeddings.weight"])},
+        "embed_positions": {"embedding": pos.copy()},
+        "embed_types": {"embedding": types},
+        "embed_norm": {
+            "scale": _np(sd["embeddings.LayerNorm.weight"]),
+            "bias": _np(sd["embeddings.LayerNorm.bias"]),
+        },
+    }
+    for i in range(cfg.n_layers):
+        p = f"encoder.layer.{i}"
+        params[f"layers_{i}"] = {
+            "attn": {
+                "wq": _linear(sd, f"{p}.attention.self.query"),
+                "wk": _linear(sd, f"{p}.attention.self.key"),
+                "wv": _linear(sd, f"{p}.attention.self.value"),
+                "wo": _linear(sd, f"{p}.attention.output.dense"),
+            },
+            "attn_norm": {
+                "scale": _np(sd[f"{p}.attention.output.LayerNorm.weight"]),
+                "bias": _np(sd[f"{p}.attention.output.LayerNorm.bias"]),
+            },
+            "mlp": {
+                "w_in": _linear(sd, f"{p}.intermediate.dense"),
+                "w_out": _linear(sd, f"{p}.output.dense"),
+            },
+            "mlp_norm": {
+                "scale": _np(sd[f"{p}.output.LayerNorm.weight"]),
+                "bias": _np(sd[f"{p}.output.LayerNorm.bias"]),
+            },
+        }
+    return params
+
+
+def _strip_encoder_prefix(sd: Mapping[str, Any]) -> dict:
+    for prefix in ("bert.", "roberta.", "model."):
+        if any(k.startswith(prefix + "embeddings.") for k in sd):
+            plen = len(prefix)
+            return {k[plen:]: v for k, v in sd.items() if k.startswith(prefix)}
+    return dict(sd)
+
+
+def convert_cross_encoder(
+    state_dict: Mapping[str, Any], cfg: EncoderConfig, position_offset: int = 0
+) -> dict:
+    """``*ForSequenceClassification`` (bge-reranker-class, 1 label) →
+    params for ``cross_encoder_scores``: encoder tree + optional pooler +
+    scalar head.
+
+    RoBERTa/bge heads are two-stage — ``classifier.dense`` (+tanh) then
+    ``classifier.out_proj`` — which maps onto the cross-encoder's optional
+    ``pooler`` stage; BERT heads are ``bert.pooler.dense`` (+tanh) then
+    ``classifier``. Both convert exactly.
+    """
+    encoder = convert_encoder(state_dict, cfg, position_offset)
+    sd = state_dict
+    params: dict = {"encoder": encoder}
+    if "classifier.out_proj.weight" in sd:  # RoBERTa-family head
+        params["pooler"] = _linear(sd, "classifier.dense")
+        params["head"] = _linear(sd, "classifier.out_proj")
+    elif "classifier.weight" in sd:  # BERT-family head over the pooler
+        for pfx in ("bert.pooler.dense", "pooler.dense"):
+            if f"{pfx}.weight" in sd:
+                params["pooler"] = _linear(sd, pfx)
+                break
+        params["head"] = _linear(sd, "classifier")
+    else:
+        raise ConversionError("no classifier head found in state dict")
+    if params["head"]["kernel"].shape[1] != 1:
+        raise ConversionError(
+            f"cross-encoder head must be scalar, got {params['head']['kernel'].shape[1]} labels"
+        )
+    return params
+
+
+# ---------------------------------------------------------------- loaders
+
+
+def load_state_dict(model_dir: str | Path) -> dict:
+    """Load a checkpoint directory's tensors (safetensors preferred, torch
+    ``pytorch_model.bin`` fallback) without instantiating an HF model."""
+    model_dir = Path(model_dir)
+    st_files = sorted(model_dir.glob("*.safetensors"))
+    if st_files:
+        try:
+            from safetensors import safe_open
+        except ImportError as e:  # pragma: no cover - safetensors ships with transformers
+            raise ConversionError("safetensors not available") from e
+        sd: dict = {}
+        for f in st_files:
+            with safe_open(str(f), framework="np") as fh:
+                for k in fh.keys():
+                    sd[k] = np.asarray(fh.get_tensor(k), dtype=np.float32)
+        return sd
+    bins = sorted(model_dir.glob("pytorch_model*.bin"))
+    if not bins:
+        raise ConversionError(f"no safetensors or torch .bin files under {model_dir}")
+    torch = _torch()
+    sd = {}
+    for f in bins:
+        sd.update(torch.load(str(f), map_location="cpu", weights_only=True))
+    return sd
+
+
+def load_llama_dir(model_dir: str | Path, dtype: str = "bfloat16") -> tuple[dict, LlamaConfig]:
+    """Local Llama checkpoint directory → (params, config)."""
+    from transformers import AutoConfig
+
+    hf_cfg = AutoConfig.from_pretrained(str(model_dir), local_files_only=True)
+    cfg = llama_config_from_hf(hf_cfg, dtype=dtype)
+    return convert_llama(load_state_dict(model_dir), cfg), cfg
+
+
+def load_encoder_dir(
+    model_dir: str | Path, dtype: str = "bfloat16", cross_encoder: bool = False
+) -> tuple[dict, EncoderConfig]:
+    """Local BERT/XLM-R checkpoint directory → (params, config)."""
+    from transformers import AutoConfig
+
+    hf_cfg = AutoConfig.from_pretrained(str(model_dir), local_files_only=True)
+    cfg = encoder_config_from_hf(hf_cfg, dtype=dtype)
+    offset = _position_offset(hf_cfg)
+    sd = load_state_dict(model_dir)
+    if cross_encoder:
+        return convert_cross_encoder(sd, cfg, offset), cfg
+    return convert_encoder(sd, cfg, offset), cfg

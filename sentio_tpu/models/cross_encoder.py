@@ -35,8 +35,14 @@ def cross_encoder_scores(
     type_ids: Array,
 ) -> Array:
     """[B, T] pair encodings → [B] float32 relevance scores (unbounded;
-    consumers sigmoid or rank directly — ranking only needs order)."""
+    consumers sigmoid or rank directly — ranking only needs order).
+
+    An optional ``pooler`` stage (dense + tanh over [CLS], present when
+    converting RoBERTa/bge-class classification heads — models/convert.py)
+    runs between pooling and the scalar head."""
     hidden = encoder_forward(params["encoder"], cfg, ids, mask, type_ids)
     pooled = cls_pool(hidden)
+    if "pooler" in params:
+        pooled = jnp.tanh(L.dense(params["pooler"], pooled, jnp.float32))
     scores = L.dense(params["head"], pooled, jnp.float32)
     return scores[:, 0].astype(jnp.float32)

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import ctypes
 import logging
+import os
 import subprocess
 import threading
 from pathlib import Path
@@ -32,20 +33,28 @@ def _build(name: str) -> Optional[Path]:
     out = _SRC_DIR / f"lib{name}.so"
     if out.exists() and out.stat().st_mtime >= src.stat().st_mtime:
         return out
+    # compile to a per-process temp name and os.replace into place: the
+    # in-process _LOCK cannot serialize concurrent *processes* (multiple
+    # server workers / pytest-xdist on a fresh checkout), and dlopen on a
+    # half-written .so fails hard
+    tmp = _SRC_DIR / f".lib{name}.{os.getpid()}.so"
     cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
-           str(src), "-o", str(out)]
+           str(src), "-o", str(tmp)]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            # -march=native can fail on exotic hosts; retry portable
+            proc = subprocess.run([c for c in cmd if c != "-march=native"],
+                                  capture_output=True, text=True, timeout=120)
+            if proc.returncode != 0:
+                logger.warning("native %s build failed:\n%s", name, proc.stderr[-2000:])
+                return None
+        os.replace(tmp, out)
     except (OSError, subprocess.TimeoutExpired) as exc:
         logger.warning("native %s build skipped: %s", name, exc)
         return None
-    if proc.returncode != 0:
-        # -march=native can fail on exotic hosts; retry portable
-        proc = subprocess.run([c for c in cmd if c != "-march=native"],
-                              capture_output=True, text=True, timeout=120)
-        if proc.returncode != 0:
-            logger.warning("native %s build failed:\n%s", name, proc.stderr[-2000:])
-            return None
+    finally:
+        tmp.unlink(missing_ok=True)
     return out
 
 

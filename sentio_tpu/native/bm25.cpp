@@ -33,11 +33,6 @@ struct SBm25 {
   const float* norm;            // [n_docs]
   float k1;
   float delta;
-  // scratch reused across queries (one allocation per handle)
-  std::vector<float> acc;
-  std::vector<int32_t> touched;  // docs with nonzero score this query
-  std::vector<int32_t> cand;     // top-k selection workspace
-  std::vector<uint8_t> seen;
 };
 
 void* sbm25_create(int32_t n_docs, int32_t n_terms, const int64_t* term_offsets,
@@ -53,19 +48,17 @@ void* sbm25_create(int32_t n_docs, int32_t n_terms, const int64_t* term_offsets,
   h->norm = norm;
   h->k1 = k1;
   h->delta = delta;
-  h->acc.assign(static_cast<size_t>(n_docs), 0.0f);
-  h->seen.assign(static_cast<size_t>(n_docs), 0);
-  h->touched.reserve(1024);
   return h;
 }
 
 void sbm25_destroy(void* handle) { delete static_cast<SBm25*>(handle); }
 
 // Accumulate scores for one query (term ids WITH repeats, matching the
-// Python np.add.at semantics) into the handle's scratch. Returns the number
-// of touched docs. Internal helper shared by the entry points below.
-static int64_t score_into_scratch(SBm25* h, const int32_t* qids, int32_t n_q) {
-  h->touched.clear();
+// Python np.add.at semantics) into a zeroed [n_docs] accumulator, recording
+// touched docs. The handle is READ-ONLY here — all scratch is caller-owned,
+// so any number of threads may score against one handle concurrently.
+static void score_into(const SBm25* h, const int32_t* qids, int32_t n_q,
+                       float* acc, std::vector<int32_t>* touched) {
   const float k1p1 = h->k1 + 1.0f;
   for (int32_t qi = 0; qi < n_q; ++qi) {
     const int32_t t = qids[qi];
@@ -77,47 +70,42 @@ static int64_t score_into_scratch(SBm25* h, const int32_t* qids, int32_t n_q) {
       const int32_t d = h->post_docs[p];
       const float tf = h->post_tfs[p];
       const float contrib = idf_t * (tf * k1p1 / (tf + h->norm[d]) + h->delta);
-      if (!h->seen[d]) {
-        h->seen[d] = 1;
-        h->touched.push_back(d);
-        h->acc[d] = contrib;
-      } else {
-        h->acc[d] += contrib;
-      }
+      if (touched != nullptr && acc[d] == 0.0f) touched->push_back(d);
+      acc[d] += contrib;
     }
   }
-  return static_cast<int64_t>(h->touched.size());
 }
 
-static void clear_scratch(SBm25* h) {
-  for (const int32_t d : h->touched) {
-    h->acc[d] = 0.0f;
-    h->seen[d] = 0;
-  }
-}
-
-// Dense score vector over the whole corpus (parity/fusion path).
+// Dense score vector over the whole corpus (parity/fusion path). ``out`` is
+// the accumulator itself — no handle scratch, no lock needed.
 void sbm25_scores(void* handle, const int32_t* qids, int32_t n_q, float* out) {
-  auto* h = static_cast<SBm25*>(handle);
+  const auto* h = static_cast<const SBm25*>(handle);
   std::memset(out, 0, sizeof(float) * static_cast<size_t>(h->n_docs));
-  score_into_scratch(h, qids, n_q);
-  for (const int32_t d : h->touched) out[d] = h->acc[d];
-  clear_scratch(h);
+  score_into(h, qids, n_q, out, nullptr);
 }
 
 // Top-k by score (descending, ties broken by ascending doc id for
 // determinism). Only docs with score > 0 are returned. Returns the count
-// written into out_idx/out_scores (<= top_k).
+// written into out_idx/out_scores (<= top_k). Scratch is per-call (the
+// zero-page calloc of ``acc`` is cheap even at millions of docs), keeping
+// concurrent searches against one handle lock-free.
 int32_t sbm25_search(void* handle, const int32_t* qids, int32_t n_q,
                      int32_t top_k, int32_t* out_idx, float* out_scores) {
-  auto* h = static_cast<SBm25*>(handle);
-  score_into_scratch(h, qids, n_q);
+  const auto* h = static_cast<const SBm25*>(handle);
+  std::vector<float> acc(static_cast<size_t>(h->n_docs), 0.0f);
+  std::vector<int32_t> docs;
+  docs.reserve(1024);
+  score_into(h, qids, n_q, acc.data(), &docs);
 
-  // select on a copy — ``touched`` must stay intact for scratch cleanup
-  h->cand.assign(h->touched.begin(), h->touched.end());
-  auto& docs = h->cand;
-  const auto cmp = [h](int32_t a, int32_t b) {
-    const float sa = h->acc[a], sb = h->acc[b];
+  // ``touched`` may hold duplicates of docs whose running sum crossed zero
+  // (negative-idf terms); dedup is implicit — a doc appears at most once
+  // per zero-crossing and the final sort/scan tolerates repeats only if
+  // scores differ, so drop exact duplicates first.
+  std::sort(docs.begin(), docs.end());
+  docs.erase(std::unique(docs.begin(), docs.end()), docs.end());
+
+  const auto cmp = [&acc](int32_t a, int32_t b) {
+    const float sa = acc[a], sb = acc[b];
     if (sa != sb) return sa > sb;
     return a < b;
   };
@@ -131,12 +119,11 @@ int32_t sbm25_search(void* handle, const int32_t* qids, int32_t n_q,
 
   int32_t written = 0;
   for (const int32_t d : docs) {
-    if (written >= top_k || h->acc[d] <= 0.0f) break;
+    if (written >= top_k || acc[d] <= 0.0f) break;
     out_idx[written] = d;
-    out_scores[written] = h->acc[d];
+    out_scores[written] = acc[d];
     ++written;
   }
-  clear_scratch(h);
   return written;
 }
 

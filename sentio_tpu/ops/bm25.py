@@ -147,18 +147,27 @@ class BM25Index:
 
     def search(self, query: str, top_k: int = 10) -> list[tuple[int, float]]:
         """Top-k under the total order (score desc, doc id asc) — the
-        deterministic tie-break the native core uses, so backends agree."""
+        deterministic tie-break the native core uses, so backends agree.
+        Work stays O(n + k log k) even when a huge fraction of the corpus
+        ties at the k-th score (boilerplate tokens): only the ``need``
+        smallest doc ids among boundary ties are materialized, never the
+        whole tie set sorted."""
         scores = self.scores(query)
         k = min(top_k, self.size)
         if k == 0:
             return []
         idx = np.argpartition(-scores, k - 1)[:k]
         kth = scores[idx].min()
-        # re-include boundary ties; scores>0 keeps a sparse match set (kth is
-        # 0 whenever fewer than k docs match — without it this would lexsort
-        # the whole corpus)
-        cand = np.nonzero((scores >= kth) & (scores > 0.0))[0]
-        cand = cand[np.lexsort((cand, -scores[cand]))][:k]
+        if kth <= 0.0:
+            # sparse match set: fewer than k docs score positive
+            cand = np.nonzero(scores > 0.0)[0]
+            cand = cand[np.lexsort((cand, -scores[cand]))][:k]
+            return [(int(i), float(scores[i])) for i in cand]
+        above = np.nonzero(scores > kth)[0]  # < k elements
+        above = above[np.lexsort((above, -scores[above]))]
+        ties = np.nonzero(scores == kth)[0]  # ascending already (nonzero order)
+        need = k - len(above)
+        cand = np.concatenate([above, ties[:need]])
         return [(int(i), float(scores[i])) for i in cand]
 
     def retrieve(self, query: str, top_k: int = 10) -> list[Document]:
@@ -231,6 +240,55 @@ class BM25Index:
         return index
 
 
+class _NativeHandle:
+    """Refcounted wrapper around one C++ index handle + its pinned buffers.
+
+    The C++ core is stateless per call (caller-owned scratch), so any number
+    of threads may score through one handle concurrently — the only hazard
+    is lifecycle: a rebuild must not destroy the handle while a search is
+    mid-flight (use-after-free), and the borrowed numpy buffers must outlive
+    it. ``acquire``/``release`` bracket each call; ``retire`` marks the
+    handle dead and the LAST releaser (or retire itself when idle) frees it.
+    """
+
+    def __init__(self, lib, handle, pinned: tuple) -> None:
+        self.lib = lib
+        self.handle = handle
+        self._pinned = pinned
+        self._refs = 0
+        self._dead = False
+        self._lock = threading.Lock()
+
+    def acquire(self) -> bool:
+        with self._lock:
+            if self._dead:
+                return False
+            self._refs += 1
+            return True
+
+    def release(self) -> None:
+        with self._lock:
+            self._refs -= 1
+            free_now = self._dead and self._refs == 0
+        if free_now:
+            self._destroy()
+
+    def retire(self) -> None:
+        with self._lock:
+            if self._dead:
+                return
+            self._dead = True
+            free_now = self._refs == 0
+        if free_now:
+            self._destroy()
+
+    def _destroy(self) -> None:
+        try:
+            self.lib.sbm25_destroy(self.handle)
+        finally:
+            self._pinned = ()
+
+
 class NativeBM25Index(BM25Index):
     """BM25Index scored by the C++ core (sentio_tpu/native/bm25.cpp).
 
@@ -238,71 +296,71 @@ class NativeBM25Index(BM25Index):
     scores are identical to the numpy path); the per-query hot loop —
     postings traversal, accumulation, top-k selection — runs native. The
     index buffers are shared zero-copy; the handle borrows them, so they
-    are pinned on the instance for its lifetime. If the native library is
+    are pinned for the handle's lifetime (``_NativeHandle``). Queries run
+    lock-free and concurrent; ``_native_lock`` only serializes handle
+    creation/retirement (build/rebuild). If the native library is
     unavailable (no toolchain), every call transparently degrades to the
     numpy implementation.
     """
 
     def __init__(self, *args, **kwargs) -> None:
         super().__init__(*args, **kwargs)
-        self._handle: Optional[int] = None
-        self._lib = None
-        self._pinned: tuple = ()
-        # the C++ handle carries per-query scratch (acc/seen/touched), so
-        # native calls AND handle lifecycle must serialize: the server's
-        # thread-pool retrievers hit one index from many threads, and /embed
-        # rebuilds it mid-flight (a destroy during a search would be
-        # use-after-free)
+        self._box: Optional[_NativeHandle] = None
         self._native_lock = threading.Lock()
 
-    # build() swaps the CSR arrays out from under a live handle — drop it
+    # build() swaps the CSR arrays out from under a live handle — retire it
+    # (in-flight searches finish against the old buffers, then it frees)
     def build(self, documents: Sequence[Document]) -> "NativeBM25Index":
         with self._native_lock:
-            self._detach_locked()
+            if self._box is not None:
+                self._box.retire()
+                self._box = None
             super().build(documents)
         return self
 
-    def _detach_locked(self) -> None:
-        if self._handle is not None and self._lib is not None:
-            self._lib.sbm25_destroy(self._handle)
-        self._handle = None
-        self._pinned = ()
-
     def __del__(self) -> None:  # noqa: D105
         try:
-            self._detach_locked()  # no surviving threads at gc time
+            if self._box is not None:
+                self._box.retire()
         except Exception:  # noqa: BLE001 — interpreter teardown
             pass
 
-    def _ensure_handle_locked(self) -> bool:
-        if self._handle is not None:
-            return True
-        if self.size == 0 or self._norm is None:
-            return False
-        from sentio_tpu import native
+    def _get_box(self) -> Optional[_NativeHandle]:
+        """The live handle, creating it on first use. Lock covers creation
+        only; callers bracket actual use with acquire/release."""
+        box = self._box
+        if box is not None:
+            return box
+        with self._native_lock:
+            if self._box is not None:
+                return self._box
+            if self.size == 0 or self._norm is None:
+                return None
+            from sentio_tpu import native
 
-        lib = native.load_bm25()
-        if lib is None:
-            return False
-        import ctypes as C
+            lib = native.load_bm25()
+            if lib is None:
+                return None
+            import ctypes as C
 
-        to = np.ascontiguousarray(self.term_offsets, dtype=np.int64)
-        pd = np.ascontiguousarray(self.post_docs, dtype=np.int32)
-        pt = np.ascontiguousarray(self.post_tfs, dtype=np.float32)
-        idf = np.ascontiguousarray(self.idf, dtype=np.float32)
-        norm = np.ascontiguousarray(self._norm, dtype=np.float32)
-        self._pinned = (to, pd, pt, idf, norm)  # handle borrows these
-        self._lib = lib
-        self._handle = lib.sbm25_create(
-            self.size, len(self.vocab),
-            to.ctypes.data_as(C.POINTER(C.c_int64)),
-            pd.ctypes.data_as(C.POINTER(C.c_int32)),
-            pt.ctypes.data_as(C.POINTER(C.c_float)),
-            idf.ctypes.data_as(C.POINTER(C.c_float)),
-            norm.ctypes.data_as(C.POINTER(C.c_float)),
-            self.params.k1, self.params.delta,
-        )
-        return self._handle is not None
+            to = np.ascontiguousarray(self.term_offsets, dtype=np.int64)
+            pd = np.ascontiguousarray(self.post_docs, dtype=np.int32)
+            pt = np.ascontiguousarray(self.post_tfs, dtype=np.float32)
+            idf = np.ascontiguousarray(self.idf, dtype=np.float32)
+            norm = np.ascontiguousarray(self._norm, dtype=np.float32)
+            handle = lib.sbm25_create(
+                self.size, len(self.vocab),
+                to.ctypes.data_as(C.POINTER(C.c_int64)),
+                pd.ctypes.data_as(C.POINTER(C.c_int32)),
+                pt.ctypes.data_as(C.POINTER(C.c_float)),
+                idf.ctypes.data_as(C.POINTER(C.c_float)),
+                norm.ctypes.data_as(C.POINTER(C.c_float)),
+                self.params.k1, self.params.delta,
+            )
+            if handle is None:
+                return None
+            self._box = _NativeHandle(lib, handle, (to, pd, pt, idf, norm))
+            return self._box
 
     def _query_ids(self, query: str) -> np.ndarray:
         """Vocab ids of query tokens, repeats preserved (np.add.at parity)."""
@@ -312,35 +370,41 @@ class NativeBM25Index(BM25Index):
     def scores(self, query: str) -> np.ndarray:
         import ctypes as C
 
-        with self._native_lock:
-            if not self._ensure_handle_locked():
-                return super().scores(query)
+        box = self._get_box()
+        if box is None or not box.acquire():
+            return super().scores(query)
+        try:
             qids = self._query_ids(query)
             out = np.zeros(self.size, dtype=np.float32)
-            self._lib.sbm25_scores(
-                self._handle, qids.ctypes.data_as(C.POINTER(C.c_int32)), len(qids),
+            box.lib.sbm25_scores(
+                box.handle, qids.ctypes.data_as(C.POINTER(C.c_int32)), len(qids),
                 out.ctypes.data_as(C.POINTER(C.c_float)),
             )
             return out
+        finally:
+            box.release()
 
     def search(self, query: str, top_k: int = 10) -> list[tuple[int, float]]:
         import ctypes as C
 
-        with self._native_lock:
-            if not self._ensure_handle_locked():
-                return super().search(query, top_k)
+        box = self._get_box()
+        if box is None or not box.acquire():
+            return super().search(query, top_k)
+        try:
             qids = self._query_ids(query)
             k = min(top_k, self.size)
             if k == 0:
                 return []
             idx = np.zeros(k, dtype=np.int32)
             sc = np.zeros(k, dtype=np.float32)
-            n = self._lib.sbm25_search(
-                self._handle, qids.ctypes.data_as(C.POINTER(C.c_int32)), len(qids), k,
+            n = box.lib.sbm25_search(
+                box.handle, qids.ctypes.data_as(C.POINTER(C.c_int32)), len(qids), k,
                 idx.ctypes.data_as(C.POINTER(C.c_int32)),
                 sc.ctypes.data_as(C.POINTER(C.c_float)),
             )
             return [(int(idx[i]), float(sc[i])) for i in range(n)]
+        finally:
+            box.release()
 
 
 def make_bm25_index(

@@ -1,0 +1,306 @@
+"""Checkpoint / resume: versioned, atomic persistence for every piece of
+restartable state the framework owns.
+
+The reference needs almost none of this — its durable state lives in external
+services, with only a BM25 pickle (reference src/core/retrievers/sparse.py:
+102-157) and a fallback-response JSON on disk (resilience/fallbacks.py:32-50).
+A TPU-native deployment owns real state: model param pytrees (8B-class),
+corpus embedding shards for the dense index, and the serving engine's KV
+page tables. SURVEY.md §5 calls for exactly this subsystem.
+
+Design:
+
+* **Format** — one ``arrays.npz`` (zip of raw ``.npy`` members, no pickle)
+  plus a ``manifest.json`` describing the tree structure and user metadata.
+  Loading is therefore safe on untrusted files (numpy refuses object arrays
+  with ``allow_pickle=False``) and zero-copy-mmap-able for big checkpoints.
+* **Atomicity** — writes land in a ``.tmp-*`` sibling and ``os.replace`` /
+  ``rename`` into place, so a killed process never leaves a half checkpoint
+  visible; readers only ever see complete step directories.
+* **Versioning** — ``step_%08d`` directories under a base dir with retention
+  (``keep`` newest), mirroring orbax's CheckpointManager layout without its
+  tensorstore dependency surface.
+* **Sharding-aware restore** — ``load_pytree(shardings=...)`` device_puts
+  each leaf through its ``NamedSharding``, so an 8B param tree restores
+  directly into the TP layout (parallel/sharding.py) without a host-side
+  full copy per device.
+
+bfloat16 leaves round-trip losslessly: npz cannot store bf16, so they are
+bit-cast to uint16 and the manifest records the true dtype.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+import zipfile
+from pathlib import Path
+from typing import Any, Mapping, Optional
+
+import numpy as np
+
+FORMAT_VERSION = 1
+_SEP = "/"
+
+
+class CheckpointError(Exception):
+    pass
+
+
+# ------------------------------------------------------------- tree <-> flat
+
+
+_TUPLE_TAG = "__tuple__"
+
+
+def _flatten(tree: Any, prefix: str = "") -> dict[str, np.ndarray]:
+    flat: dict[str, np.ndarray] = {}
+    if isinstance(tree, Mapping):
+        for k in sorted(tree):
+            if not isinstance(k, str):
+                raise CheckpointError(
+                    f"dict key {k!r} is not a string — non-str keys would not "
+                    "round-trip through the JSON manifest"
+                )
+            if _SEP in k or k == _TUPLE_TAG:
+                raise CheckpointError(f"reserved key {k!r}")
+            flat.update(_flatten(tree[k], f"{prefix}{k}{_SEP}"))
+        return flat
+    if isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            flat.update(_flatten(v, f"{prefix}{i}{_SEP}"))
+        return flat
+    flat[prefix.rstrip(_SEP)] = np.asarray(tree)
+    return flat
+
+
+def _unflatten(flat: Mapping[str, np.ndarray], structure: Any) -> Any:
+    """Rebuild using the manifest's structure spec: leaf = key string,
+    list = list, ``{"__tuple__": [...]}`` = tuple, other dict = dict."""
+    if isinstance(structure, str):
+        return flat[structure]
+    if isinstance(structure, list):
+        return [_unflatten(flat, s) for s in structure]
+    if set(structure) == {_TUPLE_TAG}:
+        return tuple(_unflatten(flat, s) for s in structure[_TUPLE_TAG])
+    return {k: _unflatten(flat, s) for k, s in structure.items()}
+
+
+def _structure_of(tree: Any, prefix: str = "") -> Any:
+    """Structure skeleton for the manifest. Tuples are tagged so they rebuild
+    as tuples (optax states are tuple pytrees — a list would change the
+    treedef and break shardings= restore). NamedTuples degrade to plain
+    tuples; restore into richer treedefs via the returned leaves if needed."""
+    if isinstance(tree, Mapping):
+        return {k: _structure_of(tree[k], f"{prefix}{k}{_SEP}") for k in sorted(tree)}
+    if isinstance(tree, tuple):
+        return {_TUPLE_TAG: [_structure_of(v, f"{prefix}{i}{_SEP}") for i, v in enumerate(tree)]}
+    if isinstance(tree, list):
+        return [_structure_of(v, f"{prefix}{i}{_SEP}") for i, v in enumerate(tree)]
+    return prefix.rstrip(_SEP)
+
+
+# --------------------------------------------------------------- save / load
+
+
+def save_pytree(path: str | Path, tree: Any, meta: Optional[dict] = None) -> Path:
+    """Write ``tree`` (nested dict/list of arrays) atomically to directory
+    ``path``. Device arrays are pulled to host; bf16 is bit-cast to uint16."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    flat = _flatten(tree)
+
+    arrays: dict[str, np.ndarray] = {}
+    dtypes: dict[str, str] = {}
+    for i, (key, arr) in enumerate(flat.items()):
+        arr = np.asarray(arr)  # devices -> host
+        dtypes[key] = str(arr.dtype)
+        if str(arr.dtype) == "bfloat16":
+            arr = arr.view(np.uint16)
+        if arr.dtype == object:
+            raise CheckpointError(f"object leaf at {key!r} is not checkpointable")
+        arrays[f"a{i}"] = arr
+
+    manifest = {
+        "format_version": FORMAT_VERSION,
+        "created_unix": time.time(),
+        "structure": _structure_of(tree),
+        "keys": {f"a{i}": k for i, k in enumerate(flat)},
+        "dtypes": dtypes,
+        "meta": meta or {},
+    }
+
+    tmp = Path(tempfile.mkdtemp(prefix=".tmp-ckpt-", dir=str(path.parent)))
+    try:
+        with open(tmp / "arrays.npz", "wb") as f:
+            np.savez(f, **arrays)
+        (tmp / "manifest.json").write_text(json.dumps(manifest, indent=1))
+        _replace_dir(tmp, path)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return path
+
+
+def _replace_dir(src: Path, dst: Path) -> None:
+    """Swap ``src`` into ``dst``'s place without a window where ``dst`` is
+    absent: an existing ``dst`` is renamed aside first (rename is atomic;
+    a crash leaves either the old or the new checkpoint visible, never
+    neither), then the displaced old version is deleted."""
+    old: Optional[Path] = None
+    if dst.exists():
+        old = dst.parent / f".old-{dst.name}-{os.getpid()}"
+        if old.exists():
+            shutil.rmtree(old)
+        os.replace(dst, old)
+    try:
+        os.replace(src, dst)
+    except BaseException:
+        if old is not None and not dst.exists():
+            os.replace(old, dst)  # roll back
+        raise
+    if old is not None:
+        shutil.rmtree(old, ignore_errors=True)
+
+
+def sweep_stale_tmp(base: Path) -> None:
+    """Remove leftover ``.tmp-*`` / ``.old-*`` dirs from crashed writers."""
+    for p in base.glob(".tmp-*"):
+        shutil.rmtree(p, ignore_errors=True)
+    for p in base.glob(".old-*"):
+        shutil.rmtree(p, ignore_errors=True)
+
+
+def load_pytree(
+    path: str | Path, shardings: Any = None
+) -> tuple[Any, dict]:
+    """Read a checkpoint directory → (tree, meta).
+
+    ``shardings``: optional pytree matching ``tree``'s structure whose leaves
+    are ``jax.sharding.Sharding``s (or None); matching leaves are device_put
+    through their sharding so restore lands directly in the distributed
+    layout.
+    """
+    path = Path(path)
+    mf_path = path / "manifest.json"
+    if not mf_path.exists():
+        raise CheckpointError(f"no checkpoint at {path}")
+    manifest = json.loads(mf_path.read_text())
+    if manifest.get("format_version") != FORMAT_VERSION:
+        raise CheckpointError(
+            f"unsupported checkpoint format {manifest.get('format_version')}"
+        )
+    with np.load(path / "arrays.npz", allow_pickle=False) as z:
+        flat: dict[str, np.ndarray] = {}
+        for slot, key in manifest["keys"].items():
+            arr = z[slot]
+            true_dtype = manifest["dtypes"][key]
+            if true_dtype == "bfloat16" and arr.dtype == np.uint16:
+                import ml_dtypes
+
+                arr = arr.view(ml_dtypes.bfloat16)
+            flat[key] = arr
+
+    tree = _unflatten(flat, manifest["structure"])
+    if shardings is not None:
+        tree = _apply_shardings(tree, shardings)
+    return tree, manifest.get("meta", {})
+
+
+def _apply_shardings(tree: Any, shardings: Any) -> Any:
+    import jax
+
+    def put(leaf, sh):
+        return jax.device_put(leaf, sh) if sh is not None else leaf
+
+    return jax.tree.map(put, tree, shardings)
+
+
+# --------------------------------------------------------------- manager
+
+
+class CheckpointManager:
+    """Versioned checkpoints: ``base/step_00000042/{name}/…`` with retention.
+
+    One step saves several named trees (e.g. ``params``, ``opt_state``,
+    ``index``) that restore together — the serving equivalent of a training
+    step checkpoint. Partial step dirs are invisible (atomic rename of the
+    whole step directory), and ``restore`` falls back through older steps if
+    the newest is unreadable.
+    """
+
+    def __init__(self, base_dir: str | Path, keep: int = 3) -> None:
+        self.base = Path(base_dir)
+        self.keep = keep
+        self.base.mkdir(parents=True, exist_ok=True)
+        sweep_stale_tmp(self.base)
+
+    @staticmethod
+    def _step_name(step: int) -> str:
+        return f"step_{step:08d}"
+
+    def all_steps(self) -> list[int]:
+        steps = []
+        for p in self.base.glob("step_*"):
+            if p.is_dir() and (p / ".complete").exists():
+                try:
+                    steps.append(int(p.name.split("_")[1]))
+                except (IndexError, ValueError):
+                    continue
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, trees: Mapping[str, Any], meta: Optional[dict] = None) -> Path:
+        tmp = Path(tempfile.mkdtemp(prefix=".tmp-step-", dir=str(self.base)))
+        final = self.base / self._step_name(step)
+        try:
+            for name, tree in trees.items():
+                save_pytree(tmp / name, tree, meta=meta)
+            (tmp / ".complete").write_text(str(time.time()))
+            _replace_dir(tmp, final)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._gc()
+        return final
+
+    def restore(
+        self,
+        step: Optional[int] = None,
+        shardings: Optional[Mapping[str, Any]] = None,
+    ) -> tuple[int, dict[str, Any], dict]:
+        """→ (step, {name: tree}, meta). Newest step when ``step`` is None;
+        corrupt newest steps are skipped with older ones tried in order."""
+        candidates = [step] if step is not None else list(reversed(self.all_steps()))
+        last_err: Optional[Exception] = None
+        for s in candidates:
+            d = self.base / self._step_name(s)
+            try:
+                trees: dict[str, Any] = {}
+                meta: dict = {}
+                names = sorted(
+                    p.name for p in d.iterdir() if p.is_dir() and not p.name.startswith(".")
+                )
+                if not names:
+                    raise CheckpointError(f"empty checkpoint step {s}")
+                for name in names:
+                    sh = (shardings or {}).get(name)
+                    trees[name], meta = load_pytree(d / name, shardings=sh)
+                return s, trees, meta
+            except (CheckpointError, OSError, ValueError, KeyError, zipfile.BadZipFile) as e:
+                # BadZipFile: power loss can truncate arrays.npz (save does
+                # not fsync); fall back to the previous step
+                last_err = e
+                continue
+        raise CheckpointError(f"no restorable checkpoint under {self.base}: {last_err}")
+
+    def _gc(self) -> None:
+        steps = self.all_steps()
+        for s in steps[: max(len(steps) - self.keep, 0)]:
+            shutil.rmtree(self.base / self._step_name(s), ignore_errors=True)
