@@ -1,10 +1,14 @@
-"""Command-line interface: ingest / serve / bench / info.
+"""Command-line interface: ingest / serve / bench / info / trace / convert.
 
 Parity with /root/reference/src/cli/ (Typer app with ``ingest``/``api``/
 ``ui``/``run``/``studio`` sub-apps, __init__.py:17-23 there) on stdlib
 argparse — Typer isn't in the base image, and the UI is served by the API
 process itself (GET /), so ``serve`` covers the reference's ``api`` + ``ui``
-+ ``run`` trio. ``python -m sentio_tpu.cli <cmd>``.
++ ``run`` trio. ``trace`` is the studio equivalent (the reference launches
+LangGraph Studio, cli/studio.py there): it runs one query through the graph
+and dumps the full node-by-node execution trace as JSON. ``convert``
+imports public HF checkpoints into framework checkpoints (models/convert.py).
+``python -m sentio_tpu.cli <cmd>``.
 """
 
 from __future__ import annotations
@@ -57,6 +61,64 @@ def _cmd_bench(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_trace(args: argparse.Namespace) -> int:
+    """Run one query through the full graph and dump the execution trace —
+    the offline equivalent of the reference's LangGraph Studio inspection
+    (cli/studio.py + langgraph.json there)."""
+    from sentio_tpu.config import get_settings
+    from sentio_tpu.graph.state import create_initial_state
+    from sentio_tpu.serve.dependencies import DependencyContainer
+
+    settings = get_settings()
+    if args.index:
+        settings.retrieval.index_path = args.index
+    container = DependencyContainer(settings=settings)
+    if args.ingest:
+        container.ingestor.ingest_path(args.ingest)
+    state = container.graph.invoke(
+        create_initial_state(args.query, metadata={"mode": args.mode})
+    )
+    trace = {
+        "query": args.query,
+        "graph_path": state["metadata"].get("graph_path"),
+        "node_timings_ms": state["metadata"].get("node_timings_ms"),
+        "num_retrieved": len(state.get("retrieved_documents") or []),
+        "num_reranked": len(state.get("reranked_documents") or []),
+        "num_selected": len(state.get("selected_documents") or []),
+        "answer": state.get("response"),
+        "metadata": {
+            k: v for k, v in state["metadata"].items()
+            if k not in ("graph_path", "node_timings_ms")
+        },
+    }
+    if args.documents:
+        trace["selected_documents"] = [
+            {"id": d.id, "text": d.text[:200], "metadata": d.metadata}
+            for d in (state.get("selected_documents") or [])
+        ]
+    print(json.dumps(trace, indent=2, default=str))
+    return 0
+
+
+def _cmd_convert(args: argparse.Namespace) -> int:
+    """Import a local HF checkpoint directory into a framework checkpoint
+    (runtime/checkpoint.py format) ready for serve --restore."""
+    from sentio_tpu.models import convert as C
+    from sentio_tpu.runtime.checkpoint import save_pytree
+
+    if args.family == "llama":
+        params, cfg = C.load_llama_dir(args.src, dtype=args.dtype)
+    elif args.family == "encoder":
+        params, cfg = C.load_encoder_dir(args.src, dtype=args.dtype)
+    elif args.family == "cross-encoder":
+        params, cfg = C.load_encoder_dir(args.src, dtype=args.dtype, cross_encoder=True)
+    else:  # pragma: no cover - argparse choices guard this
+        raise ValueError(args.family)
+    save_pytree(args.dst, params, meta={"family": args.family, "config": cfg.__dict__})
+    print(json.dumps({"family": args.family, "dst": args.dst, "config": cfg.__dict__}))
+    return 0
+
+
 def _cmd_info(args: argparse.Namespace) -> int:
     import jax
 
@@ -98,6 +160,23 @@ def main(argv: list[str] | None = None) -> int:
     p_bench = sub.add_parser("bench", help="run the end-to-end benchmark")
     p_bench.add_argument("--fast", action="store_true")
     p_bench.set_defaults(fn=_cmd_bench)
+
+    p_trace = sub.add_parser("trace", help="run one query and dump the graph execution trace")
+    p_trace.add_argument("query")
+    p_trace.add_argument("--ingest", default="", help="ingest this path first")
+    p_trace.add_argument("--index", default="", help="load a persisted dense index")
+    p_trace.add_argument("--mode", default="balanced",
+                         choices=["fast", "balanced", "quality", "creative"])
+    p_trace.add_argument("--documents", action="store_true",
+                         help="include selected document previews")
+    p_trace.set_defaults(fn=_cmd_trace)
+
+    p_conv = sub.add_parser("convert", help="convert a local HF checkpoint dir")
+    p_conv.add_argument("family", choices=["llama", "encoder", "cross-encoder"])
+    p_conv.add_argument("src", help="HF checkpoint directory (config.json + weights)")
+    p_conv.add_argument("dst", help="output framework checkpoint directory")
+    p_conv.add_argument("--dtype", default="bfloat16")
+    p_conv.set_defaults(fn=_cmd_convert)
 
     p_info = sub.add_parser("info", help="print version/device/config info")
     p_info.set_defaults(fn=_cmd_info)

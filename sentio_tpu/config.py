@@ -109,6 +109,8 @@ class RetrievalConfig:
     # dense index
     index_backend: str = "tpu"  # tpu | qdrant
     collection_name: str = "sentio"
+    qdrant_url: str = "http://localhost:6333"
+    qdrant_api_key: str = ""
     # persisted TpuDenseIndex to load at startup ("" = start empty); BM25
     # rehydrates from the loaded documents
     index_path: str = ""
@@ -132,6 +134,8 @@ class RetrievalConfig:
             bm25_backend=_env_str(["BM25_BACKEND"], "auto"),
             index_backend=_env_str(["INDEX_BACKEND", "VECTOR_STORE"], "tpu"),
             collection_name=_env_str(["COLLECTION_NAME", "QDRANT_COLLECTION"], "sentio"),
+            qdrant_url=_env_str(["QDRANT_URL"], "http://localhost:6333"),
+            qdrant_api_key=_env_str(["QDRANT_API_KEY"], ""),
             index_path=_env_str(["INDEX_PATH"], ""),
         )
 

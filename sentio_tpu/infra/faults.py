@@ -1,0 +1,127 @@
+"""Fault injection — deterministic failure simulation for resilience tests.
+
+SURVEY.md §5 notes the reference has NO fault-injection framework (failures
+are simulated ad hoc with mocks in its tests) and prescribes adding "a
+fault-injection hook (drop/deadline a batch) for tests" to the build. This
+module is that hook: named injection points are planted at the framework's
+failure-relevant seams (device dispatch in the generator engine, retriever
+legs, reranker batches), default to no-ops with near-zero overhead, and
+tests (or chaos drills) arm them with rules — fail N times, fail with a
+given exception, add latency, fail with probability p under a seeded RNG.
+
+Usage:
+
+    with inject("engine.generate", error=TimeoutError("deadline"), times=2):
+        ...  # first two generate dispatches raise, third proceeds
+
+Planting a point in framework code:
+
+    faults.hit("engine.generate")   # raises if an armed rule says so
+
+Points are process-global and thread-safe; ``reset()`` disarms everything
+(autouse-able in fixtures). Arming is cheap; an unarmed ``hit`` is a dict
+lookup on a usually-empty dict.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Optional
+
+__all__ = ["FaultRule", "arm", "disarm", "reset", "hit", "inject", "active_rules"]
+
+
+@dataclass
+class FaultRule:
+    """What happens when an armed point is hit.
+
+    * ``error`` — exception instance to raise (a fresh copy each hit via
+      type(error)(*error.args), so tracebacks don't chain weirdly).
+    * ``times`` — fire for the first N hits, then disarm (None = forever).
+    * ``probability`` — fire with this probability (seeded ``rng`` makes it
+      deterministic in tests).
+    * ``delay_s`` — sleep before (optionally) failing: deadline simulation.
+    """
+
+    error: Optional[BaseException] = None
+    times: Optional[int] = None
+    probability: float = 1.0
+    delay_s: float = 0.0
+    rng: random.Random = field(default_factory=lambda: random.Random(0))
+    hits: int = 0
+    fired: int = 0
+
+    def should_fire(self) -> bool:
+        if self.times is not None and self.fired >= self.times:
+            return False
+        return self.probability >= 1.0 or self.rng.random() < self.probability
+
+
+_rules: dict[str, FaultRule] = {}
+_lock = threading.Lock()
+
+
+def arm(point: str, rule: FaultRule) -> None:
+    with _lock:
+        _rules[point] = rule
+
+
+def disarm(point: str) -> None:
+    with _lock:
+        _rules.pop(point, None)
+
+
+def reset() -> None:
+    with _lock:
+        _rules.clear()
+
+
+def active_rules() -> dict[str, FaultRule]:
+    with _lock:
+        return dict(_rules)
+
+
+def hit(point: str) -> None:
+    """Framework code calls this at an injection point. No-op unless armed."""
+    if not _rules:  # fast path: nothing armed anywhere
+        return
+    with _lock:
+        rule = _rules.get(point)
+        if rule is None:
+            return
+        rule.hits += 1
+        fire = rule.should_fire()
+        if fire:
+            rule.fired += 1
+        delay = rule.delay_s if fire else 0.0
+        error = rule.error if fire else None
+    if delay > 0:
+        time.sleep(delay)
+    if error is not None:
+        raise type(error)(*error.args)
+
+
+@contextmanager
+def inject(
+    point: str,
+    error: Optional[BaseException] = None,
+    times: Optional[int] = None,
+    probability: float = 1.0,
+    delay_s: float = 0.0,
+    seed: int = 0,
+) -> Iterator[FaultRule]:
+    """Arm ``point`` for the duration of the block; yields the rule so the
+    test can assert on ``hits``/``fired``."""
+    rule = FaultRule(
+        error=error, times=times, probability=probability,
+        delay_s=delay_s, rng=random.Random(seed),
+    )
+    arm(point, rule)
+    try:
+        yield rule
+    finally:
+        disarm(point)

@@ -76,6 +76,8 @@ class MetricsCollector:
         self.memory = InMemoryMetrics()
         self.registry = None
         self._prom: dict[str, Any] = {}
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         if PROMETHEUS_AVAILABLE and enabled:
             self.registry = CollectorRegistry()
             self._build_prom()
@@ -116,6 +118,11 @@ class MetricsCollector:
             ),
             "tokens_per_s": Gauge(
                 "sentio_tpu_decode_tokens_per_second", "decode throughput", [], registry=r
+            ),
+            # the HPA scaling signal (deploy/kubernetes/hpa.yaml): CPU% is
+            # meaningless for a TPU pod, queue depth is what saturates a slice
+            "inflight": Gauge(
+                "sentio_inflight_requests", "requests currently being served", [], registry=r
             ),
         }
 
@@ -186,16 +193,29 @@ class MetricsCollector:
 
     # --------------------------------------------------------------- helpers
 
+    def adjust_inflight(self, delta: int) -> None:
+        # gauge writes stay INSIDE the lock: two concurrent finishes could
+        # otherwise write counter values out of order and leave the HPA
+        # scaling signal stuck at a phantom non-zero on an idle pod
+        with self._inflight_lock:
+            self._inflight = max(self._inflight + delta, 0)
+            value = float(self._inflight)
+            self.memory.set_gauge("inflight", (), value)
+            if self._prom:
+                self._prom["inflight"].set(value)
+
     @contextmanager
     def track_request(self, endpoint: str):
         t0 = time.perf_counter()
         status = 200
+        self.adjust_inflight(+1)
         try:
             yield
         except Exception:
             status = 500
             raise
         finally:
+            self.adjust_inflight(-1)
             self.record_request(endpoint, status, time.perf_counter() - t0)
 
     # ---------------------------------------------------------------- export

@@ -43,13 +43,43 @@ class ConversionError(Exception):
 
 
 def _np(t: Any) -> np.ndarray:
-    """torch.Tensor | np.ndarray → float32 numpy (host)."""
+    """torch.Tensor | np.ndarray → numpy (host). Numpy arrays keep their
+    dtype (safetensors bf16 arrives as ml_dtypes.bfloat16 and stays that
+    way — no 2x f32 blow-up for 8B-class checkpoints); torch tensors go
+    through f32 per-tensor (transient)."""
     if isinstance(t, np.ndarray):
-        return t.astype(np.float32)
+        return t
     try:  # torch tensor without importing torch at module scope
         return t.detach().to("cpu").to(dtype=_torch().float32).numpy()
     except AttributeError as e:
         raise ConversionError(f"cannot convert tensor of type {type(t)!r}") from e
+
+
+def cast_tree(params: dict, dtype: str) -> dict:
+    """Cast every floating leaf to ``dtype`` (bf16 via ml_dtypes on numpy).
+    Param storage dtype is a deployment choice: f32 masters for fine-tuning,
+    bf16 for serving 8B-class models at half the HBM/disk."""
+    want = np.dtype(dtype) if dtype != "bfloat16" else _bf16()
+
+    def cast(leaf):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) or str(arr.dtype) == "bfloat16":
+            return arr.astype(want) if arr.dtype != want else arr
+        return arr
+
+    return _tree_map(cast, params)
+
+
+def _bf16():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def _tree_map(fn, tree):
+    if isinstance(tree, dict):
+        return {k: _tree_map(fn, v) for k, v in tree.items()}
+    return fn(tree)
 
 
 def _torch():
@@ -277,7 +307,9 @@ def load_state_dict(model_dir: str | Path) -> dict:
         for f in st_files:
             with safe_open(str(f), framework="np") as fh:
                 for k in fh.keys():
-                    sd[k] = np.asarray(fh.get_tensor(k), dtype=np.float32)
+                    # native dtype preserved (bf16 → ml_dtypes.bfloat16):
+                    # an 8B bf16 checkpoint loads at 16 GB, not 32
+                    sd[k] = np.asarray(fh.get_tensor(k))
         return sd
     bins = sorted(model_dir.glob("pytorch_model*.bin"))
     if not bins:
@@ -295,7 +327,8 @@ def load_llama_dir(model_dir: str | Path, dtype: str = "bfloat16") -> tuple[dict
 
     hf_cfg = AutoConfig.from_pretrained(str(model_dir), local_files_only=True)
     cfg = llama_config_from_hf(hf_cfg, dtype=dtype)
-    return convert_llama(load_state_dict(model_dir), cfg), cfg
+    params = cast_tree(convert_llama(load_state_dict(model_dir), cfg), dtype)
+    return params, cfg
 
 
 def load_encoder_dir(
@@ -308,6 +341,5 @@ def load_encoder_dir(
     cfg = encoder_config_from_hf(hf_cfg, dtype=dtype)
     offset = _position_offset(hf_cfg)
     sd = load_state_dict(model_dir)
-    if cross_encoder:
-        return convert_cross_encoder(sd, cfg, offset), cfg
-    return convert_encoder(sd, cfg, offset), cfg
+    params = convert_cross_encoder(sd, cfg, offset) if cross_encoder else convert_encoder(sd, cfg, offset)
+    return cast_tree(params, dtype), cfg

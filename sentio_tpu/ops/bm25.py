@@ -241,19 +241,29 @@ class BM25Index:
 
 
 class _NativeHandle:
-    """Refcounted wrapper around one C++ index handle + its pinned buffers.
+    """Refcounted wrapper around one C++ index handle + a SNAPSHOT of the
+    Python-side state it must stay consistent with.
 
     The C++ core is stateless per call (caller-owned scratch), so any number
-    of threads may score through one handle concurrently — the only hazard
-    is lifecycle: a rebuild must not destroy the handle while a search is
-    mid-flight (use-after-free), and the borrowed numpy buffers must outlive
-    it. ``acquire``/``release`` bracket each call; ``retire`` marks the
-    handle dead and the LAST releaser (or retire itself when idle) frees it.
+    of threads may score through one handle concurrently — the hazards are
+    lifecycle and consistency: a rebuild must not destroy the handle while a
+    search is mid-flight (use-after-free), the borrowed numpy buffers must
+    outlive it, AND a query running against an old handle must size its
+    output by the OLD corpus (the C++ core writes ``n_docs`` floats — a
+    buffer sized from post-rebuild ``self.size`` would overflow) and map
+    result indices through the OLD document list. ``n_docs``/``documents``
+    are snapshotted here for that; the vocab is safe to share because
+    ``build`` only ever APPENDS term ids (setdefault) and the core
+    bounds-checks ids ≥ its n_terms. ``acquire``/``release`` bracket each
+    call; ``retire`` marks the handle dead and the LAST releaser (or retire
+    itself when idle) frees it.
     """
 
-    def __init__(self, lib, handle, pinned: tuple) -> None:
+    def __init__(self, lib, handle, pinned: tuple, n_docs: int, documents: list) -> None:
         self.lib = lib
         self.handle = handle
+        self.n_docs = n_docs
+        self.documents = documents  # the list object this handle indexed
         self._pinned = pinned
         self._refs = 0
         self._dead = False
@@ -359,7 +369,10 @@ class NativeBM25Index(BM25Index):
             )
             if handle is None:
                 return None
-            self._box = _NativeHandle(lib, handle, (to, pd, pt, idf, norm))
+            self._box = _NativeHandle(
+                lib, handle, (to, pd, pt, idf, norm),
+                n_docs=self.size, documents=self._documents,
+            )
             return self._box
 
     def _query_ids(self, query: str) -> np.ndarray:
@@ -374,8 +387,10 @@ class NativeBM25Index(BM25Index):
         if box is None or not box.acquire():
             return super().scores(query)
         try:
+            # size the buffer by the handle's snapshot, not live self.size —
+            # a concurrent rebuild may have changed the corpus under us
             qids = self._query_ids(query)
-            out = np.zeros(self.size, dtype=np.float32)
+            out = np.zeros(box.n_docs, dtype=np.float32)
             box.lib.sbm25_scores(
                 box.handle, qids.ctypes.data_as(C.POINTER(C.c_int32)), len(qids),
                 out.ctypes.data_as(C.POINTER(C.c_float)),
@@ -385,24 +400,46 @@ class NativeBM25Index(BM25Index):
             box.release()
 
     def search(self, query: str, top_k: int = 10) -> list[tuple[int, float]]:
-        import ctypes as C
-
         box = self._get_box()
         if box is None or not box.acquire():
             return super().search(query, top_k)
         try:
-            qids = self._query_ids(query)
-            k = min(top_k, self.size)
-            if k == 0:
-                return []
-            idx = np.zeros(k, dtype=np.int32)
-            sc = np.zeros(k, dtype=np.float32)
-            n = box.lib.sbm25_search(
-                box.handle, qids.ctypes.data_as(C.POINTER(C.c_int32)), len(qids), k,
-                idx.ctypes.data_as(C.POINTER(C.c_int32)),
-                sc.ctypes.data_as(C.POINTER(C.c_float)),
-            )
-            return [(int(idx[i]), float(sc[i])) for i in range(n)]
+            return self._native_search(box, query, top_k)
+        finally:
+            box.release()
+
+    def _native_search(self, box: _NativeHandle, query: str, top_k: int) -> list[tuple[int, float]]:
+        import ctypes as C
+
+        qids = self._query_ids(query)
+        k = min(top_k, box.n_docs)
+        if k == 0:
+            return []
+        idx = np.zeros(k, dtype=np.int32)
+        sc = np.zeros(k, dtype=np.float32)
+        n = box.lib.sbm25_search(
+            box.handle, qids.ctypes.data_as(C.POINTER(C.c_int32)), len(qids), k,
+            idx.ctypes.data_as(C.POINTER(C.c_int32)),
+            sc.ctypes.data_as(C.POINTER(C.c_float)),
+        )
+        return [(int(idx[i]), float(sc[i])) for i in range(n)]
+
+    def retrieve(self, query: str, top_k: int = 10) -> list[Document]:
+        box = self._get_box()
+        if box is None or not box.acquire():
+            return super().retrieve(query, top_k)
+        try:
+            # one box snapshot for the whole operation: indices from the
+            # native search resolve against the SAME document list the
+            # handle indexed, even mid-rebuild
+            out = []
+            for di, score in self._native_search(box, query, top_k):
+                doc = box.documents[di]
+                meta = dict(doc.metadata)
+                meta["score"] = score
+                meta["retriever"] = "bm25"
+                out.append(Document(text=doc.text, metadata=meta, id=doc.id))
+            return out
         finally:
             box.release()
 

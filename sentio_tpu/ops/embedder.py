@@ -23,6 +23,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from sentio_tpu.config import EmbedderConfig, get_settings
+from sentio_tpu.infra import faults
 
 
 class EmbeddingError(Exception):
@@ -100,6 +101,7 @@ class BaseEmbedder:
     # -- public API ----------------------------------------------------------
 
     def embed_many(self, texts: Sequence[str]) -> np.ndarray:
+        faults.hit("embedder.batch")
         t0 = time.perf_counter()
         self.stats["requests"] += 1
         self.stats["texts"] += len(texts)

@@ -19,6 +19,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from sentio_tpu.config import RerankConfig, get_settings
+from sentio_tpu.infra import faults
 from sentio_tpu.models.document import Document
 
 logger = logging.getLogger(__name__)
@@ -48,6 +49,7 @@ class Reranker:
             return RerankingResult([], [], self.name)
         top_k = top_k if top_k is not None else len(documents)
         try:
+            faults.hit("reranker.score")
             scores = np.asarray(self._score(query, documents), np.float32)
             if scores.shape != (len(documents),):
                 raise ValueError(f"scorer returned shape {scores.shape}")

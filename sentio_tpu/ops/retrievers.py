@@ -18,6 +18,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from sentio_tpu.config import RetrievalConfig, Settings, get_settings
+from sentio_tpu.infra import faults
 from sentio_tpu.models.document import Document
 from sentio_tpu.ops.bm25 import BM25Index
 from sentio_tpu.ops.dense_index import TpuDenseIndex
@@ -50,6 +51,7 @@ class DenseRetriever(BaseRetriever):
     name: str = "dense"
 
     def retrieve(self, query: str, top_k: int = 10) -> list[Document]:
+        faults.hit("retriever.dense")
         q_vec = self.embedder.embed(query)
         return self.index.retrieve(np.asarray(q_vec, np.float32), top_k)
 
@@ -60,6 +62,7 @@ class SparseRetriever(BaseRetriever):
     name: str = "bm25"
 
     def retrieve(self, query: str, top_k: int = 10) -> list[Document]:
+        faults.hit("retriever.sparse")
         return self.index.retrieve(query, top_k)
 
 

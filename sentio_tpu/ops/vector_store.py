@@ -1,0 +1,311 @@
+"""Pluggable vector stores: the in-tree TPU dense index and an external
+Qdrant adapter, behind one registry.
+
+Parity with the reference's store layer (src/core/vector_store/__init__.py:
+17-57 there — registry of named backends; qdrant_store.py:37-523 — the
+LangChain-style Qdrant wrapper with collection bootstrap, upsert, filtered
+search, and health check). Differences, TPU-first:
+
+* The DEFAULT store is :class:`sentio_tpu.ops.dense_index.TpuDenseIndex` —
+  corpus embeddings live in HBM sharded over the mesh and top-k is an XLA
+  matmul, replacing the external ANN server for NQ-scale corpora
+  (SURVEY.md §2.6 "TPU-native plan").
+* The Qdrant adapter targets Qdrant's REST API directly over httpx — the
+  ``qdrant-client`` package is not a dependency. It exists as the escape
+  hatch for corpora too large for HBM (SURVEY.md §7 "exact-vs-ANN
+  tradeoff") and converts payloads to :class:`Document` with the same
+  multi-key text fallback the reference applies (dense.py:76-104 there).
+
+Both stores expose the surface the retrieval/ingest layers consume:
+``add/delete/clear/size/documents/search/search_batch/retrieve``. Document
+ids are arbitrary strings; Qdrant requires UUID/int point ids, so point ids
+are UUIDv5 hashes of the document id and the original id rides in the
+payload.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import uuid
+from typing import Any, Optional, Protocol, Sequence
+
+import numpy as np
+
+from sentio_tpu.models.document import Document
+
+logger = logging.getLogger(__name__)
+
+_UUID_NS = uuid.UUID("8a6e0804-2bd0-4672-b79d-d97027f9071a")
+
+
+class VectorStore(Protocol):
+    """What retrieval (ops/retrievers.py) and ingest (ops/ingest.py) need."""
+
+    dim: int
+
+    @property
+    def size(self) -> int: ...
+    def documents(self) -> list[Document]: ...
+    def add(self, documents: Sequence[Document], embeddings: np.ndarray) -> None: ...
+    def delete(self, ids: Sequence[str]) -> int: ...
+    def clear(self) -> None: ...
+    def search(self, query: np.ndarray, top_k: int = 10) -> list[tuple[Document, float]]: ...
+    def search_batch(
+        self, queries: np.ndarray, top_k: int = 10
+    ) -> list[list[tuple[Document, float]]]: ...
+    def retrieve(self, query_embedding: np.ndarray, top_k: int = 10) -> list[Document]: ...
+
+
+class VectorStoreError(Exception):
+    pass
+
+
+def _point_id(doc_id: str) -> str:
+    return str(uuid.uuid5(_UUID_NS, doc_id))
+
+
+def _payload_to_document(payload: dict, point_id: str) -> Document:
+    """Payload → Document with the reference's multi-key text fallback
+    (payloads written by other tools may use different content keys)."""
+    text = ""
+    for key in ("text", "content", "page_content", "body"):
+        val = payload.get(key)
+        if isinstance(val, str) and val:
+            text = val
+            break
+    meta = payload.get("metadata")
+    if not isinstance(meta, dict):
+        meta = {k: v for k, v in payload.items() if k not in ("text", "content", "page_content", "body", "doc_id")}
+    return Document(text=text, id=str(payload.get("doc_id") or point_id), metadata=dict(meta))
+
+
+class QdrantVectorStore:
+    """External Qdrant collection over its REST API (httpx, no client lib).
+
+    Synchronous by design: retrieval already runs retriever legs in worker
+    threads, and one HTTP round-trip per search matches the reference's
+    behavior (qdrant_store.py:351-417 there). Collection is bootstrapped on
+    first use with cosine distance — embeddings are L2-normalized by the
+    embedder, so ranking matches the TPU index's inner product.
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        url: str = "http://localhost:6333",
+        collection: str = "sentio",
+        api_key: str = "",
+        timeout_s: float = 10.0,
+        transport: Any = None,  # tests inject httpx.MockTransport
+    ) -> None:
+        import httpx
+
+        self.dim = dim
+        self.collection = collection
+        headers = {"api-key": api_key} if api_key else {}
+        self._client = httpx.Client(
+            base_url=url.rstrip("/"), headers=headers, timeout=timeout_s,
+            transport=transport,
+        )
+        self._bootstrapped = False
+        self._bootstrap_lock = threading.Lock()
+
+    # ------------------------------------------------------------------ http
+
+    def _request(self, method: str, path: str, json_body: Optional[dict] = None) -> dict:
+        import httpx
+
+        try:
+            resp = self._client.request(method, path, json=json_body)
+        except httpx.HTTPError as exc:
+            raise VectorStoreError(f"qdrant {method} {path}: {exc}") from exc
+        if resp.status_code >= 400:
+            raise VectorStoreError(
+                f"qdrant {method} {path} -> {resp.status_code}: {resp.text[:300]}"
+            )
+        return resp.json()
+
+    def _ensure_collection(self) -> None:
+        if self._bootstrapped:
+            return
+        import httpx
+
+        # serialized: retrieval legs run in worker threads, and two
+        # concurrent first queries would otherwise both see 404 and race the
+        # create (Qdrant 409s the loser). A 409 from another PROCESS racing
+        # us is likewise success — the collection exists.
+        with self._bootstrap_lock:
+            if self._bootstrapped:
+                return
+            try:
+                resp = self._client.get(f"/collections/{self.collection}")
+            except httpx.HTTPError as exc:
+                raise VectorStoreError(f"qdrant unreachable: {exc}") from exc
+            if resp.status_code == 404:
+                try:
+                    self._request(
+                        "PUT",
+                        f"/collections/{self.collection}",
+                        {"vectors": {"size": self.dim, "distance": "Cosine"}},
+                    )
+                except VectorStoreError as exc:
+                    if "409" not in str(exc):
+                        raise
+            elif resp.status_code >= 400:
+                raise VectorStoreError(
+                    f"qdrant collection check -> {resp.status_code}: {resp.text[:300]}"
+                )
+            self._bootstrapped = True
+
+    def health(self) -> bool:
+        try:
+            self._request("GET", "/collections")
+            return True
+        except VectorStoreError:
+            return False
+
+    # ------------------------------------------------------------------ crud
+
+    @property
+    def size(self) -> int:
+        self._ensure_collection()
+        out = self._request(
+            "POST", f"/collections/{self.collection}/points/count", {"exact": True}
+        )
+        return int(out["result"]["count"])
+
+    def add(self, documents: Sequence[Document], embeddings: np.ndarray) -> None:
+        embeddings = np.asarray(embeddings, np.float32)
+        if embeddings.ndim != 2 or embeddings.shape[1] != self.dim:
+            raise VectorStoreError(f"expected embeddings [N, {self.dim}], got {embeddings.shape}")
+        if len(documents) != embeddings.shape[0]:
+            raise VectorStoreError("documents/embeddings length mismatch")
+        self._ensure_collection()
+        points = [
+            {
+                "id": _point_id(doc.id),
+                "vector": emb.tolist(),
+                "payload": {"doc_id": doc.id, "text": doc.text, "metadata": doc.metadata},
+            }
+            for doc, emb in zip(documents, embeddings)
+        ]
+        # batch like the reference's upsert batching (async_qdrant_store.py:424-459)
+        for start in range(0, len(points), 128):
+            self._request(
+                "PUT",
+                f"/collections/{self.collection}/points?wait=true",
+                {"points": points[start : start + 128]},
+            )
+
+    def delete(self, ids: Sequence[str]) -> int:
+        if not ids:
+            return 0
+        self._ensure_collection()
+        before = self.size
+        self._request(
+            "POST",
+            f"/collections/{self.collection}/points/delete?wait=true",
+            {"points": [_point_id(i) for i in ids]},
+        )
+        return max(before - self.size, 0)
+
+    def clear(self) -> None:
+        self._request("DELETE", f"/collections/{self.collection}")
+        self._bootstrapped = False
+
+    def documents(self) -> list[Document]:
+        """Scroll the whole collection (the reference's corpus hydration,
+        retrievers/factory.py:83-133 there) — feeds BM25 rebuild."""
+        self._ensure_collection()
+        docs: list[Document] = []
+        offset = None
+        while True:
+            body: dict = {"limit": 256, "with_payload": True, "with_vector": False}
+            if offset is not None:
+                body["offset"] = offset
+            out = self._request(
+                "POST", f"/collections/{self.collection}/points/scroll", body
+            )
+            result = out["result"]
+            for pt in result["points"]:
+                docs.append(_payload_to_document(pt.get("payload") or {}, str(pt["id"])))
+            offset = result.get("next_page_offset")
+            if offset is None:
+                return docs
+
+    # ---------------------------------------------------------------- search
+
+    def search(self, query: np.ndarray, top_k: int = 10) -> list[tuple[Document, float]]:
+        self._ensure_collection()
+        query = np.asarray(query, np.float32).reshape(-1)
+        out = self._request(
+            "POST",
+            f"/collections/{self.collection}/points/search",
+            {"vector": query.tolist(), "limit": int(top_k), "with_payload": True},
+        )
+        hits = []
+        for hit in out["result"]:
+            doc = _payload_to_document(hit.get("payload") or {}, str(hit["id"]))
+            hits.append((doc, float(hit["score"])))
+        return hits
+
+    def search_batch(
+        self, queries: np.ndarray, top_k: int = 10
+    ) -> list[list[tuple[Document, float]]]:
+        self._ensure_collection()
+        queries = np.asarray(queries, np.float32)
+        body = {
+            "searches": [
+                {"vector": q.tolist(), "limit": int(top_k), "with_payload": True}
+                for q in queries
+            ]
+        }
+        out = self._request(
+            "POST", f"/collections/{self.collection}/points/search/batch", body
+        )
+        batches = []
+        for result in out["result"]:
+            hits = []
+            for hit in result:
+                doc = _payload_to_document(hit.get("payload") or {}, str(hit["id"]))
+                hits.append((doc, float(hit["score"])))
+            batches.append(hits)
+        return batches
+
+    def retrieve(self, query_embedding: np.ndarray, top_k: int = 10) -> list[Document]:
+        out = []
+        for doc, score in self.search(query_embedding, top_k):
+            meta = dict(doc.metadata)
+            meta["score"] = score
+            meta["retriever"] = "qdrant"
+            out.append(Document(text=doc.text, id=doc.id, metadata=meta))
+        return out
+
+    def close(self) -> None:
+        self._client.close()
+
+
+def get_vector_store(
+    name: str,
+    dim: int,
+    mesh: Any = None,
+    settings: Any = None,
+    **kwargs: Any,
+) -> Any:
+    """Registry: ``tpu`` (in-HBM exact index, default) | ``qdrant``
+    (external REST adapter). Mirrors the reference's named-store factory
+    (vector_store/__init__.py:17-57 there)."""
+    if name == "tpu":
+        from sentio_tpu.ops.dense_index import TpuDenseIndex
+
+        dtype = kwargs.pop("dtype", "bfloat16")
+        return TpuDenseIndex(dim=dim, mesh=mesh, dtype=dtype)
+    if name == "qdrant":
+        r = settings.retrieval if settings is not None else None
+        url = kwargs.pop("url", "") or (r.qdrant_url if r else "") or "http://localhost:6333"
+        collection = kwargs.pop("collection", None) or (r.collection_name if r else "sentio")
+        if "api_key" not in kwargs and r is not None:
+            kwargs["api_key"] = r.qdrant_api_key
+        return QdrantVectorStore(dim=dim, url=url, collection=collection, **kwargs)
+    raise VectorStoreError(f"unknown vector store {name!r} (expected: tpu, qdrant)")

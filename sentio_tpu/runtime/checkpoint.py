@@ -274,16 +274,18 @@ class CheckpointManager:
         self,
         step: Optional[int] = None,
         shardings: Optional[Mapping[str, Any]] = None,
-    ) -> tuple[int, dict[str, Any], dict]:
-        """→ (step, {name: tree}, meta). Newest step when ``step`` is None;
-        corrupt newest steps are skipped with older ones tried in order."""
+    ) -> tuple[int, dict[str, Any], dict[str, dict]]:
+        """→ (step, {name: tree}, {name: meta}). Newest step when ``step``
+        is None; corrupt newest steps are skipped with older ones tried in
+        order. Metas are per-tree — a step assembled from separate
+        ``save_pytree`` calls can carry a different meta per tree."""
         candidates = [step] if step is not None else list(reversed(self.all_steps()))
         last_err: Optional[Exception] = None
         for s in candidates:
             d = self.base / self._step_name(s)
             try:
                 trees: dict[str, Any] = {}
-                meta: dict = {}
+                metas: dict[str, dict] = {}
                 names = sorted(
                     p.name for p in d.iterdir() if p.is_dir() and not p.name.startswith(".")
                 )
@@ -291,8 +293,8 @@ class CheckpointManager:
                     raise CheckpointError(f"empty checkpoint step {s}")
                 for name in names:
                     sh = (shardings or {}).get(name)
-                    trees[name], meta = load_pytree(d / name, shardings=sh)
-                return s, trees, meta
+                    trees[name], metas[name] = load_pytree(d / name, shardings=sh)
+                return s, trees, metas
             except (CheckpointError, OSError, ValueError, KeyError, zipfile.BadZipFile) as e:
                 # BadZipFile: power loss can truncate arrays.npz (save does
                 # not fsync); fall back to the previous step

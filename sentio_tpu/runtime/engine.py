@@ -203,6 +203,10 @@ class GeneratorEngine:
         import jax
         import jax.numpy as jnp
 
+        from sentio_tpu.infra import faults
+
+        faults.hit("engine.generate")
+
         max_batch = max(self.BATCH_BUCKETS)
         if len(prompts) > max_batch:
             out: list[GenerationResult] = []

@@ -127,8 +127,14 @@ def _make_observability_middleware(container: DependencyContainer):
         path = request.path
         t0 = time.perf_counter()
         status = 500
+        metrics = get_metrics()
+        # queue-depth gauge: the k8s HPA scales TPU slices on this signal
+        # (deploy/kubernetes/hpa.yaml) — probes/metrics scrapes excluded
+        work = not path.startswith(("/health", "/metrics"))
+        if work:
+            metrics.adjust_inflight(+1)
         try:
-            if not path.startswith(("/health", "/metrics")) and path != "/":
+            if work and path != "/":
                 endpoint = "/embed" if path == "/embed" else "*"
                 ip = _client_ip(request, trust_proxy=container.settings.serve.trust_proxy_headers)
                 container.rate_limiter.check(ip, endpoint)
@@ -145,7 +151,9 @@ def _make_observability_middleware(container: DependencyContainer):
             status = exc.status
             raise
         finally:
-            get_metrics().record_request(path, status, time.perf_counter() - t0)
+            if work:
+                metrics.adjust_inflight(-1)
+            metrics.record_request(path, status, time.perf_counter() - t0)
 
     return observability_middleware
 

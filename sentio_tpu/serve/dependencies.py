@@ -80,6 +80,19 @@ class DependencyContainer:
 
             from sentio_tpu.ops.dense_index import TpuDenseIndex
 
+            cfg = self.settings.retrieval
+            if cfg.index_backend != "tpu":
+                # external-store escape hatch (SURVEY.md §7: corpora too
+                # large for in-HBM exact search) — one construction path,
+                # the registry, so config wiring can't drift
+                from sentio_tpu.ops.vector_store import get_vector_store
+
+                return get_vector_store(
+                    cfg.index_backend,
+                    dim=self.embedder.dimension,
+                    mesh=self.mesh,
+                    settings=self.settings,
+                )
             path = self.settings.retrieval.index_path
             # save() writes <path>.npz + <path>.json — check the metadata file
             if path and Path(path).with_suffix(".json").exists():

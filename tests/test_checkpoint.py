@@ -130,8 +130,8 @@ class TestManager:
         params = init_llama(jax.random.PRNGKey(0), cfg)
         mgr = CheckpointManager(tmp_path)
         mgr.save(0, {"params": params}, meta={"config": cfg.__dict__})
-        step, trees, meta = mgr.restore()
-        assert meta["config"]["dim"] == cfg.dim
+        step, trees, metas = mgr.restore()
+        assert metas["params"]["config"]["dim"] == cfg.dim
         got, want = trees["params"], params
         for path in (["embed_tokens", "embedding"], ["layers_0", "attn", "wq", "kernel"]):
             g, w = got, want
@@ -180,3 +180,17 @@ class TestReviewRegressions:
         (tmp_path / ".old-step_00000001-123").mkdir(parents=True)
         CheckpointManager(tmp_path)
         assert not list(tmp_path.glob(".tmp-*")) and not list(tmp_path.glob(".old-*"))
+
+    def test_restore_returns_per_tree_metas(self, tmp_path, tree):
+        """A step assembled from separate save_pytree calls keeps each
+        tree's own meta — the manager must not collapse them to one."""
+        from sentio_tpu.runtime.checkpoint import save_pytree as sp
+
+        step_dir = tmp_path / "step_00000003"
+        sp(step_dir / "params", tree, meta={"config": {"dim": 64}})
+        sp(step_dir / "zindex", {"x": np.ones(1, np.float32)}, meta={"rows": 1})
+        (step_dir / ".complete").write_text("1")
+        mgr = CheckpointManager(tmp_path)
+        _, trees, metas = mgr.restore()
+        assert metas["params"]["config"]["dim"] == 64
+        assert metas["zindex"]["rows"] == 1

@@ -1,0 +1,83 @@
+"""CLI surface: trace (studio-equivalent execution dump) and convert
+(HF checkpoint import), driven through main() with fake model providers."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from sentio_tpu.cli import main
+from sentio_tpu.config import (
+    EmbedderConfig,
+    GeneratorConfig,
+    RerankConfig,
+    Settings,
+    set_settings,
+)
+
+
+@pytest.fixture()
+def fake_settings():
+    s = Settings(
+        embedder=EmbedderConfig(provider="hash", dim=32),
+        generator=GeneratorConfig(provider="echo", use_verifier=False, max_new_tokens=16),
+        rerank=RerankConfig(enabled=False),
+    )
+    set_settings(s)
+    yield s
+    set_settings(None)
+
+
+class TestTrace:
+    def test_trace_dumps_execution(self, fake_settings, tmp_path, capsys):
+        doc = tmp_path / "doc.txt"
+        doc.write_text("TPUs pair a systolic MXU with HBM for fast matmul.")
+        rc = main(["trace", "what is an MXU?", "--ingest", str(tmp_path), "--documents"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["graph_path"][0] == "retrieve"
+        assert "generate" in out["graph_path"]
+        assert out["num_retrieved"] >= 1
+        assert out["node_timings_ms"]
+        assert out["selected_documents"]
+        assert out["answer"]
+
+    def test_trace_empty_index_degrades(self, fake_settings, capsys):
+        rc = main(["trace", "anything"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["num_retrieved"] == 0
+
+
+class TestConvert:
+    def test_convert_llama_dir_round_trip(self, fake_settings, tmp_path, capsys):
+        transformers = pytest.importorskip("transformers")
+        torch = pytest.importorskip("torch")
+
+        cfg = transformers.LlamaConfig(
+            vocab_size=64, hidden_size=16, intermediate_size=32,
+            num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=1,
+            max_position_embeddings=32,
+        )
+        torch.manual_seed(0)
+        src = tmp_path / "hf"
+        transformers.LlamaForCausalLM(cfg).save_pretrained(src)
+        dst = tmp_path / "ckpt"
+        rc = main(["convert", "llama", str(src), str(dst), "--dtype", "float32"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["config"]["dim"] == 16
+
+        from sentio_tpu.runtime.checkpoint import load_pytree
+
+        params, meta = load_pytree(dst)
+        assert meta["family"] == "llama"
+        assert params["embed_tokens"]["embedding"].shape == (64, 16)
+
+
+class TestInfo:
+    def test_info_runs(self, fake_settings, capsys):
+        assert main(["info"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert "devices" in out and out["devices"]

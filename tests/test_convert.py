@@ -161,3 +161,21 @@ class TestCrossEncoderConversion:
             type_ids=jnp.zeros_like(jnp.asarray(ids)),
         )
         np.testing.assert_allclose(np.asarray(got), ref, atol=5e-4, rtol=2e-3)
+
+
+class TestDtypeStorage:
+    def test_load_dir_casts_to_requested_dtype(self, tiny_hf_llama, tmp_path):
+        """--dtype bfloat16 must reach the stored arrays (half the disk/RAM
+        for 8B-class checkpoints), not just the config metadata."""
+        model, _ = tiny_hf_llama
+        src = tmp_path / "hf"
+        model.save_pretrained(src)
+
+        from sentio_tpu.models.convert import load_llama_dir
+
+        params, cfg = load_llama_dir(src, dtype="bfloat16")
+        assert str(params["embed_tokens"]["embedding"].dtype) == "bfloat16"
+        assert str(params["layers_0"]["attn"]["wq"]["kernel"].dtype) == "bfloat16"
+
+        params32, _ = load_llama_dir(src, dtype="float32")
+        assert params32["lm_head"]["kernel"].dtype == np.float32

@@ -500,3 +500,25 @@ def test_rate_limiter_sweeps_idle_keys():
         rl._checks_since_sweep = 10_000
     rl.check("fresh-ip", "/x")
     assert len(rl._events) <= 2
+
+
+class TestInflightGauge:
+    def test_inflight_tracks_and_floors_at_zero(self):
+        from sentio_tpu.infra.metrics import MetricsCollector
+
+        m = MetricsCollector(enabled=True)
+        m.adjust_inflight(+1)
+        m.adjust_inflight(+1)
+        assert m.export_json()["gauges"]["inflight()"] == 2.0
+        m.adjust_inflight(-1)
+        m.adjust_inflight(-1)
+        m.adjust_inflight(-1)  # never below zero
+        assert m.export_json()["gauges"]["inflight()"] == 0.0
+
+    def test_track_request_brackets_inflight(self):
+        from sentio_tpu.infra.metrics import MetricsCollector
+
+        m = MetricsCollector(enabled=True)
+        with m.track_request("/chat"):
+            assert m.export_json()["gauges"]["inflight()"] == 1.0
+        assert m.export_json()["gauges"]["inflight()"] == 0.0

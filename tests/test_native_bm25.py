@@ -222,3 +222,54 @@ class TestTieBound:
         assert [i for i, _ in out] == list(range(10))
         nat = NativeBM25Index().build(docs)
         assert nat.search("boilerplate", top_k=10) == out
+
+
+class TestRebuildConsistency:
+    def test_inflight_query_uses_handle_snapshot_after_shrink(self):
+        """A query holding the old handle mid-rebuild must size buffers by
+        the OLD corpus (the C++ core writes old-n_docs floats — live size
+        would overflow after a shrink) and resolve indices against the OLD
+        document list."""
+        nat = NativeBM25Index().build(corpus(250))
+        box = nat._get_box()
+        assert box is not None and box.acquire()
+        try:
+            nat.build(corpus(40))  # shrink under the in-flight query
+            assert box.n_docs == 250
+            hits = nat._native_search(box, "tpu jax kernel", top_k=5)
+            for di, _ in hits:
+                assert 0 <= di < 250
+                assert box.documents[di].id.startswith("d")
+        finally:
+            box.release()
+        # post-rebuild queries see the new corpus
+        assert all(0 <= di < 40 for di, _ in nat.search("tpu jax kernel", top_k=5))
+
+    def test_retrieve_documents_match_scores_under_churn(self):
+        """Stress: concurrent retrieves during shrinking/growing rebuilds
+        return documents whose metadata is internally consistent."""
+        import threading
+
+        nat = NativeBM25Index().build(corpus(300))
+        stop = threading.Event()
+        errors = []
+
+        def worker():
+            while not stop.is_set():
+                try:
+                    for doc in nat.retrieve("tpu jax kernel shard", top_k=5):
+                        if not doc.id.startswith("d"):
+                            errors.append(f"bad id {doc.id}")
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+                    return
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for n in (30, 280, 10, 300, 50):
+            nat.build(corpus(n))
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors

@@ -1,0 +1,231 @@
+"""Vector store registry + Qdrant REST adapter against an in-memory fake
+Qdrant served through httpx.MockTransport — the reference's mock-client
+test pattern (test_jina_embeddings.py there injects a mock httpx client);
+here the fake implements enough of the REST surface (collection bootstrap,
+upsert, count, scroll, search, batch search, delete) to check behavior,
+including ranking parity with the in-tree TPU index on the same vectors.
+"""
+
+from __future__ import annotations
+
+import json
+
+import httpx
+import numpy as np
+import pytest
+
+from sentio_tpu.models.document import Document
+from sentio_tpu.ops.dense_index import TpuDenseIndex
+from sentio_tpu.ops.vector_store import (
+    QdrantVectorStore,
+    VectorStoreError,
+    get_vector_store,
+)
+
+
+class FakeQdrant:
+    """Minimal in-memory Qdrant REST double with exact cosine scoring."""
+
+    def __init__(self):
+        self.collections: dict[str, dict] = {}
+
+    def handler(self, request: httpx.Request) -> httpx.Response:
+        path = request.url.path
+        body = json.loads(request.content) if request.content else {}
+        parts = [p for p in path.split("/") if p]
+        if parts == ["collections"]:
+            return self._ok({"collections": [{"name": n} for n in self.collections]})
+        name = parts[1]
+        if len(parts) == 2:
+            if request.method == "GET":
+                if name not in self.collections:
+                    return httpx.Response(404, json={"status": {"error": "not found"}})
+                return self._ok({"status": "green"})
+            if request.method == "PUT":
+                self.collections[name] = {"points": {}, "dim": body["vectors"]["size"]}
+                return self._ok(True)
+            if request.method == "DELETE":
+                self.collections.pop(name, None)
+                return self._ok(True)
+        col = self.collections.get(name)
+        if col is None:
+            return httpx.Response(404, json={"status": {"error": "no collection"}})
+        op = parts[-1]
+        if op == "points" and request.method == "PUT":
+            for pt in body["points"]:
+                col["points"][pt["id"]] = pt
+            return self._ok({"status": "completed"})
+        if op == "count":
+            return self._ok({"count": len(col["points"])})
+        if op == "delete":
+            for pid in body["points"]:
+                col["points"].pop(pid, None)
+            return self._ok({"status": "completed"})
+        if op == "scroll":
+            ids = sorted(col["points"])
+            start = 0 if "offset" not in body else ids.index(body["offset"])
+            page = ids[start : start + body["limit"]]
+            nxt = ids[start + body["limit"]] if start + body["limit"] < len(ids) else None
+            return self._ok({
+                "points": [
+                    {"id": pid, "payload": col["points"][pid]["payload"]} for pid in page
+                ],
+                "next_page_offset": nxt,
+            })
+        if op == "search":
+            return self._ok(self._search(col, body))
+        if op == "batch":  # .../points/search/batch
+            return self._ok([self._search(col, s) for s in body["searches"]])
+        return httpx.Response(400, json={"status": {"error": f"unhandled {path}"}})
+
+    def _search(self, col, body):
+        q = np.asarray(body["vector"], np.float32)
+        qn = q / max(np.linalg.norm(q), 1e-9)
+        scored = []
+        for pid, pt in col["points"].items():
+            v = np.asarray(pt["vector"], np.float32)
+            vn = v / max(np.linalg.norm(v), 1e-9)
+            scored.append((float(qn @ vn), pid))
+        scored.sort(key=lambda t: (-t[0], t[1]))
+        return [
+            {"id": pid, "score": s, "payload": col["points"][pid]["payload"]}
+            for s, pid in scored[: body["limit"]]
+        ]
+
+    @staticmethod
+    def _ok(result):
+        return httpx.Response(200, json={"status": "ok", "result": result})
+
+
+@pytest.fixture()
+def fake():
+    return FakeQdrant()
+
+
+@pytest.fixture()
+def store(fake):
+    return QdrantVectorStore(
+        dim=8, collection="test", transport=httpx.MockTransport(fake.handler)
+    )
+
+
+def mk_docs_vecs(n=6, dim=8, seed=0):
+    rng = np.random.default_rng(seed)
+    vecs = rng.standard_normal((n, dim)).astype(np.float32)
+    vecs /= np.linalg.norm(vecs, axis=1, keepdims=True)
+    docs = [Document(text=f"doc {i}", id=f"d{i}", metadata={"i": i}) for i in range(n)]
+    return docs, vecs
+
+
+class TestQdrantAdapter:
+    def test_add_count_search(self, store):
+        docs, vecs = mk_docs_vecs()
+        store.add(docs, vecs)
+        assert store.size == 6
+        hits = store.search(vecs[2], top_k=3)
+        assert hits[0][0].id == "d2"
+        assert hits[0][1] == pytest.approx(1.0, abs=1e-5)
+
+    def test_upsert_same_id_overwrites(self, store):
+        docs, vecs = mk_docs_vecs()
+        store.add(docs, vecs)
+        store.add([Document(text="updated", id="d0", metadata={})], vecs[:1])
+        assert store.size == 6
+        hits = store.search(vecs[0], top_k=1)
+        assert hits[0][0].text == "updated"
+
+    def test_delete(self, store):
+        docs, vecs = mk_docs_vecs()
+        store.add(docs, vecs)
+        assert store.delete(["d1", "d3", "missing"]) == 2
+        assert store.size == 4
+
+    def test_documents_scroll_pagination(self, store):
+        docs, vecs = mk_docs_vecs(n=600)  # > one 256-point scroll page
+        store.add(docs, vecs)
+        got = store.documents()
+        assert len(got) == 600
+        assert {d.id for d in got} == {d.id for d in docs}
+
+    def test_retrieve_contract(self, store):
+        docs, vecs = mk_docs_vecs()
+        store.add(docs, vecs)
+        out = store.retrieve(vecs[4], top_k=2)
+        assert out[0].id == "d4"
+        assert out[0].metadata["retriever"] == "qdrant"
+        assert "score" in out[0].metadata
+
+    def test_clear_drops_collection(self, store):
+        docs, vecs = mk_docs_vecs()
+        store.add(docs, vecs)
+        store.clear()
+        assert store.size == 0  # re-bootstraps empty
+
+    def test_batch_search(self, store):
+        docs, vecs = mk_docs_vecs()
+        store.add(docs, vecs)
+        batches = store.search_batch(vecs[:3], top_k=2)
+        assert [b[0][0].id for b in batches] == ["d0", "d1", "d2"]
+
+    def test_shape_mismatch_raises(self, store):
+        docs, vecs = mk_docs_vecs()
+        with pytest.raises(VectorStoreError):
+            store.add(docs, vecs[:, :4])
+
+    def test_unreachable_raises_store_error(self):
+        def down(request):
+            raise httpx.ConnectError("connection refused")
+
+        s = QdrantVectorStore(dim=8, transport=httpx.MockTransport(down))
+        with pytest.raises(VectorStoreError):
+            s.search(np.zeros(8, np.float32))
+
+    def test_payload_text_fallback(self, fake, store):
+        """Payloads written by other tools use 'content' etc. — the adapter
+        applies the reference's multi-key fallback (dense.py:76-104 there)."""
+        store.add([Document(text="x", id="seed", metadata={})],
+                  np.ones((1, 8), np.float32))
+        pid = next(iter(fake.collections["test"]["points"]))
+        fake.collections["test"]["points"][pid]["payload"] = {
+            "content": "alt content", "doc_id": "seed", "extra": 1
+        }
+        hits = store.search(np.ones(8, np.float32), top_k=1)
+        assert hits[0][0].text == "alt content"
+
+
+class TestRankingParityWithTpuIndex:
+    def test_same_ranking_as_dense_index(self, store):
+        docs, vecs = mk_docs_vecs(n=40, seed=3)
+        store.add(docs, vecs)
+        tpu = TpuDenseIndex(dim=8, dtype="float32")
+        tpu.add(docs, vecs)
+        rng = np.random.default_rng(9)
+        for _ in range(5):
+            q = rng.standard_normal(8).astype(np.float32)
+            a = [d.id for d, _ in store.search(q, top_k=5)]
+            b = [d.id for d, _ in tpu.search(q, top_k=5)]
+            assert a == b
+
+
+class TestRegistry:
+    def test_tpu_default(self):
+        idx = get_vector_store("tpu", dim=16)
+        assert isinstance(idx, TpuDenseIndex)
+
+    def test_qdrant_entry(self):
+        s = get_vector_store("qdrant", dim=16, url="http://example:6333",
+                             transport=httpx.MockTransport(FakeQdrant().handler))
+        assert isinstance(s, QdrantVectorStore)
+
+    def test_unknown_raises(self):
+        with pytest.raises(VectorStoreError):
+            get_vector_store("hnswlib", dim=16)
+
+    def test_container_respects_index_backend(self, settings):
+        from sentio_tpu.config import EmbedderConfig
+        from sentio_tpu.serve.dependencies import DependencyContainer
+
+        settings.embedder = EmbedderConfig(provider="hash", dim=8)
+        settings.retrieval.index_backend = "qdrant"
+        c = DependencyContainer(settings=settings)
+        assert isinstance(c.dense_index, QdrantVectorStore)
