@@ -13,8 +13,11 @@ FROM ${BASE}
 
 WORKDIR /app
 
-# no requirements install: jax/flax/optax/aiohttp ship in the base image;
-# the package itself is dependency-light by design (see README)
+# the JAX TPU base ships the jax stack; slim/CPU bases need the runtime deps
+COPY requirements.txt ./
+RUN python -c "import jax, aiohttp, httpx, einops, optax" 2>/dev/null \
+    || pip install --no-cache-dir -r requirements.txt
+
 COPY sentio_tpu/ sentio_tpu/
 COPY prompts/ prompts/
 COPY bench.py ./

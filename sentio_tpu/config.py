@@ -149,6 +149,9 @@ class RerankConfig:
     top_k: int = 5
     max_pair_tokens: int = 512
     batch_size: int = 32
+    # converted checkpoint (cli convert cross-encoder ...)
+    checkpoint_path: str = ""
+    tokenizer_path: str = ""
 
     @classmethod
     def from_env(cls) -> "RerankConfig":
@@ -158,6 +161,8 @@ class RerankConfig:
             top_k=_env_int(["RERANK_TOP_K"], 5),
             max_pair_tokens=_env_int(["RERANK_MAX_PAIR_TOKENS"], 512),
             batch_size=_env_int(["RERANK_BATCH_SIZE"], 32),
+            checkpoint_path=_env_str(["RERANKER_CHECKPOINT"], ""),
+            tokenizer_path=_env_str(["RERANKER_TOKENIZER"], ""),
         )
 
 
@@ -174,6 +179,9 @@ class EmbedderConfig:
     cache_size: int = 10_000
     cache_ttl_s: float = 3600.0
     model_preset: str = "base"  # tiny | base (tiny = CPU-test scale)
+    # converted checkpoint (cli convert encoder ...); "" = random-init preset
+    checkpoint_path: str = ""
+    tokenizer_path: str = ""  # local HF tokenizer dir (usually the HF src dir)
 
     @classmethod
     def from_env(cls) -> "EmbedderConfig":
@@ -185,6 +193,8 @@ class EmbedderConfig:
             cache_size=_env_int(["EMBEDDING_CACHE_SIZE"], 10_000),
             cache_ttl_s=_env_float(["EMBEDDING_CACHE_TTL"], 3600.0),
             model_preset=_env_str(["EMBEDDER_PRESET"], "base"),
+            checkpoint_path=_env_str(["EMBEDDER_CHECKPOINT"], ""),
+            tokenizer_path=_env_str(["EMBEDDER_TOKENIZER"], ""),
         )
 
 
@@ -195,7 +205,8 @@ class GeneratorConfig:
 
     provider: str = "tpu"  # tpu | echo (deterministic fake)
     model_preset: str = "llama3-8b"  # llama3-8b | tiny
-    checkpoint_path: str = ""
+    checkpoint_path: str = ""  # converted checkpoint (cli convert llama ...)
+    tokenizer_path: str = ""  # local HF tokenizer dir
     mode: str = "balanced"  # fast | balanced | quality | creative
     max_new_tokens: int = 1024
     context_token_budget: int = 2000
@@ -224,6 +235,7 @@ class GeneratorConfig:
             provider=_env_str(["LLM_PROVIDER", "CHAT_LLM_PROVIDER"], "tpu"),
             model_preset=_env_str(["LLM_MODEL", "CHAT_LLM_MODEL"], "llama3-8b"),
             checkpoint_path=_env_str(["LLM_CHECKPOINT", "MODEL_PATH"], ""),
+            tokenizer_path=_env_str(["LLM_TOKENIZER", "TOKENIZER_PATH"], ""),
             mode=_env_str(["LLM_MODE"], "balanced"),
             max_new_tokens=_env_int(["LLM_MAX_TOKENS", "MAX_NEW_TOKENS"], 1024),
             context_token_budget=_env_int(["CONTEXT_TOKEN_BUDGET"], 2000),
@@ -283,14 +295,19 @@ class ServeConfig:
     @classmethod
     def from_env(cls) -> "ServeConfig":
         return cls(
-            host=_env_str(["API_HOST", "HOST"], "0.0.0.0"),
-            port=_env_int(["API_PORT", "PORT"], 8000),
-            rate_limit_embed_per_min=_env_int(["RATE_LIMIT_EMBED"], 10),
-            rate_limit_default_per_min=_env_int(["RATE_LIMIT_DEFAULT"], 100),
+            host=_env_str(["SENTIO_HOST", "API_HOST", "HOST"], "0.0.0.0"),
+            port=_env_int(["SENTIO_PORT", "API_PORT", "PORT"], 8000),
+            rate_limit_embed_per_min=_env_int(
+                ["RATE_LIMIT_EMBED_PER_MIN", "RATE_LIMIT_EMBED"], 10
+            ),
+            rate_limit_default_per_min=_env_int(
+                ["RATE_LIMIT_DEFAULT_PER_MIN", "RATE_LIMIT_DEFAULT"], 100
+            ),
             max_question_chars=_env_int(["MAX_QUESTION_CHARS"], 2000),
             max_embed_chars=_env_int(["MAX_EMBED_CHARS"], 50_000),
             top_k_max=_env_int(["TOP_K_MAX"], 20),
             cors_origins=_env_str(["CORS_ORIGINS"], "*"),
+            trust_proxy_headers=_env_bool(["TRUST_PROXY_HEADERS"], False),
             batch_deadline_ms=_env_float(["BATCH_DEADLINE_MS"], 8.0),
             batch_max_size=_env_int(["BATCH_MAX_SIZE"], 8),
         )

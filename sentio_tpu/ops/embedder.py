@@ -203,6 +203,15 @@ class TpuEmbedder(BaseEmbedder):
             mean_pool,
         )
 
+        if params is None and self.config.checkpoint_path:
+            # real weights: a `cli convert encoder` checkpoint + HF tokenizer
+            from sentio_tpu.runtime.weights import load_model
+
+            params, model_config, ck_tok = load_model(
+                self.config.checkpoint_path, expect_family="encoder",
+                tokenizer_path=self.config.tokenizer_path,
+            )
+            tokenizer = tokenizer or ck_tok
         self.model_config = model_config or (
             EncoderConfig.tiny() if self.config.model_preset == "tiny" else EncoderConfig.base()
         )

@@ -251,9 +251,16 @@ class DocumentIngestor:
     @property
     def dense_index(self):
         if self._dense_index is None:
-            from sentio_tpu.ops.dense_index import TpuDenseIndex
+            # through the registry so INDEX_BACKEND=qdrant ingests into the
+            # same external store the serving pods retrieve from — a local
+            # default here would silently ingest into a process-private index
+            from sentio_tpu.ops.vector_store import get_vector_store
 
-            self._dense_index = TpuDenseIndex(dim=self.embedder.dimension)
+            self._dense_index = get_vector_store(
+                self.settings.retrieval.index_backend,
+                dim=self.embedder.dimension,
+                settings=self.settings,
+            )
         return self._dense_index
 
     # ----------------------------------------------------------------- load

@@ -120,6 +120,15 @@ class CrossEncoderReranker(Reranker):
         from sentio_tpu.models.transformer import EncoderConfig
 
         self.config = config or get_settings().rerank
+        if params is None and self.config.checkpoint_path:
+            # real weights: a `cli convert cross-encoder` checkpoint
+            from sentio_tpu.runtime.weights import load_model
+
+            params, model_config, ck_tok = load_model(
+                self.config.checkpoint_path, expect_family="cross-encoder",
+                tokenizer_path=self.config.tokenizer_path,
+            )
+            tokenizer = tokenizer or ck_tok
         self.model_config = model_config or EncoderConfig.tiny()
         self.tokenizer = tokenizer or ByteTokenizer(self.model_config.vocab_size)
         if params is None:

@@ -202,13 +202,22 @@ class QdrantVectorStore:
         if not ids:
             return 0
         self._ensure_collection()
-        before = self.size
+        pids = [_point_id(i) for i in ids]
+        # which of these actually exist (retrieve-by-ids, no payloads) —
+        # counting size before/after instead would race concurrent writers
+        # and cost two exact-count collection scans
+        existing = self._request(
+            "POST",
+            f"/collections/{self.collection}/points",
+            {"ids": pids, "with_payload": False, "with_vector": False},
+        )
+        n = len(existing.get("result") or [])
         self._request(
             "POST",
             f"/collections/{self.collection}/points/delete?wait=true",
-            {"points": [_point_id(i) for i in ids]},
+            {"points": pids},
         )
-        return max(before - self.size, 0)
+        return n
 
     def clear(self) -> None:
         self._request("DELETE", f"/collections/{self.collection}")

@@ -59,6 +59,15 @@ class GeneratorEngine:
         from sentio_tpu.models.tokenizer import ByteTokenizer
 
         self.config = config or get_settings().generator
+        if params is None and self.config.checkpoint_path:
+            # real weights: a `cli convert llama` checkpoint + HF tokenizer
+            from sentio_tpu.runtime.weights import load_model
+
+            params, model_config, ck_tok = load_model(
+                self.config.checkpoint_path, expect_family="llama",
+                tokenizer_path=self.config.tokenizer_path,
+            )
+            tokenizer = tokenizer or ck_tok
         self.model_config = model_config or (
             LlamaConfig.tiny() if self.config.model_preset == "tiny" else LlamaConfig.llama3_8b()
         )

@@ -1,0 +1,92 @@
+"""Checkpoint → live model resolution for the serving stack.
+
+The reference has no weights anywhere — its models are remote APIs keyed by
+env credentials (settings.py:27-191 there picks providers/urls). Here the
+equivalent configuration surface is a *checkpoint path* per model family
+(generator, embedder, reranker): ``cli convert`` writes framework
+checkpoints (runtime/checkpoint.py format, meta carrying the model family
+and config), and this module loads them back into (params, model_config,
+tokenizer) triples for the constructors in ops/ and runtime/engine.py.
+
+Resolution order per model (mirrors the reference's provider-selection
+semantics, factory.py:20-27 there, with its mock-mode fallback):
+
+1. ``checkpoint_path`` set → load params + config from the checkpoint;
+   tokenizer from ``tokenizer_path`` (a local HF tokenizer dir — usually
+   the original HF checkpoint dir) when given.
+2. No path → random-init at the preset size (the deterministic fake-model
+   mode tests and offline dev run on, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from sentio_tpu.runtime.checkpoint import CheckpointError, load_pytree
+
+logger = logging.getLogger(__name__)
+
+_FAMILY_CONFIGS = {
+    "llama": ("sentio_tpu.models.llama", "LlamaConfig"),
+    "encoder": ("sentio_tpu.models.transformer", "EncoderConfig"),
+    "cross-encoder": ("sentio_tpu.models.transformer", "EncoderConfig"),
+}
+
+
+class WeightsError(Exception):
+    pass
+
+
+def load_model(
+    checkpoint_path: str,
+    expect_family: Optional[str] = None,
+    tokenizer_path: str = "",
+) -> tuple[Any, Any, Optional[Any]]:
+    """→ (params, model_config, tokenizer|None) from a ``cli convert`` /
+    ``save_pytree`` checkpoint. The meta's recorded config reconstructs the
+    exact dataclass the weights were converted for — a preset mismatch
+    cannot silently produce shape errors deep in the first forward pass."""
+    try:
+        params, meta = load_pytree(checkpoint_path)
+    except CheckpointError as exc:
+        raise WeightsError(f"cannot load checkpoint {checkpoint_path!r}: {exc}") from exc
+
+    family = meta.get("family")
+    if expect_family and family and family != expect_family:
+        raise WeightsError(
+            f"checkpoint {checkpoint_path!r} holds a {family!r} model, "
+            f"expected {expect_family!r}"
+        )
+    cfg_dict = meta.get("config")
+    if not cfg_dict:
+        raise WeightsError(f"checkpoint {checkpoint_path!r} has no config in meta")
+    lookup = family or expect_family
+    if lookup not in _FAMILY_CONFIGS:
+        raise WeightsError(f"unknown model family {lookup!r} in {checkpoint_path!r}")
+    mod_name, cls_name = _FAMILY_CONFIGS[lookup]
+    import importlib
+
+    cfg_cls = getattr(importlib.import_module(mod_name), cls_name)
+    # tuples serialize as lists in JSON meta; dataclass fields that want
+    # tuples get them back
+    fields = {f.name: f.type for f in cfg_cls.__dataclass_fields__.values()}  # type: ignore[attr-defined]
+    kwargs = {k: v for k, v in cfg_dict.items() if k in fields}
+    model_config = cfg_cls(**kwargs)
+
+    tokenizer = None
+    if tokenizer_path:
+        from sentio_tpu.models.tokenizer import HFTokenizer
+
+        tokenizer = HFTokenizer(tokenizer_path)
+        if tokenizer.vocab_size > model_config.vocab_size:
+            raise WeightsError(
+                f"tokenizer at {tokenizer_path!r} has vocab {tokenizer.vocab_size} "
+                f"> model vocab {model_config.vocab_size}"
+            )
+    logger.info(
+        "loaded %s checkpoint from %s (dim=%s, layers=%s)",
+        lookup, checkpoint_path, getattr(model_config, "dim", "?"),
+        getattr(model_config, "n_layers", "?"),
+    )
+    return params, model_config, tokenizer

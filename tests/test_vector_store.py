@@ -55,6 +55,10 @@ class FakeQdrant:
             for pt in body["points"]:
                 col["points"][pt["id"]] = pt
             return self._ok({"status": "completed"})
+        if op == "points" and request.method == "POST":  # retrieve by ids
+            return self._ok([
+                {"id": pid, "payload": None} for pid in body["ids"] if pid in col["points"]
+            ])
         if op == "count":
             return self._ok({"count": len(col["points"])})
         if op == "delete":
@@ -229,3 +233,28 @@ class TestRegistry:
         settings.retrieval.index_backend = "qdrant"
         c = DependencyContainer(settings=settings)
         assert isinstance(c.dense_index, QdrantVectorStore)
+
+
+class TestIngestorRoutesThroughRegistry:
+    def test_ingestor_uses_qdrant_backend(self, settings, fake, monkeypatch):
+        """cli ingest with INDEX_BACKEND=qdrant must write to the external
+        store the serving pods read, not a process-private index."""
+        from sentio_tpu.config import EmbedderConfig
+        from sentio_tpu.ops import vector_store as vs
+        from sentio_tpu.ops.ingest import DocumentIngestor
+
+        settings.embedder = EmbedderConfig(provider="hash", dim=8)
+        settings.retrieval.index_backend = "qdrant"
+
+        orig = vs.QdrantVectorStore
+
+        def patched(*args, **kwargs):
+            kwargs["transport"] = httpx.MockTransport(fake.handler)
+            return orig(*args, **kwargs)
+
+        monkeypatch.setattr(vs, "QdrantVectorStore", patched)
+        ing = DocumentIngestor(settings=settings)
+        assert isinstance(ing.dense_index, orig)
+        stats = ing.ingest_document("TPUs multiply matrices.", metadata={})
+        assert stats.chunks_stored >= 1
+        assert fake.collections["sentio"]["points"]
