@@ -317,10 +317,12 @@ class ServeConfig:
 class CacheConfig:
     """Cache tiers (reference: caching/cache_manager.py:18-125)."""
 
-    backend: str = "memory"  # memory | multi_tier (L2 hook) | off
+    backend: str = "memory"  # memory | multi_tier (L1 + redis L2) | off
     max_entries: int = 10_000
     default_ttl_s: float = 3600.0
     query_cache_ttl_s: float = 600.0
+    redis_url: str = "redis://localhost:6379/0"
+    redis_key_prefix: str = "sentio:"
 
     @classmethod
     def from_env(cls) -> "CacheConfig":
@@ -329,6 +331,8 @@ class CacheConfig:
             max_entries=_env_int(["CACHE_MAX_ENTRIES"], 10_000),
             default_ttl_s=_env_float(["CACHE_TTL"], 3600.0),
             query_cache_ttl_s=_env_float(["QUERY_CACHE_TTL"], 600.0),
+            redis_url=_env_str(["REDIS_URL"], "redis://localhost:6379/0"),
+            redis_key_prefix=_env_str(["REDIS_KEY_PREFIX"], "sentio:"),
         )
 
 

@@ -210,6 +210,13 @@ class CacheManager:
     ) -> None:
         self.config = config or get_settings().cache
         self.l1 = MemoryCache(self.config.max_entries, self.config.default_ttl_s)
+        if l2 is None and self.config.backend == "multi_tier":
+            # redis L2 via the in-tree RESP client; errors degrade to misses
+            from sentio_tpu.infra.redis_cache import RedisL2Cache
+
+            l2 = RedisL2Cache(
+                url=self.config.redis_url, key_prefix=self.config.redis_key_prefix
+            )
         self.l2: L2Cache = l2 or NullL2Cache()
         self.strategy: CacheStrategy = strategy or TTLStrategy(self.config.default_ttl_s)
         self.enabled = self.config.backend != "off"

@@ -1,0 +1,173 @@
+"""Redis L2 cache over a minimal in-tree RESP2 client (asyncio sockets).
+
+Parity with the reference's optional redis tier (src/core/caching/
+redis_cache.py there: key prefix, JSON serialization, TTL, health check,
+silent degradation when redis is down) WITHOUT the redis-py dependency —
+the image doesn't ship it, and the cache needs only five commands (AUTH,
+PING, GET, SET PX, DEL), which is a few dozen lines of RESP2 framing.
+
+Values are JSON (never pickle — an attacker with redis access must not get
+code execution in the server). Every public method satisfies the
+:class:`sentio_tpu.infra.caching.L2Cache` contract: errors surface as
+misses/None, never as exceptions into the cache manager; the connection
+re-establishes on next use after a failure.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Optional
+from urllib.parse import urlparse
+
+logger = logging.getLogger(__name__)
+
+_CRLF = b"\r\n"
+
+
+class RESPError(Exception):
+    pass
+
+
+def _encode_command(*args: str | bytes) -> bytes:
+    """RESP2 array-of-bulk-strings encoding."""
+    out = [b"*%d" % len(args), _CRLF]
+    for arg in args:
+        data = arg if isinstance(arg, bytes) else str(arg).encode()
+        out += [b"$%d" % len(data), _CRLF, data, _CRLF]
+    return b"".join(out)
+
+
+async def _read_reply(reader: asyncio.StreamReader) -> Any:
+    line = (await reader.readuntil(_CRLF))[:-2]
+    kind, rest = line[:1], line[1:]
+    if kind == b"+":  # simple string
+        return rest.decode()
+    if kind == b"-":  # error
+        raise RESPError(rest.decode())
+    if kind == b":":  # integer
+        return int(rest)
+    if kind == b"$":  # bulk string
+        n = int(rest)
+        if n == -1:
+            return None
+        data = await reader.readexactly(n + 2)
+        return data[:-2]
+    if kind == b"*":  # array
+        n = int(rest)
+        if n == -1:
+            return None
+        return [await _read_reply(reader) for _ in range(n)]
+    raise RESPError(f"unknown RESP type byte {kind!r}")
+
+
+class RedisL2Cache:
+    """L2Cache implementation speaking RESP2 to a redis-compatible server.
+
+    One connection, serialized by an asyncio lock (the cache manager issues
+    low-rate single-key ops; pipelining is not worth the complexity here).
+    """
+
+    def __init__(
+        self,
+        url: str = "redis://localhost:6379/0",
+        key_prefix: str = "sentio:",
+        timeout_s: float = 2.0,
+    ) -> None:
+        parsed = urlparse(url)
+        if parsed.scheme not in ("redis", ""):
+            raise ValueError(f"unsupported redis url scheme {parsed.scheme!r}")
+        self.host = parsed.hostname or "localhost"
+        self.port = parsed.port or 6379
+        self.password = parsed.password or ""
+        try:
+            self.db = int((parsed.path or "/0").lstrip("/") or 0)
+        except ValueError:
+            self.db = 0
+        self.key_prefix = key_prefix
+        self.timeout_s = timeout_s
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._lock = asyncio.Lock()
+
+    # ------------------------------------------------------------ connection
+
+    async def _connect(self) -> None:
+        self._reader, self._writer = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port), self.timeout_s
+        )
+        if self.password:
+            await self._command_locked("AUTH", self.password)
+        if self.db:
+            await self._command_locked("SELECT", str(self.db))
+
+    def _drop_connection(self) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._reader = self._writer = None
+
+    async def _command_locked(self, *args: str | bytes) -> Any:
+        assert self._writer is not None and self._reader is not None
+        self._writer.write(_encode_command(*args))
+        await asyncio.wait_for(self._writer.drain(), self.timeout_s)
+        return await asyncio.wait_for(_read_reply(self._reader), self.timeout_s)
+
+    async def _command(self, *args: str | bytes) -> Any:
+        async with self._lock:
+            if self._writer is None:
+                await self._connect()
+            try:
+                return await self._command_locked(*args)
+            except (OSError, asyncio.TimeoutError, asyncio.IncompleteReadError):
+                # dead connection: drop it so the next call redials
+                self._drop_connection()
+                raise
+
+    # ----------------------------------------------------------- L2 surface
+
+    def _k(self, key: str) -> str:
+        return self.key_prefix + key
+
+    async def get(self, key: str) -> Optional[Any]:
+        try:
+            raw = await self._command("GET", self._k(key))
+        except Exception as exc:  # noqa: BLE001 — contract: errors are misses
+            logger.debug("redis get failed: %s", exc)
+            return None
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except (ValueError, TypeError):
+            return None
+
+    async def set(self, key: str, value: Any, ttl_s: float) -> None:
+        try:
+            payload = json.dumps(value, default=str)
+        except (TypeError, ValueError):
+            return
+        px = max(int(ttl_s * 1000), 1)
+        try:
+            await self._command("SET", self._k(key), payload, "PX", str(px))
+        except Exception as exc:  # noqa: BLE001
+            logger.debug("redis set failed: %s", exc)
+
+    async def delete(self, key: str) -> None:
+        try:
+            await self._command("DEL", self._k(key))
+        except Exception as exc:  # noqa: BLE001
+            logger.debug("redis del failed: %s", exc)
+
+    async def ping(self) -> bool:
+        try:
+            return await self._command("PING") == "PONG"
+        except Exception:  # noqa: BLE001
+            return False
+
+    async def close(self) -> None:
+        async with self._lock:
+            self._drop_connection()

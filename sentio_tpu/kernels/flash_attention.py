@@ -39,7 +39,7 @@ __all__ = ["flash_attention", "attention_auto"]
 
 
 def _flash_kernel(
-    lens_ref,  # [1] int32 in SMEM — this row's valid kv length
+    lens_ref,  # [B*H] int32, scalar-prefetched whole into SMEM
     q_ref,     # [block_q, d]
     k_ref,     # [block_k, d]
     v_ref,     # [block_k, d]
@@ -53,6 +53,7 @@ def _flash_kernel(
     block_q: int,
     block_k: int,
 ):
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -74,7 +75,7 @@ def _flash_kernel(
         ) * sm_scale  # [bq, bk]
 
         k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        valid = k_pos < lens_ref[0]
+        valid = k_pos < lens_ref[bh]
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             valid = valid & (k_pos <= q_pos)
@@ -158,22 +159,29 @@ def flash_attention(
         block_q=block_q_eff,
         block_k=block_k_eff,
     )
-    out = pl.pallas_call(
-        kernel,
+    # lens rides as a scalar-prefetch operand: the whole [B*H] vector lands
+    # in SMEM before the kernel body runs (TPU lowering rejects rank-1
+    # SMEM *blocks* that aren't whole-array or 128-multiples — observed as
+    # a lowering error on real chips; interpret mode on CPU accepted it)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1,), lambda bh, qi, ki: (bh,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((None, block_q_eff, d_pad), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((None, block_k_eff, d_pad), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((None, block_k_eff, d_pad), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, block_q_eff, d_pad), lambda bh, qi, ki, lens: (bh, qi, 0)),
+            pl.BlockSpec((None, block_k_eff, d_pad), lambda bh, qi, ki, lens: (bh, ki, 0)),
+            pl.BlockSpec((None, block_k_eff, d_pad), lambda bh, qi, ki, lens: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q_eff, d_pad), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t_pad, d_pad), q.dtype),
+        out_specs=pl.BlockSpec((None, block_q_eff, d_pad), lambda bh, qi, ki, lens: (bh, qi, 0)),
         scratch_shapes=[
             pltpu.VMEM((block_q_eff, 1), jnp.float32),   # running max m
             pltpu.VMEM((block_q_eff, 1), jnp.float32),   # normalizer l
             pltpu.VMEM((block_q_eff, d_pad), jnp.float32),  # output accumulator
         ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, t_pad, d_pad), q.dtype),
         interpret=interpret,
     )(lens_rows, qr, kr, vr)
 

@@ -159,15 +159,30 @@ class TpuDenseIndex:
         return self._device_state
 
     def search_batch(
-        self, queries: np.ndarray, top_k: int = 10
+        self, queries, top_k: int = 10
     ) -> list[list[tuple[Document, float]]]:
-        """queries [Q, D] → per-query (Document, cosine score) descending."""
-        if self.size == 0:
-            return [[] for _ in range(len(queries))]
-        queries = np.asarray(queries, np.float32)
+        """queries [Q, D] → per-query (Document, cosine score) descending.
+
+        Accepts host numpy OR a device array (the fused retrieval path hands
+        the embedder's output over without a host round trip — queries are
+        L2-normalized on whichever side they already live)."""
+        import jax
+        import jax.numpy as jnp
+
+        on_device = isinstance(queries, jax.Array)
+        if not on_device:
+            queries = np.asarray(queries, np.float32)
         if queries.ndim != 2 or queries.shape[1] != self.dim:
             raise DenseIndexError(f"expected queries [Q, {self.dim}], got {queries.shape}")
-        qn = queries / np.maximum(np.linalg.norm(queries, axis=1, keepdims=True), 1e-9)
+        if self.size == 0:
+            return [[] for _ in range(len(queries))]
+        if on_device:
+            qn = queries / jnp.maximum(
+                jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-9
+            )
+        else:
+            qn = queries / np.maximum(np.linalg.norm(queries, axis=1, keepdims=True), 1e-9)
+            qn = jnp.asarray(qn)
 
         corpus_dev, valid_dev, n_pad = self._ensure_device()
         k = min(top_k, self.size)
@@ -175,13 +190,12 @@ class TpuDenseIndex:
         k_local = min(max(k, 1), n_pad // shards)
         k_out = min(k, shards * k_local)
 
-        import jax.numpy as jnp
-
         scores, rows = _topk_fn(self.mesh, self.dtype, k_local, k_out)(
-            corpus_dev, valid_dev, jnp.asarray(qn)
+            corpus_dev, valid_dev, qn
         )
+        # one blocking fetch for both outputs, not two sequential ones
+        scores, rows = jax.device_get((scores, rows))
         scores = np.asarray(scores, np.float32)
-        rows = np.asarray(rows)
 
         out: list[list[tuple[Document, float]]] = []
         for qi in range(len(queries)):

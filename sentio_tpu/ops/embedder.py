@@ -258,6 +258,51 @@ class TpuEmbedder(BaseEmbedder):
         out = self._fwd(self.params, jnp.asarray(ids), jnp.asarray(mask))
         return np.asarray(out, np.float32)[:n]
 
+    def embed_device(self, texts: list[str]):
+        """Embed → [n, D] array WITHOUT a blocking host download. The dense
+        retrieval leg chains this straight into the index's top-k program so
+        the query vector never makes a host round trip — on remote-attached
+        devices each blocking transfer costs ~RTT, which dominated the
+        retrieve leg before this path existed.
+
+        Cache contract matches :meth:`embed_many`: full-hit batches return
+        cached host vectors (no device work at all); misses compute on
+        device and the cache is populated from a BACKGROUND thread so the
+        fetch never blocks this request."""
+        cached = [self.cache.get(t) for t in texts]
+        if all(c is not None for c in cached):
+            self.stats["cache_hits"] = self.stats.get("cache_hits", 0) + len(texts)
+            return np.stack(cached).astype(np.float32)
+
+        import jax.numpy as jnp
+
+        from sentio_tpu.models.tokenizer import batch_encode
+        from sentio_tpu.parallel.batcher import bucket_size
+
+        ids, mask = batch_encode(
+            self.tokenizer, texts, max_len=min(self.config.max_tokens, self.model_config.max_len)
+        )
+        n = ids.shape[0]
+        width = bucket_size(ids.shape[1], self.BUCKETS)
+        rows = bucket_size(n, self.BATCH_BUCKETS)
+        ids = np.pad(
+            ids, ((0, rows - n), (0, width - ids.shape[1])),
+            constant_values=self.tokenizer.pad_id,
+        )
+        mask = np.pad(mask, ((0, rows - n), (0, width - mask.shape[1])))
+        out = self._fwd(self.params, jnp.asarray(ids), jnp.asarray(mask))[:n]
+
+        def fill_cache() -> None:
+            try:
+                host = np.asarray(out, np.float32)
+                for text, vec in zip(texts, host):
+                    self.cache.set(text, vec)
+            except Exception:  # noqa: BLE001 — cache fill is best-effort
+                pass
+
+        threading.Thread(target=fill_cache, daemon=True).start()
+        return out
+
 
 _PROVIDERS = {"hash": HashEmbedder, "tpu": TpuEmbedder}
 

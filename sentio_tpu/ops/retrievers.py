@@ -52,8 +52,22 @@ class DenseRetriever(BaseRetriever):
 
     def retrieve(self, query: str, top_k: int = 10) -> list[Document]:
         faults.hit("retriever.dense")
+        # fused path: embedder output stays on device and feeds the index's
+        # top-k program directly — one host round trip for the whole leg
+        if hasattr(self.embedder, "embed_device") and isinstance(self.index, TpuDenseIndex):
+            q_dev = self.embedder.embed_device([query])
+            return [doc for doc, _ in self._scored(q_dev, top_k)]
         q_vec = self.embedder.embed(query)
         return self.index.retrieve(np.asarray(q_vec, np.float32), top_k)
+
+    def _scored(self, q_dev, top_k: int):
+        out = []
+        for doc, score in self.index.search_batch(q_dev, top_k)[0]:
+            meta = dict(doc.metadata)
+            meta["score"] = score
+            meta["retriever"] = "dense"
+            out.append((Document(text=doc.text, metadata=meta, id=doc.id), score))
+        return out
 
 
 @dataclass

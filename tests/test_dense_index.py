@@ -147,3 +147,22 @@ def test_duplicate_ids_in_one_add_batch():
     assert index.delete(["x"]) == 1
     assert index.size == 1
     assert all(d.id == "y" for d, _ in index.search(embs[2], top_k=5))
+
+
+class TestDeviceQueryPath:
+    def test_search_batch_accepts_device_arrays(self, docs):
+        import jax.numpy as jnp
+
+        from sentio_tpu.ops.embedder import HashEmbedder
+        from sentio_tpu.config import EmbedderConfig
+
+        emb = HashEmbedder(EmbedderConfig(provider="hash", dim=32))
+        vecs = emb.embed_many([d.text for d in docs])
+        idx = TpuDenseIndex(dim=32, dtype="float32")
+        idx.add(docs, vecs)
+        q = vecs[2:3]
+        host_hits = idx.search_batch(q, top_k=3)
+        dev_hits = idx.search_batch(jnp.asarray(q), top_k=3)
+        assert [d.id for d, _ in host_hits[0]] == [d.id for d, _ in dev_hits[0]]
+        for (_, a), (_, b) in zip(host_hits[0], dev_hits[0]):
+            assert abs(a - b) < 1e-4

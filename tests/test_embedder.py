@@ -124,3 +124,38 @@ def test_batch_bucketing_avoids_recompiles():
     emb.embed_many(["d", "e", "f", "g"])  # same B=4 bucket -> no new compile
     if compiled is not None:
         assert emb._fwd._cache_size() == compiled
+
+
+class TestEmbedDevice:
+    def test_embed_device_matches_embed_many(self, settings):
+        from sentio_tpu.config import EmbedderConfig
+        from sentio_tpu.models.transformer import EncoderConfig
+        from sentio_tpu.ops.embedder import TpuEmbedder
+
+        emb = TpuEmbedder(EmbedderConfig(provider="tpu", dim=64),
+                          model_config=EncoderConfig.tiny())
+        texts = ["the quick fox", "jax compiles to xla"]
+        dev = np.asarray(emb.embed_device(texts), np.float32)
+        host = emb.embed_many(texts)
+        np.testing.assert_allclose(dev, host, atol=1e-5)
+
+    def test_embed_device_cache_hit_path(self, settings):
+        import time
+
+        from sentio_tpu.config import EmbedderConfig
+        from sentio_tpu.models.transformer import EncoderConfig
+        from sentio_tpu.ops.embedder import TpuEmbedder
+
+        emb = TpuEmbedder(EmbedderConfig(provider="tpu", dim=64),
+                          model_config=EncoderConfig.tiny())
+        emb.embed_many(["warm me"])  # populates cache synchronously
+        out = emb.embed_device(["warm me"])
+        assert isinstance(out, np.ndarray)  # served from cache, no device call
+
+        # miss path fills the cache from the background thread
+        emb.embed_device(["fresh text"])
+        for _ in range(50):
+            if emb.cache.get("fresh text") is not None:
+                break
+            time.sleep(0.05)
+        assert emb.cache.get("fresh text") is not None
