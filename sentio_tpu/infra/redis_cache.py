@@ -97,10 +97,17 @@ class RedisL2Cache:
         self._reader, self._writer = await asyncio.wait_for(
             asyncio.open_connection(self.host, self.port), self.timeout_s
         )
-        if self.password:
-            await self._command_locked("AUTH", self.password)
-        if self.db:
-            await self._command_locked("SELECT", str(self.db))
+        try:
+            if self.password:
+                await self._command_locked("AUTH", self.password)
+            if self.db:
+                await self._command_locked("SELECT", str(self.db))
+        except BaseException:
+            # a failed handshake (wrong password, bad db, timeout) must not
+            # leave a half-initialized connection installed — later commands
+            # would run unauthenticated / on the wrong db forever
+            self._drop_connection()
+            raise
 
     def _drop_connection(self) -> None:
         if self._writer is not None:
@@ -125,6 +132,13 @@ class RedisL2Cache:
             except (OSError, asyncio.TimeoutError, asyncio.IncompleteReadError):
                 # dead connection: drop it so the next call redials
                 self._drop_connection()
+                raise
+            except RESPError as exc:
+                # auth/protocol desync (NOAUTH, WRONGPASS, LOADING) means the
+                # session state is wrong, not just this command — redial
+                msg = str(exc).upper()
+                if msg.startswith(("NOAUTH", "WRONGPASS", "LOADING", "MASTERDOWN")):
+                    self._drop_connection()
                 raise
 
     # ----------------------------------------------------------- L2 surface

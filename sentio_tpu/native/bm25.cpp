@@ -86,21 +86,32 @@ void sbm25_scores(void* handle, const int32_t* qids, int32_t n_q, float* out) {
 
 // Top-k by score (descending, ties broken by ascending doc id for
 // determinism). Only docs with score > 0 are returned. Returns the count
-// written into out_idx/out_scores (<= top_k). Scratch is per-call (the
-// zero-page calloc of ``acc`` is cheap even at millions of docs), keeping
+// written into out_idx/out_scores (<= top_k). Scratch is a thread_local
+// accumulator cleared via the touched list after each query — short
+// queries never pay an O(n_docs) memset, and per-thread scratch keeps
 // concurrent searches against one handle lock-free.
 int32_t sbm25_search(void* handle, const int32_t* qids, int32_t n_q,
                      int32_t top_k, int32_t* out_idx, float* out_scores) {
   const auto* h = static_cast<const SBm25*>(handle);
-  std::vector<float> acc(static_cast<size_t>(h->n_docs), 0.0f);
+  thread_local std::vector<float> acc;
+  const auto need = static_cast<size_t>(h->n_docs);
+  if (acc.size() < need) {
+    acc.resize(need, 0.0f);
+  } else if (acc.size() > 4 * need && acc.size() > (1u << 20)) {
+    // corpus shrank a lot (rebuild/handle swap): release the excess rather
+    // than pinning peak-corpus scratch per thread forever
+    std::vector<float>(need, 0.0f).swap(acc);
+  }
   std::vector<int32_t> docs;
   docs.reserve(1024);
   score_into(h, qids, n_q, acc.data(), &docs);
 
-  // ``touched`` may hold duplicates of docs whose running sum crossed zero
-  // (negative-idf terms); dedup is implicit — a doc appears at most once
-  // per zero-crossing and the final sort/scan tolerates repeats only if
-  // scores differ, so drop exact duplicates first.
+  // ``docs`` may hold duplicates (a zero contrib leaves acc at 0, so the
+  // same doc can be pushed again); drop exact duplicates. Top-k selection
+  // happens IN PLACE but never truncates — the full list doubles as the
+  // touched set that restores acc's all-zero invariant at the end. (No
+  // exception guard: the only caller is ctypes, where a C++ exception
+  // escaping the C ABI terminates the process anyway.)
   std::sort(docs.begin(), docs.end());
   docs.erase(std::unique(docs.begin(), docs.end()), docs.end());
 
@@ -113,17 +124,19 @@ int32_t sbm25_search(void* handle, const int32_t* qids, int32_t n_q,
   if (k > 0 && k < docs.size()) {
     std::nth_element(docs.begin(), docs.begin() + static_cast<int64_t>(k) - 1,
                      docs.end(), cmp);
-    docs.resize(k);
   }
-  std::sort(docs.begin(), docs.end(), cmp);
+  std::sort(docs.begin(), docs.begin() + static_cast<int64_t>(k), cmp);
 
   int32_t written = 0;
-  for (const int32_t d : docs) {
-    if (written >= top_k || acc[d] <= 0.0f) break;
+  for (size_t i = 0; i < k; ++i) {
+    const int32_t d = docs[i];
+    if (acc[d] <= 0.0f) break;
     out_idx[written] = d;
     out_scores[written] = acc[d];
     ++written;
   }
+  // restore the all-zero invariant for the next query on this thread
+  for (const int32_t d : docs) acc[d] = 0.0f;
   return written;
 }
 

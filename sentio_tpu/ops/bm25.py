@@ -20,7 +20,7 @@ import re
 import threading
 from dataclasses import dataclass
 from pathlib import Path
-from typing import Callable, Optional, Sequence
+from typing import Callable, NamedTuple, Optional, Sequence
 
 import numpy as np
 
@@ -43,6 +43,23 @@ class BM25Params:
     variant: str = "okapi"  # okapi | plus
 
 
+class _Postings(NamedTuple):
+    """One consistent, immutable snapshot of the index state. ``build()``
+    publishes a new snapshot in a single reference assignment AFTER all
+    arrays are final, so concurrent queries read either the old or the new
+    corpus — never a torn mix. Arrays referenced by a published snapshot
+    are never written again."""
+
+    term_offsets: np.ndarray
+    post_docs: np.ndarray
+    post_tfs: np.ndarray
+    idf: np.ndarray
+    norm: np.ndarray
+    avgdl: float
+    doc_ids: list
+    documents: list
+
+
 class BM25Index:
     """Immutable-after-build BM25 index.
 
@@ -51,6 +68,11 @@ class BM25Index:
     ids, so score accumulation is a vectorized fancy-index add per query term
     (cost: O(sum of query-term posting lengths), the same work Lucene does,
     without the JVM).
+
+    Queries read only the :class:`_Postings` snapshot (``self._epoch``), so
+    they are lock-free and safe against a concurrent ``build()``; the vocab
+    is shared across rebuilds and append-only, and snapshot readers bounds-
+    check term ids against their own snapshot's term count.
     """
 
     def __init__(
@@ -72,6 +94,7 @@ class BM25Index:
         self.post_tfs = np.zeros(0, dtype=np.float32)
         self.idf = np.zeros(0, dtype=np.float32)
         self._documents: list[Document] = []
+        self._epoch = self._snapshot()
 
     # ------------------------------------------------------------------ build
 
@@ -112,6 +135,8 @@ class BM25Index:
             idf = np.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
         self.idf = np.maximum(idf, 0.0).astype(np.float32)
         self._finalize_norm()
+        # single atomic publish: queries in flight keep the old snapshot
+        self._epoch = self._snapshot()
         return self
 
     def _finalize_norm(self) -> None:
@@ -121,39 +146,59 @@ class BM25Index:
         else:
             self._norm = np.zeros_like(self.doc_lens)
 
+    def _snapshot(self) -> _Postings:
+        return _Postings(
+            term_offsets=self.term_offsets,
+            post_docs=self.post_docs,
+            post_tfs=self.post_tfs,
+            idf=self.idf,
+            norm=self._norm if self._norm is not None else np.zeros(0, np.float32),
+            avgdl=self.avgdl,
+            doc_ids=self.doc_ids,
+            documents=self._documents,
+        )
+
     @property
     def size(self) -> int:
         return len(self.doc_ids)
 
     # ------------------------------------------------------------------ score
 
-    def scores(self, query: str) -> np.ndarray:
+    def scores(self, query: str, _e: Optional[_Postings] = None) -> np.ndarray:
         """Dense score vector over the whole corpus for one query."""
-        out = np.zeros(self.size, dtype=np.float32)
-        if self.size == 0 or self.avgdl == 0 or self._norm is None:
+        e = _e if _e is not None else self._epoch
+        n = len(e.doc_ids)
+        out = np.zeros(n, dtype=np.float32)
+        if n == 0 or e.avgdl == 0:
             return out
         k1, delta = self.params.k1, self.params.delta
+        n_terms = len(e.term_offsets) - 1
         for tok in self.tokenizer(query):
             tid = self.vocab.get(tok)
-            if tid is None:
+            # vocab is shared/append-only; ids minted after this snapshot
+            # have no postings here
+            if tid is None or tid >= n_terms:
                 continue
-            start, end = self.term_offsets[tid], self.term_offsets[tid + 1]
-            docs = self.post_docs[start:end]
-            tfs = self.post_tfs[start:end]
-            denom = tfs + self._norm[docs]
-            contrib = self.idf[tid] * (tfs * (k1 + 1.0) / denom + delta)
+            start, end = e.term_offsets[tid], e.term_offsets[tid + 1]
+            docs = e.post_docs[start:end]
+            tfs = e.post_tfs[start:end]
+            denom = tfs + e.norm[docs]
+            contrib = e.idf[tid] * (tfs * (k1 + 1.0) / denom + delta)
             np.add.at(out, docs, contrib)  # repeated query terms hit same docs
         return out
 
-    def search(self, query: str, top_k: int = 10) -> list[tuple[int, float]]:
+    def search(
+        self, query: str, top_k: int = 10, _e: Optional[_Postings] = None
+    ) -> list[tuple[int, float]]:
         """Top-k under the total order (score desc, doc id asc) — the
         deterministic tie-break the native core uses, so backends agree.
         Work stays O(n + k log k) even when a huge fraction of the corpus
         ties at the k-th score (boilerplate tokens): only the ``need``
         smallest doc ids among boundary ties are materialized, never the
         whole tie set sorted."""
-        scores = self.scores(query)
-        k = min(top_k, self.size)
+        e = _e if _e is not None else self._epoch
+        scores = self.scores(query, e)
+        k = min(top_k, len(e.doc_ids))
         if k == 0:
             return []
         idx = np.argpartition(-scores, k - 1)[:k]
@@ -171,9 +216,10 @@ class BM25Index:
         return [(int(i), float(scores[i])) for i in cand]
 
     def retrieve(self, query: str, top_k: int = 10) -> list[Document]:
+        e = self._epoch  # one snapshot: indices resolve against the same docs
         out = []
-        for di, score in self.search(query, top_k):
-            doc = self._documents[di]
+        for di, score in self.search(query, top_k, e):
+            doc = e.documents[di]
             meta = dict(doc.metadata)
             meta["score"] = score
             meta["retriever"] = "bm25"
@@ -237,6 +283,7 @@ class BM25Index:
         index.post_tfs = arrays["post_tfs"]
         index.idf = arrays["idf"]
         index._finalize_norm()
+        index._epoch = index._snapshot()
         return index
 
 
@@ -310,7 +357,8 @@ class NativeBM25Index(BM25Index):
     lock-free and concurrent; ``_native_lock`` only serializes handle
     creation/retirement (build/rebuild). If the native library is
     unavailable (no toolchain), every call transparently degrades to the
-    numpy implementation.
+    numpy implementation, which reads the lock-free ``_Postings`` snapshot
+    — concurrent rebuilds can't tear it either.
     """
 
     def __init__(self, *args, **kwargs) -> None:
@@ -380,9 +428,13 @@ class NativeBM25Index(BM25Index):
         ids = [self.vocab[t] for t in self.tokenizer(query) if t in self.vocab]
         return np.asarray(ids, dtype=np.int32)
 
-    def scores(self, query: str) -> np.ndarray:
+    def scores(self, query: str, _e: Optional[_Postings] = None) -> np.ndarray:
         import ctypes as C
 
+        if _e is not None:
+            # caller pinned a snapshot (fallback search mid-rebuild): the
+            # native box may index a different corpus — stay consistent
+            return super().scores(query, _e)
         box = self._get_box()
         if box is None or not box.acquire():
             return super().scores(query)
@@ -399,7 +451,11 @@ class NativeBM25Index(BM25Index):
         finally:
             box.release()
 
-    def search(self, query: str, top_k: int = 10) -> list[tuple[int, float]]:
+    def search(
+        self, query: str, top_k: int = 10, _e: Optional[_Postings] = None
+    ) -> list[tuple[int, float]]:
+        if _e is not None:
+            return super().search(query, top_k, _e)
         box = self._get_box()
         if box is None or not box.acquire():
             return super().search(query, top_k)

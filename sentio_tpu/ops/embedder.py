@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import logging
 import threading
 import time
 from typing import Optional, Sequence
@@ -24,6 +25,8 @@ import numpy as np
 
 from sentio_tpu.config import EmbedderConfig, get_settings
 from sentio_tpu.infra import faults
+
+logger = logging.getLogger(__name__)
 
 
 class EmbeddingError(Exception):
@@ -294,11 +297,11 @@ class TpuEmbedder(BaseEmbedder):
 
         def fill_cache() -> None:
             try:
-                host = np.asarray(out, np.float32)
+                host = np.asarray(out, np.float32)  # device fetch can fail
                 for text, vec in zip(texts, host):
-                    self.cache.set(text, vec)
-            except Exception:  # noqa: BLE001 — cache fill is best-effort
-                pass
+                    self.cache.put(text, vec)
+            except Exception as exc:  # best-effort, but never silent
+                logger.warning("embed_device background cache fill failed: %s", exc)
 
         threading.Thread(target=fill_cache, daemon=True).start()
         return out

@@ -68,10 +68,17 @@ def load_model(
     import importlib
 
     cfg_cls = getattr(importlib.import_module(mod_name), cls_name)
-    # tuples serialize as lists in JSON meta; dataclass fields that want
-    # tuples get them back
+    # tuples serialize as lists in JSON meta; convert back for fields whose
+    # annotation is a tuple type so frozen configs stay hashable
     fields = {f.name: f.type for f in cfg_cls.__dataclass_fields__.values()}  # type: ignore[attr-defined]
-    kwargs = {k: v for k, v in cfg_dict.items() if k in fields}
+    kwargs = {}
+    for k, v in cfg_dict.items():
+        if k not in fields:
+            continue
+        ann = str(fields[k]).lower()
+        if isinstance(v, list) and ("tuple" in ann):
+            v = tuple(v)
+        kwargs[k] = v
     model_config = cfg_cls(**kwargs)
 
     tokenizer = None
