@@ -182,6 +182,10 @@ class EmbedderConfig:
     # converted checkpoint (cli convert encoder ...); "" = random-init preset
     checkpoint_path: str = ""
     tokenizer_path: str = ""  # local HF tokenizer dir (usually the HF src dir)
+    # coalesce concurrent single-query embeds into one device batch
+    coalesce: bool = True
+    coalesce_deadline_ms: float = 5.0
+    coalesce_max: int = 16
 
     @classmethod
     def from_env(cls) -> "EmbedderConfig":
@@ -195,6 +199,9 @@ class EmbedderConfig:
             model_preset=_env_str(["EMBEDDER_PRESET"], "base"),
             checkpoint_path=_env_str(["EMBEDDER_CHECKPOINT"], ""),
             tokenizer_path=_env_str(["EMBEDDER_TOKENIZER"], ""),
+            coalesce=_env_bool(["EMBED_COALESCE"], True),
+            coalesce_deadline_ms=_env_float(["EMBED_COALESCE_DEADLINE_MS"], 5.0),
+            coalesce_max=_env_int(["EMBED_COALESCE_MAX"], 16),
         )
 
 
@@ -217,6 +224,9 @@ class GeneratorConfig:
     kv_page_size: int = 128
     kv_max_pages_per_seq: int = 64
     max_batch_size: int = 8
+    # paged KV + continuous batching as the live /chat decode path; the
+    # contiguous engine remains for streaming and as an escape hatch
+    use_paged_decode: bool = True
     prefill_buckets: tuple[int, ...] = (256, 512, 1024, 2048, 4096)
     temperature_by_mode: tuple[tuple[str, float], ...] = (
         ("fast", 0.0),
@@ -246,6 +256,7 @@ class GeneratorConfig:
             kv_page_size=_env_int(["KV_PAGE_SIZE"], 128),
             kv_max_pages_per_seq=_env_int(["KV_MAX_PAGES_PER_SEQ"], 64),
             max_batch_size=_env_int(["LLM_MAX_BATCH"], 8),
+            use_paged_decode=_env_bool(["USE_PAGED_KV", "USE_PAGED_DECODE"], True),
         )
 
 

@@ -65,6 +65,8 @@ class EmbeddingCache:
             return vec
 
     def put(self, text: str, vec: np.ndarray) -> None:
+        if self.max_size <= 0:  # caching disabled
+            return
         k = self.key(text)
         with self._lock:
             if len(self._store) >= self.max_size and k not in self._store:
@@ -235,9 +237,38 @@ class TpuEmbedder(BaseEmbedder):
 
         self._fwd = jax.jit(fwd)
 
+        # built eagerly (no lazy-init race); the dispatcher thread itself
+        # only starts on first submit
+        self._query_batcher = None
+        if self.config.coalesce:
+            from sentio_tpu.parallel.batcher import ThreadBatcher
+
+            def process(batch_texts: list[str]):
+                out = self._embed_device_batch(batch_texts)
+                # each caller gets its own [1, D] device slice (no download)
+                return [out[i : i + 1] for i in range(len(batch_texts))]
+
+            self._query_batcher = ThreadBatcher(
+                process,
+                max_size=self.config.coalesce_max,
+                deadline_ms=self.config.coalesce_deadline_ms,
+                name="embed-coalescer",
+            )
+
+    def close(self) -> None:
+        """Stop the coalescer dispatcher thread (container cleanup)."""
+        if self._query_batcher is not None:
+            self._query_batcher.close()
+
     @property
     def dimension(self) -> int:
         return self.model_config.dim
+
+    def get_stats(self) -> dict:
+        stats = super().get_stats()
+        if self._query_batcher is not None:
+            stats["coalescer"] = self._query_batcher.stats.snapshot()
+        return stats
 
     def _embed_batch(self, texts: list[str]) -> np.ndarray:
         import jax.numpy as jnp
@@ -268,6 +299,11 @@ class TpuEmbedder(BaseEmbedder):
         devices each blocking transfer costs ~RTT, which dominated the
         retrieve leg before this path existed.
 
+        Single-query calls (the /chat hot path — one worker thread per
+        request) coalesce across threads through a deadline batcher so
+        concurrent requests share ONE padded device batch; multi-text calls
+        are already a batch and dispatch directly.
+
         Cache contract matches :meth:`embed_many`: full-hit batches return
         cached host vectors (no device work at all); misses compute on
         device and the cache is populated from a BACKGROUND thread so the
@@ -277,6 +313,11 @@ class TpuEmbedder(BaseEmbedder):
             self.stats["cache_hits"] = self.stats.get("cache_hits", 0) + len(texts)
             return np.stack(cached).astype(np.float32)
 
+        if len(texts) == 1 and self._query_batcher is not None:
+            return self._query_batcher.submit(texts[0])
+        return self._embed_device_batch(texts)
+
+    def _embed_device_batch(self, texts: list[str]):
         import jax.numpy as jnp
 
         from sentio_tpu.models.tokenizer import batch_encode

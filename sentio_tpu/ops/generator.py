@@ -52,10 +52,25 @@ class EchoProvider:
 
 @dataclass
 class TpuProvider:
+    """Dispatches to the in-process TPU runtime. With a ``service`` (the
+    continuous-batching pump over the paged KV pool) attached, every chat
+    call joins the SHARED decode batch — concurrent requests coalesce on
+    device instead of serializing (closes the round-1 gap where
+    runtime/paged.py was dead code). The contiguous ``engine`` remains the
+    streaming path and the fallback when paged decode is disabled."""
+
     engine: object = None  # GeneratorEngine
+    service: object = None  # PagedGenerationService (continuous batching)
     name: str = "tpu"
 
     def chat(self, prompt: str, max_new_tokens: int, temperature: float) -> str:
+        if self.service is not None:
+            result = self.service.generate(
+                prompt, max_new_tokens=max_new_tokens, temperature=temperature
+            )
+            if result.finish_reason == "error":
+                raise RuntimeError("paged decode failed for this request")
+            return result.text
         result = self.engine.generate(
             [prompt], max_new_tokens=max_new_tokens, temperature=temperature
         )[0]
@@ -159,12 +174,13 @@ class LLMGenerator:
 def create_generator(
     settings=None,
     engine=None,
+    service=None,
 ) -> LLMGenerator:
     """env→generator wiring (reference: llm/factory.py:14-69)."""
     settings = settings or get_settings()
     cfg = settings.generator
     if cfg.provider == "tpu" and engine is not None:
-        provider = TpuProvider(engine=engine)
+        provider = TpuProvider(engine=engine, service=service)
     elif cfg.provider == "tpu":
         # no engine supplied (tests, host-only dev) → deterministic echo
         provider = EchoProvider()

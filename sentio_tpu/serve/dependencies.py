@@ -173,11 +173,43 @@ class DependencyContainer:
         return self._get("engine", build)
 
     @property
+    def generation_service(self):
+        """Continuous-batching pump over the paged KV pool — the default
+        decode path for /chat. Shares weights/tokenizer with the contiguous
+        engine (which keeps streaming + escape-hatch duty)."""
+
+        def build():
+            cfg = self.settings.generator
+            if cfg.provider != "tpu" or not cfg.use_paged_decode:
+                return None
+            engine = self.engine
+            if engine is None:
+                return None
+            from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+            from sentio_tpu.runtime.service import PagedGenerationService
+
+            paged = ContinuousBatchingEngine(
+                model_config=engine.model_config,
+                params=engine.params,
+                tokenizer=engine.tokenizer,
+                max_slots=cfg.max_batch_size,
+                page_size=cfg.kv_page_size,
+                max_pages_per_seq=cfg.kv_max_pages_per_seq,
+            )
+            return PagedGenerationService(paged)
+
+        return self._get("generation_service", build)
+
+    @property
     def generator(self):
         def build():
             from sentio_tpu.ops.generator import create_generator
 
-            return create_generator(settings=self.settings, engine=self.engine)
+            return create_generator(
+                settings=self.settings,
+                engine=self.engine,
+                service=self.generation_service,
+            )
 
         return self._get("generator", build)
 
@@ -292,9 +324,10 @@ class DependencyContainer:
             t0 = time.perf_counter()
             order = [
                 "mesh", "embedder", "dense_index", "sparse_index", "retriever",
-                "reranker", "engine", "generator", "verifier", "graph",
-                "ingestor", "cache_manager", "auth_manager", "rate_limiter",
-                "metrics", "chat_handler", "health_handler",
+                "reranker", "engine", "generation_service", "generator",
+                "verifier", "graph", "ingestor", "cache_manager",
+                "auth_manager", "rate_limiter", "metrics", "chat_handler",
+                "health_handler",
             ]
             for name in order:
                 getattr(self, name)
@@ -304,6 +337,13 @@ class DependencyContainer:
 
     def cleanup(self) -> None:
         with self._lock:
+            for name in ("generation_service", "embedder"):
+                component = self._cache.get(name)
+                if component is not None and hasattr(component, "close"):
+                    try:
+                        component.close()
+                    except Exception:  # noqa: BLE001 — shutdown is best-effort
+                        logger.warning("%s close failed", name, exc_info=True)
             self._cache.clear()
             self._initialized = False
 
@@ -331,6 +371,12 @@ class DependencyContainer:
             )
         except Exception as exc:  # noqa: BLE001
             out["engine"] = {"healthy": False, "error": str(exc)}
+        try:
+            service = self.generation_service
+            if service is not None:
+                out["generation_service"] = {"healthy": True, **service.stats()}
+        except Exception as exc:  # noqa: BLE001
+            out["generation_service"] = {"healthy": False, "error": str(exc)}
         return out
 
 
