@@ -336,15 +336,17 @@ class TpuEmbedder(BaseEmbedder):
         mask = np.pad(mask, ((0, rows - n), (0, width - mask.shape[1])))
         out = self._fwd(self.params, jnp.asarray(ids), jnp.asarray(mask))[:n]
 
-        def fill_cache() -> None:
-            try:
-                host = np.asarray(out, np.float32)  # device fetch can fail
-                for text, vec in zip(texts, host):
-                    self.cache.put(text, vec)
-            except Exception as exc:  # best-effort, but never silent
-                logger.warning("embed_device background cache fill failed: %s", exc)
+        if self.cache.max_size > 0:  # cache off → skip the device download
 
-        threading.Thread(target=fill_cache, daemon=True).start()
+            def fill_cache() -> None:
+                try:
+                    host = np.asarray(out, np.float32)  # device fetch can fail
+                    for text, vec in zip(texts, host):
+                        self.cache.put(text, vec)
+                except Exception as exc:  # best-effort, but never silent
+                    logger.warning("embed_device background cache fill failed: %s", exc)
+
+            threading.Thread(target=fill_cache, daemon=True).start()
         return out
 
 

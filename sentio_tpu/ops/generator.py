@@ -65,12 +65,17 @@ class TpuProvider:
 
     def chat(self, prompt: str, max_new_tokens: int, temperature: float) -> str:
         if self.service is not None:
-            result = self.service.generate(
-                prompt, max_new_tokens=max_new_tokens, temperature=temperature
-            )
-            if result.finish_reason == "error":
-                raise RuntimeError("paged decode failed for this request")
-            return result.text
+            try:
+                result = self.service.generate(
+                    prompt, max_new_tokens=max_new_tokens, temperature=temperature
+                )
+                if result.finish_reason != "error":
+                    return result.text
+            except Exception:  # noqa: BLE001 — contiguous engine is the escape hatch
+                if self.engine is None:
+                    raise
+            if self.engine is None:
+                raise RuntimeError("paged decode failed and no contiguous engine")
         result = self.engine.generate(
             [prompt], max_new_tokens=max_new_tokens, temperature=temperature
         )[0]

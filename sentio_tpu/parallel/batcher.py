@@ -208,9 +208,13 @@ class ThreadBatcher(Generic[T, R]):
         # surface as an error the resilience ladder can degrade on, not
         # deadlock every serving worker thread forever
         if not pending.event.wait(self.timeout_s):
-            raise BatcherTimeout(
+            # mark abandoned so the dispatcher drops it instead of burning a
+            # device batch on a result nobody is waiting for
+            pending.error = BatcherTimeout(
                 f"{self.name}: batch did not complete within {self.timeout_s:.0f}s"
             )
+            pending.event.set()
+            raise pending.error
         if pending.error is not None:
             raise pending.error
         return pending.result  # type: ignore[return-value]
@@ -243,11 +247,13 @@ class ThreadBatcher(Generic[T, R]):
                     if remaining <= 0:
                         break
                     self._cond.wait(timeout=remaining)
-                batch = [
-                    self._queue.popleft()
-                    for _ in range(min(len(self._queue), self.max_size))
-                ]
-            self._dispatch(batch)
+                batch = []
+                while self._queue and len(batch) < self.max_size:
+                    pending = self._queue.popleft()
+                    if not pending.event.is_set():  # skip timed-out waiters
+                        batch.append(pending)
+            if batch:
+                self._dispatch(batch)
 
     def _dispatch(self, batch: list[_SyncPending[T, R]]) -> None:
         now = time.perf_counter()

@@ -331,6 +331,27 @@ class ContinuousBatchingEngine:
         self._queue.append(_Request(rid, prompt, max_new_tokens, temperature))
         return rid
 
+    def reset(self) -> None:
+        """Rebuild all device/host decode state after a failed tick.
+
+        ``step``'s compiled programs donate the pool buffers — an exception
+        mid-dispatch can leave ``pool.k/v`` deleted and slots half-admitted,
+        which would poison every later tick. Queued and in-flight requests
+        are dropped (their callers were already failed by the layer above);
+        weights and compiled programs are kept."""
+        import jax
+
+        self.pool = init_pool(self.cfg, self.allocator.num_pages, self.page_size)
+        self.allocator = PageAllocator(self.allocator.num_pages)
+        self.slots = [_Slot() for _ in range(self.max_slots)]
+        self._queue.clear()
+        self._finished_buffer.clear()
+        self._page_table[:] = 0
+        self._lens[:] = 0
+        self._temps[:] = 0.0
+        self._last_tok[:] = 0
+        self._rng = jax.random.PRNGKey(int(np.random.default_rng().integers(2**31)))
+
     @property
     def has_work(self) -> bool:
         return bool(self._queue) or any(s.active for s in self.slots)
@@ -371,8 +392,14 @@ class ContinuousBatchingEngine:
         while self._queue and free:
             req = self._queue[0]
             tok_ids = self.tokenizer.encode(req.prompt, add_bos=True)
-            max_prompt = self.max_pages_per_seq * self.page_size - 8
-            tok_ids = tok_ids[:max_prompt]
+            # budget split inside the per-sequence page window: generation
+            # gets its requested tokens up to HALF the window (else decode
+            # retires on out_of_pages after window - prompt tokens); the
+            # prompt always keeps at least the other half, so a huge
+            # max_new can never silently truncate most of the context
+            window = self.max_pages_per_seq * self.page_size
+            reserve = min(req.max_new + 2, window // 2)
+            tok_ids = tok_ids[: window - reserve]
             need_now = (len(tok_ids) + self.page_size - 1) // self.page_size
             need_total = min(
                 (len(tok_ids) + req.max_new + self.page_size - 1) // self.page_size,

@@ -65,6 +65,7 @@ class PagedGenerationService:
         self._pump: Optional[threading.Thread] = None
         self._pump_running = False
         self._closed = False
+        self._broken = False  # reset failed: paged path permanently down
         # occupancy telemetry (the serving-path answer to BatcherStats):
         # ticks with >1 active slot are decode steps shared across requests
         self._ticks = 0
@@ -88,6 +89,8 @@ class PagedGenerationService:
         with self._mutex:
             if self._closed:
                 raise RuntimeError("generation service is closed")
+            if self._broken:
+                raise RuntimeError("paged decode engine is down (reset failed)")
             self._inbox.append(ticket)
             self._ensure_pump()
         if not ticket.event.wait(timeout_s or self.default_timeout_s):
@@ -156,8 +159,22 @@ class PagedGenerationService:
                 finished = self.engine.step()
             except Exception:
                 logger.exception("paged decode tick failed; failing waiters")
+                # the failed dispatch may have consumed the donated pool
+                # buffers and left slots half-admitted — rebuild the decode
+                # state so the NEXT request gets a working engine instead of
+                # a permanently poisoned one. Reset runs BEFORE waiters are
+                # failed and before _pump_running flips: this pump still
+                # exclusively owns the engine, so a retrying caller cannot
+                # start a new pump that races the reset.
+                reset_ok = True
+                try:
+                    self.engine.reset()
+                except Exception:
+                    logger.exception("paged engine reset failed; paged path disabled")
+                    reset_ok = False
                 with self._mutex:
                     self._pump_running = False
+                    self._broken = self._broken or not reset_ok
                     self._fail_all_locked("decode tick failed")
                 return
             active = sum(s.active for s in self.engine.slots)

@@ -149,6 +149,36 @@ class TestPagedGenerationService:
         # all pages reclaimed after the burst
         assert service.stats()["free_pages"] == service.stats()["total_pages"] - 1
 
+    def test_tick_failure_fails_waiters_and_recovers(self, contiguous):
+        """A failing decode tick must (a) fail the in-flight waiters with
+        finish_reason='error' and (b) reset the engine so the NEXT request
+        works — a transient device error must not poison the pool forever."""
+        engine = ContinuousBatchingEngine(
+            model_config=contiguous.model_config,
+            params=contiguous.params,
+            tokenizer=contiguous.tokenizer,
+            max_slots=2,
+            page_size=16,
+            max_pages_per_seq=4,
+        )
+        svc = PagedGenerationService(engine)
+        original_step = engine.step
+
+        def boom():
+            raise RuntimeError("injected device failure")
+
+        engine.step = boom
+        try:
+            failed = svc.generate("doomed request", max_new_tokens=4)
+            assert failed.finish_reason == "error"
+        finally:
+            engine.step = original_step
+        # engine was reset by the pump; a new request must succeed
+        ok = svc.generate("hello world from request two", max_new_tokens=4)
+        assert ok.finish_reason in ("stop", "length")
+        assert svc.stats()["free_pages"] == svc.stats()["total_pages"] - 1
+        svc.close()
+
     def test_closed_service_rejects(self, contiguous):
         engine = ContinuousBatchingEngine(
             model_config=contiguous.model_config,
