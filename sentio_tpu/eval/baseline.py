@@ -1,0 +1,373 @@
+"""Measured reference-architecture baseline (SURVEY.md §6: "we must measure
+the baseline ourselves").
+
+The reference stack itself cannot run here (its deps — langgraph, FastAPI,
+rank_bm25, qdrant-client — are not in the image, and its model calls need
+remote APIs this zero-egress environment cannot reach). What CAN be
+measured faithfully is its *architecture*: the same pipeline shape
+(/root/reference/src/core/graph/factory.py:94-188 — retrieve(dense+sparse
+fused) → rerank → select → generate → verify) with every ML step behind a
+REAL HTTP process boundary, exactly where the reference calls Jina/OpenAI
+(jina.py:33, jina_reranker.py:120, openai.py:117 there), served by a
+loopback mock-model server using the reference's own test fakes (hash
+embeddings, jina_reranker.py:297's decaying default ranking, canned chat).
+
+This is a deliberate LOWER bound for the reference: zero network latency,
+zero model compute. Every millisecond it records is pure architecture cost
+— HTTP framing, JSON serialization of document payloads, python-loop
+retrieval math (rank_bm25-style scoring, per-doc cosine, O(k²) MMR) — the
+cost our in-process device-dispatch design removes. Real deployments add
+10–400 ms of WAN latency per hop on top; SENTIO_BASELINE_RTT_MS injects a
+per-hop delay for sensitivity studies but defaults to 0 so the recorded
+baseline is never fabricated.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+import re
+import threading
+import time
+from collections import defaultdict
+from typing import Optional, Sequence
+
+import numpy as np
+
+from sentio_tpu.models.document import Document
+
+RRF_K = 20  # the reference's tuned value (retrievers/factory.py:29-34 there)
+
+
+# ------------------------------------------------------- loopback mock APIs
+
+
+class MockModelServer:
+    """aiohttp server with the reference's three remote-model surfaces,
+    implemented with its own mock-mode semantics (deterministic hash
+    embeddings, identity rerank with decaying scores, canned chat)."""
+
+    def __init__(self, dim: int = 1024, rtt_ms: float = 0.0) -> None:
+        self.dim = dim
+        self.rtt_s = max(rtt_ms, 0.0) / 1000.0
+        self.port: Optional[int] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self.calls = defaultdict(int)
+
+    # hash-embedding identical to the reference's empty-key mock mode
+    # (jina.py:141-159 there): deterministic per-text pseudo-vectors
+    def _embed(self, texts: list[str]) -> np.ndarray:
+        from sentio_tpu.ops.embedder import HashEmbedder
+
+        if not hasattr(self, "_hash"):
+            from sentio_tpu.config import EmbedderConfig
+
+            self._hash = HashEmbedder(EmbedderConfig(provider="hash", dim=self.dim, cache_size=0))
+        return self._hash._embed_batch(texts)
+
+    async def _maybe_delay(self) -> None:
+        if self.rtt_s:
+            await asyncio.sleep(self.rtt_s)
+
+    async def _h_embed(self, request):
+        from aiohttp import web
+
+        await self._maybe_delay()
+        body = await request.json()
+        self.calls["embeddings"] += 1
+        vecs = self._embed(body["input"])
+        return web.json_response(
+            {"data": [{"embedding": v.tolist(), "index": i} for i, v in enumerate(vecs)]}
+        )
+
+    async def _h_rerank(self, request):
+        from aiohttp import web
+
+        await self._maybe_delay()
+        body = await request.json()
+        self.calls["rerank"] += 1
+        n = len(body["documents"])
+        # the reference's fallback/default ranking: original order with
+        # scores 1.0 - 0.1*idx (jina_reranker.py:297-322 there)
+        results = [
+            {"index": i, "relevance_score": max(1.0 - 0.1 * i, 0.0)} for i in range(n)
+        ]
+        return web.json_response({"results": results[: body.get("top_n", n)]})
+
+    async def _h_chat(self, request):
+        from aiohttp import web
+
+        await self._maybe_delay()
+        body = await request.json()
+        self.calls["chat"] += 1
+        content = body["messages"][-1]["content"]
+        if '"verdict"' in content or "citations_ok" in content:
+            reply = '{"verdict": "pass", "citations_ok": true, "notes": []}'
+        else:
+            first = content.splitlines()[0][:120] if content else ""
+            reply = f"Based on the provided sources: {first}"
+        return web.json_response({"choices": [{"message": {"content": reply}}]})
+
+    def start(self) -> "MockModelServer":
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_post("/v1/embeddings", self._h_embed)
+        app.router.add_post("/v1/rerank", self._h_rerank)
+        app.router.add_post("/v1/chat/completions", self._h_chat)
+
+        def run() -> None:
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            loop.run_until_complete(site.start())
+            self.port = site._server.sockets[0].getsockname()[1]
+            self._started.set()
+            loop.run_forever()
+            loop.run_until_complete(runner.cleanup())
+
+        self._thread = threading.Thread(target=run, daemon=True, name="mock-model-api")
+        self._thread.start()
+        if not self._started.wait(10.0):
+            raise RuntimeError("mock model server failed to start")
+        return self
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    @property
+    def base_url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+
+# ---------------------------------------------- reference-shape host pipeline
+
+
+class _PyBM25:
+    """rank_bm25-style scorer: python dict walk per document per query —
+    the reference's sparse leg (sparse.py:33-203 there, `rank_bm25` Okapi)."""
+
+    def __init__(self, docs: Sequence[Document], k1: float = 1.5, b: float = 0.75) -> None:
+        self.k1, self.b = k1, b
+        self.docs = list(docs)
+        self.doc_tfs: list[dict[str, int]] = []
+        df: dict[str, int] = defaultdict(int)
+        lens = []
+        for doc in docs:
+            toks = doc.content.lower().split()
+            tf: dict[str, int] = defaultdict(int)
+            for t in toks:
+                tf[t] += 1
+            self.doc_tfs.append(dict(tf))
+            lens.append(len(toks))
+            for t in tf:
+                df[t] += 1
+        n = max(len(self.docs), 1)
+        self.avgdl = sum(lens) / n if lens else 0.0
+        self.doc_lens = lens
+        self.idf = {
+            t: math.log(1.0 + (n - d + 0.5) / (d + 0.5)) for t, d in df.items()
+        }
+
+    def top_k(self, query: str, k: int) -> list[tuple[int, float]]:
+        q_toks = query.lower().split()
+        scores = []
+        for di, tf in enumerate(self.doc_tfs):  # the hot python loop
+            s = 0.0
+            norm = self.k1 * (1 - self.b + self.b * self.doc_lens[di] / max(self.avgdl, 1e-9))
+            for t in q_toks:
+                f = tf.get(t)
+                if f:
+                    s += self.idf.get(t, 0.0) * f * (self.k1 + 1) / (f + norm)
+            if s > 0:
+                scores.append((di, s))
+        scores.sort(key=lambda x: -x[1])
+        return scores[:k]
+
+
+class ReferenceShapePipeline:
+    """The reference's /chat hot path (SURVEY.md §3.1), process boundaries
+    included: embed-query HTTP → dense cosine → python BM25 → RRF dict merge
+    → scorer plugins (keyword regex + semantic re-embed via HTTP + MMR loop)
+    → rerank HTTP → token-budget select → generate HTTP → verify HTTP."""
+
+    def __init__(
+        self,
+        server: MockModelServer,
+        documents: Sequence[Document],
+        top_k: int = 10,
+        use_rerank: bool = True,
+        use_verify: bool = True,
+        use_scorers: bool = True,
+    ) -> None:
+        import httpx
+
+        self.server = server
+        self.docs = list(documents)
+        self.top_k = top_k
+        self.use_rerank = use_rerank
+        self.use_verify = use_verify
+        self.use_scorers = use_scorers
+        self.client = httpx.Client(base_url=server.base_url, timeout=30.0)
+        # corpus ingestion exactly like the reference: batched embed calls
+        # of <= 100 texts over HTTP (jina.py:229-236 there)
+        vecs = []
+        texts = [d.content for d in self.docs]
+        for start in range(0, len(texts), 100):
+            vecs.append(self._embed_http(texts[start : start + 100]))
+        self.matrix = np.concatenate(vecs, axis=0) if vecs else np.zeros((0, server.dim))
+        self.matrix /= np.maximum(np.linalg.norm(self.matrix, axis=1, keepdims=True), 1e-9)
+        self.bm25 = _PyBM25(self.docs)
+
+    def close(self) -> None:
+        self.client.close()
+
+    # ------------------------------------------------------------ HTTP hops
+
+    def _embed_http(self, texts: list[str]) -> np.ndarray:
+        resp = self.client.post("/v1/embeddings", json={"input": texts})
+        resp.raise_for_status()
+        data = resp.json()["data"]
+        return np.asarray([d["embedding"] for d in data], np.float32)
+
+    def _rerank_http(self, query: str, docs: list[Document], top_n: int) -> list[Document]:
+        resp = self.client.post(
+            "/v1/rerank",
+            json={"query": query, "documents": [d.content for d in docs], "top_n": top_n},
+        )
+        resp.raise_for_status()
+        order = resp.json()["results"]
+        return [docs[r["index"]] for r in order]
+
+    def _chat_http(self, prompt: str) -> str:
+        resp = self.client.post(
+            "/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": prompt}], "max_tokens": 1024},
+        )
+        resp.raise_for_status()
+        return resp.json()["choices"][0]["message"]["content"]
+
+    # -------------------------------------------------------------- retrieval
+
+    def retrieve(self, query: str) -> list[Document]:
+        pool = self.top_k * 2
+        q_vec = self._embed_http([query])[0]
+        q_vec /= max(np.linalg.norm(q_vec), 1e-9)
+        sims = self.matrix @ q_vec
+        dense_idx = np.argsort(-sims)[:pool]
+        sparse_hits = self.bm25.top_k(query, pool)
+
+        # RRF dict merge (hybrid.py:204-259 there)
+        fused: dict[int, float] = defaultdict(float)
+        for rank, di in enumerate(dense_idx):
+            fused[int(di)] += 1.0 / (RRF_K + rank)
+        for rank, (di, _s) in enumerate(sparse_hits):
+            fused[di] += 1.0 / (RRF_K + rank)
+
+        merged = [self.docs[di] for di in fused]
+        if self.use_scorers and merged:
+            # keyword overlap scorer (scorers.py:25-72 there)
+            q_words = set(re.findall(r"\w+", query.lower()))
+            for di in list(fused):
+                words = set(re.findall(r"\w+", self.docs[di].content.lower()))
+                overlap = len(q_words & words) / max(len(q_words), 1)
+                fused[di] += 0.8 * overlap
+            # semantic scorer: re-embeds the candidate docs over HTTP per
+            # query — the N+1 the reference pays (scorers.py:131-191 there)
+            texts = [d.content for d in merged]
+            doc_vecs = self._embed_http(texts)
+            for (di, _), vec in zip(fused.items(), doc_vecs):
+                denom = max(np.linalg.norm(vec) * np.linalg.norm(q_vec), 1e-9)
+                fused[di] += 0.5 * float(np.dot(vec, q_vec) / denom)
+            # MMR diversification: greedy O(k²) python loop (scorers.py:194+)
+            chosen: list[int] = []
+            cand = list(fused)
+            while cand and len(chosen) < self.top_k:
+                best, best_score = None, -1e9
+                for di in cand:
+                    rel = fused[di]
+                    red = 0.0
+                    for cj in chosen:
+                        a, b = doc_vecs[merged.index(self.docs[di])], doc_vecs[merged.index(self.docs[cj])]
+                        red = max(red, float(np.dot(a, b) / max(np.linalg.norm(a) * np.linalg.norm(b), 1e-9)))
+                    score = 0.7 * rel - 0.3 * red
+                    if score > best_score:
+                        best, best_score = di, score
+                chosen.append(best)
+                cand.remove(best)
+            ranked = chosen
+        else:
+            ranked = [di for di, _ in sorted(fused.items(), key=lambda x: -x[1])[: self.top_k]]
+        return [self.docs[di] for di in ranked[: self.top_k]]
+
+    # ------------------------------------------------------------------ chat
+
+    def chat(self, question: str) -> tuple[list[Document], str]:
+        docs = self.retrieve(question)
+        if self.use_rerank and docs:
+            docs = self._rerank_http(question, docs, self.top_k)
+        # token-budget select: ~4 chars/token, 2000-token cap (nodes.py:296-338)
+        budget_chars = 2000 * 4
+        selected, used = [], 0
+        for doc in docs:
+            if used + len(doc.content) > budget_chars and selected:
+                break
+            selected.append(doc)
+            used += len(doc.content)
+        context = "\n\n".join(
+            f"[{i}] Source: {d.metadata.get('source', d.id)}\n{d.content}"
+            for i, d in enumerate(selected, 1)
+        )
+        answer = self._chat_http(f"{context}\n\nQuestion: {question}\nAnswer:")
+        if self.use_verify:
+            self._chat_http(
+                f'Audit this answer. Reply JSON {{"verdict": ..., "citations_ok": ...}}\n'
+                f"Answer: {answer}\nContext: {context[:2000]}"
+            )
+        return selected, answer
+
+
+def measure_baseline(
+    documents: Sequence[Document],
+    queries: Sequence[tuple[str, str]],
+    dim: int = 1024,
+    rtt_ms: float = 0.0,
+    use_scorers: bool = True,
+):
+    """Stand up the loopback mock APIs, run the reference-shape pipeline
+    over the queries, and return (EvalResult, per-query HTTP-call counts)."""
+    from sentio_tpu.eval.harness import run_queries
+
+    server = MockModelServer(dim=dim, rtt_ms=rtt_ms).start()
+    t0 = time.perf_counter()
+    pipeline = ReferenceShapePipeline(server, documents, use_scorers=use_scorers)
+    ingest_s = time.perf_counter() - t0
+    try:
+        result = run_queries("reference-baseline", pipeline.chat, queries)
+        result.extras["ingest_s"] = round(ingest_s, 2)
+        result.extras["http_calls"] = dict(server.calls)
+        return result
+    finally:
+        pipeline.close()
+        server.stop()
+
+
+def _self_check() -> None:  # pragma: no cover — manual smoke
+    from sentio_tpu.eval.dataset import build_bundle
+
+    bundle = build_bundle(n_docs=128, n_queries=8)
+    result = measure_baseline(bundle.documents, bundle.queries, dim=256)
+    print(json.dumps(result.row(), indent=1))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    _self_check()
