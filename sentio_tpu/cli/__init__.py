@@ -119,6 +119,32 @@ def _cmd_convert(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_eval(args: argparse.Namespace) -> int:
+    """Run the BASELINE.md measurement matrix (five configs + the measured
+    reference-architecture baseline) and write EVAL.json."""
+    from sentio_tpu.eval.runner import run_eval
+
+    payload = run_eval(
+        scale=args.scale,
+        n_docs=args.docs,
+        n_queries=args.queries,
+        concurrency=args.concurrency,
+        new_tokens=args.new_tokens,
+        rtt_ms=args.rtt_ms,
+        seed=args.seed,
+        skip_baseline=args.skip_baseline,
+        configs={c.strip() for c in args.configs.split(",") if c.strip()} or None
+        if args.configs else None,
+    )
+    text = json.dumps(payload, indent=2)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+        print(f"wrote {args.out}", file=sys.stderr)
+    print(text)
+    return 0
+
+
 def _cmd_info(args: argparse.Namespace) -> int:
     import jax
 
@@ -177,6 +203,23 @@ def main(argv: list[str] | None = None) -> int:
     p_conv.add_argument("dst", help="output framework checkpoint directory")
     p_conv.add_argument("--dtype", default="bfloat16")
     p_conv.set_defaults(fn=_cmd_convert)
+
+    p_eval = sub.add_parser(
+        "eval", help="run the BASELINE measurement matrix; write EVAL.json"
+    )
+    p_eval.add_argument("--scale", default="bench", choices=["tiny", "bench"])
+    p_eval.add_argument("--docs", type=int, default=1024)
+    p_eval.add_argument("--queries", type=int, default=64)
+    p_eval.add_argument("--concurrency", type=int, default=8)
+    p_eval.add_argument("--new-tokens", type=int, default=48)
+    p_eval.add_argument("--rtt-ms", type=float, default=0.0,
+                        help="inject per-hop RTT into the loopback baseline APIs")
+    p_eval.add_argument("--seed", type=int, default=0)
+    p_eval.add_argument("--skip-baseline", action="store_true")
+    p_eval.add_argument("--configs", default="",
+                        help="comma list: sparse_api,dense,hybrid_rerank,full_paged,batched")
+    p_eval.add_argument("--out", default="", help="also write the JSON here")
+    p_eval.set_defaults(fn=_cmd_eval)
 
     p_info = sub.add_parser("info", help="print version/device/config info")
     p_info.set_defaults(fn=_cmd_info)

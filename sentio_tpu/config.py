@@ -210,10 +210,16 @@ class GeneratorConfig:
     """Generator/verifier settings (reference: llm/factory.py:14-69,
     graph/factory.py:90,145 — context budget 2000 tok, 1024 max new)."""
 
-    provider: str = "tpu"  # tpu | echo (deterministic fake)
+    provider: str = "tpu"  # tpu | echo (deterministic fake) | openai (remote API)
     model_preset: str = "llama3-8b"  # llama3-8b | tiny
     checkpoint_path: str = ""  # converted checkpoint (cli convert llama ...)
     tokenizer_path: str = ""  # local HF tokenizer dir
+    # remote OpenAI-compatible endpoint (provider="openai" — the reference's
+    # primary path, kept here as the pluggable fallback seam)
+    api_base: str = ""
+    api_key: str = ""
+    api_model: str = "default"
+    api_timeout_s: float = 60.0
     mode: str = "balanced"  # fast | balanced | quality | creative
     max_new_tokens: int = 1024
     context_token_budget: int = 2000
@@ -246,6 +252,10 @@ class GeneratorConfig:
             model_preset=_env_str(["LLM_MODEL", "CHAT_LLM_MODEL"], "llama3-8b"),
             checkpoint_path=_env_str(["LLM_CHECKPOINT", "MODEL_PATH"], ""),
             tokenizer_path=_env_str(["LLM_TOKENIZER", "TOKENIZER_PATH"], ""),
+            api_base=_env_str(["OPENAI_BASE_URL", "CHAT_LLM_BASE_URL"], ""),
+            api_key=_env_str(["OPENAI_API_KEY", "CHAT_LLM_API_KEY"], ""),
+            api_model=_env_str(["OPENAI_MODEL", "CHAT_LLM_API_MODEL"], "default"),
+            api_timeout_s=_env_float(["OPENAI_TIMEOUT_S"], 60.0),
             mode=_env_str(["LLM_MODE"], "balanced"),
             max_new_tokens=_env_int(["LLM_MAX_TOKENS", "MAX_NEW_TOKENS"], 1024),
             context_token_budget=_env_int(["CONTEXT_TOKEN_BUDGET"], 2000),

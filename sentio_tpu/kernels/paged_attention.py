@@ -66,10 +66,14 @@ def _paged_kernel(
     @pl.when(i * page <= cur)
     def _block():
         q = q_ref[:]  # [Hkv, rep, D]
-        k = k_ref[:]  # [page, Hkv, D]
-        # s[g, r, p] = q[g, r, :] · k[p, g, :]
+        # [page, Hkv, D] → [Hkv, page, D]: Mosaic's tpu.matmul requires the
+        # batch dims of both operands at the SAME index ("batch dims must be
+        # equal" compile error on real chips otherwise; interpret mode on CPU
+        # accepted the mismatched layout)
+        k = k_ref[:].swapaxes(0, 1)
+        # s[g, r, p] = q[g, r, :] · k[g, p, :]
         s = jax.lax.dot_general(
-            q, k, (((2,), (2,)), ((0,), (1,))), preferred_element_type=jnp.float32
+            q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
         ) * sm_scale  # [Hkv, rep, page]
 
         pos = i * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
@@ -81,9 +85,10 @@ def _paged_kernel(
         alpha = jnp.exp(jnp.where(m_new > NEG_INF / 2, m_prev - m_new, 0.0))
 
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=2, keepdims=True)
-        # acc[g, r, :] += p[g, r, :] @ v[:, g, :]
+        v = v_ref[:].swapaxes(0, 1)  # [Hkv, page, D], same batch-dim rule
+        # acc[g, r, :] += p[g, r, :] @ v[g, :, :]
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[:], (((2,), (0,)), ((0,), (1,))),
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )
         m_ref[:] = m_new

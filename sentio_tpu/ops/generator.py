@@ -87,6 +87,126 @@ class TpuProvider:
         )
 
 
+@dataclass
+class OpenAIProvider:
+    """OpenAI-compatible remote chat provider — the pluggable alternative the
+    reference keeps as its primary path (/root/reference/src/core/llm/
+    providers/openai.py:44-314: httpx client against ``{base_url}/chat/
+    completions``, bearer auth, retry loop, SSE streaming). Here it is the
+    FALLBACK seam: the default provider is the in-process TPU engine, and
+    this adapter exists for split deployments (retrieval on the TPU host,
+    generation on a remote endpoint) and for measuring the API-baseline
+    configs in eval/. Zero-egress images point it at loopback mocks."""
+
+    base_url: str = "http://127.0.0.1:8000/v1"
+    api_key: str = ""
+    model: str = "default"
+    timeout_s: float = 60.0
+    max_retries: int = 2
+    name: str = "openai"
+
+    def _client(self):
+        """One pooled httpx.Client per provider — reused across calls and
+        retries (a client per request would pay a TCP/TLS handshake each)."""
+        client = getattr(self, "_client_cached", None)
+        if client is None:
+            import httpx
+
+            headers = {"Content-Type": "application/json"}
+            if self.api_key:
+                headers["Authorization"] = f"Bearer {self.api_key}"
+            client = httpx.Client(
+                base_url=self.base_url.rstrip("/"), timeout=self.timeout_s,
+                headers=headers,
+            )
+            object.__setattr__(self, "_client_cached", client)
+        return client
+
+    def close(self) -> None:
+        client = getattr(self, "_client_cached", None)
+        if client is not None:
+            client.close()
+            object.__setattr__(self, "_client_cached", None)
+
+    def _payload(self, prompt: str, max_new_tokens: int, temperature: float) -> dict:
+        return {
+            "model": self.model,
+            "messages": [{"role": "user", "content": prompt}],
+            "max_tokens": max_new_tokens,
+            "temperature": temperature,
+        }
+
+    def chat(self, prompt: str, max_new_tokens: int, temperature: float) -> str:
+        import random
+        import time
+
+        last_exc: Exception | None = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                resp = self._client().post(
+                    "/chat/completions",
+                    json=self._payload(prompt, max_new_tokens, temperature),
+                )
+                resp.raise_for_status()
+                return resp.json()["choices"][0]["message"]["content"]
+            except Exception as exc:  # noqa: BLE001 — retry transport/5xx/429
+                status = getattr(getattr(exc, "response", None), "status_code", None)
+                if status is not None and 400 <= status < 500 and status != 429:
+                    raise  # auth/config errors don't heal with retries
+                last_exc = exc
+                if attempt < self.max_retries:
+                    time.sleep(min(2.0**attempt, 4.0) * (0.5 + random.random() / 2))
+        raise RuntimeError(f"openai provider failed after {self.max_retries + 1} attempts") from last_exc
+
+    def stream(self, prompt: str, max_new_tokens: int, temperature: float) -> Iterator[str]:
+        """SSE stream (``data: {...}`` lines, ``[DONE]`` sentinel). Falls back
+        to one non-streaming call if the endpoint rejects stream=True."""
+        import json as _json
+
+        payload = {**self._payload(prompt, max_new_tokens, temperature), "stream": True}
+        saw_sse = False
+        try:
+            body_lines: list[str] = []
+            with self._client().stream(
+                "POST", "/chat/completions", json=payload
+            ) as resp:
+                resp.raise_for_status()
+                for line in resp.iter_lines():
+                    if not line.startswith("data:"):
+                        body_lines.append(line)
+                        continue
+                    saw_sse = True
+                    data = line[len("data:"):].strip()
+                    if data == "[DONE]":
+                        return
+                    try:
+                        delta = _json.loads(data)["choices"][0]["delta"]
+                    except (KeyError, IndexError, ValueError):
+                        continue
+                    chunk = delta.get("content")
+                    if chunk:
+                        yield chunk
+            if not saw_sse:
+                # endpoint ignored stream=True and sent one JSON completion
+                reply = _json.loads("\n".join(body_lines))
+                yield reply["choices"][0]["message"]["content"]
+        except Exception:  # noqa: BLE001 — endpoints without SSE support
+            if saw_sse:
+                # the stream broke mid-answer — surfacing a silently
+                # truncated reply as complete would be worse than failing
+                raise
+            yield self.chat(prompt, max_new_tokens, temperature)
+
+    @classmethod
+    def from_config(cls, cfg: GeneratorConfig) -> "OpenAIProvider":
+        return cls(
+            base_url=cfg.api_base or cls.base_url,
+            api_key=cfg.api_key,
+            model=cfg.api_model or cls.model,
+            timeout_s=cfg.api_timeout_s,
+        )
+
+
 _PROVIDERS: dict[str, type] = {}
 
 
@@ -102,6 +222,7 @@ def register_provider(name: str):
 
 register_provider("echo")(EchoProvider)
 register_provider("tpu")(TpuProvider)
+register_provider("openai")(OpenAIProvider)
 
 
 def get_provider(name: str, **kwargs):
@@ -189,6 +310,8 @@ def create_generator(
     elif cfg.provider == "tpu":
         # no engine supplied (tests, host-only dev) → deterministic echo
         provider = EchoProvider()
+    elif cfg.provider == "openai":
+        provider = OpenAIProvider.from_config(cfg)
     else:
         provider = get_provider(cfg.provider)
     return LLMGenerator(provider=provider, config=cfg)
