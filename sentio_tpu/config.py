@@ -233,6 +233,9 @@ class GeneratorConfig:
     # paged KV + continuous batching as the live /chat decode path; the
     # contiguous engine remains for streaming and as an escape hatch
     use_paged_decode: bool = True
+    # decode sub-steps fused into one device dispatch per engine tick —
+    # amortizes host round trips; admission waits at most one tick
+    decode_steps_per_tick: int = 8
     prefill_buckets: tuple[int, ...] = (256, 512, 1024, 2048, 4096)
     temperature_by_mode: tuple[tuple[str, float], ...] = (
         ("fast", 0.0),
@@ -267,6 +270,7 @@ class GeneratorConfig:
             kv_max_pages_per_seq=_env_int(["KV_MAX_PAGES_PER_SEQ"], 64),
             max_batch_size=_env_int(["LLM_MAX_BATCH"], 8),
             use_paged_decode=_env_bool(["USE_PAGED_KV", "USE_PAGED_DECODE"], True),
+            decode_steps_per_tick=_env_int(["DECODE_STEPS_PER_TICK"], 8),
         )
 
 

@@ -56,13 +56,33 @@ class PagedPool:
         return self.k.shape[1]
 
 
-def init_pool(cfg: LlamaConfig, num_pages: int, page_size: int) -> PagedPool:
+def init_pool(
+    cfg: LlamaConfig, num_pages: int, page_size: int, mesh=None
+) -> PagedPool:
+    """Allocate the page pool; with a mesh, kv heads shard over ``tp`` (the
+    same axis the wk/wv weight columns shard on, so per-shard Q·K never
+    crosses devices) and page tables stay replicated host-side."""
+    import jax
     import jax.numpy as jnp
 
     shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
-    return PagedPool(
-        k=jnp.zeros(shape, cfg.jdtype), v=jnp.zeros(shape, cfg.jdtype), page_size=page_size
-    )
+    if mesh is None:
+        k = jnp.zeros(shape, cfg.jdtype)
+        v = jnp.zeros(shape, cfg.jdtype)
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from sentio_tpu.parallel.mesh import AXIS_TP
+
+        tp = mesh.shape[AXIS_TP]
+        if cfg.n_kv_heads % tp != 0:
+            raise ValueError(
+                f"n_kv_heads={cfg.n_kv_heads} not divisible by tp={tp}"
+            )
+        spec = NamedSharding(mesh, P(None, None, None, AXIS_TP, None))
+        k = jax.device_put(jnp.zeros(shape, cfg.jdtype), spec)
+        v = jax.device_put(jnp.zeros(shape, cfg.jdtype), spec)
+    return PagedPool(k=k, v=v, page_size=page_size)
 
 
 class PageAllocator:
@@ -119,13 +139,16 @@ def _paged_attn_xla(q, k_pages_l, v_pages_l, page_table, lens, n_rep):
 
 
 def paged_decode_forward(params, cfg: LlamaConfig, tok, lens, page_table, k_pages, v_pages,
-                         attn_impl=None):
+                         attn_impl=None, write_mask=None):
     """One decode step over the paged pool.
 
     tok [B] int32 (last sampled token per slot); lens [B] absolute position
     the new token occupies; page_table [B, NB]. Returns (logits [B, V],
     k_pages, v_pages) with this step's k/v scattered into each row's current
     page. Masked/free slots must point their page table at scratch page 0.
+    ``write_mask`` [B] bool (optional) redirects masked rows' k/v writes to
+    the scratch page — the multi-step tick uses it to freeze rows that hit
+    EOS or their budget mid-scan without corrupting their cache.
     """
     import jax
     import jax.numpy as jnp
@@ -142,6 +165,9 @@ def paged_decode_forward(params, cfg: LlamaConfig, tok, lens, page_table, k_page
 
     page_ids = jnp.take_along_axis(page_table, (lens // page)[:, None], axis=1)[:, 0]
     offsets = lens % page
+    if write_mask is not None:
+        page_ids = jnp.where(write_mask, page_ids, 0)
+        offsets = jnp.where(write_mask, offsets, 0)
 
     x = L.embed(params["embed_tokens"], tok[:, None], dt)  # [B,1,d]
     for i in range(cfg.n_layers):
@@ -245,6 +271,8 @@ class ContinuousBatchingEngine:
         max_pages_per_seq: int = 16,
         rng_seed: int = 0,
         use_pallas: Optional[bool] = None,
+        steps_per_tick: int = 8,
+        mesh=None,
     ) -> None:
         import jax
 
@@ -259,12 +287,19 @@ class ContinuousBatchingEngine:
         self.max_slots = max_slots
         self.page_size = page_size
         self.max_pages_per_seq = max_pages_per_seq
+        # decode sub-steps fused into ONE device dispatch per tick: host
+        # round trips (the dominant per-token cost through remote-attached
+        # devices, and real overhead even locally) amortize over the chunk.
+        # Admission latency grows by at most steps_per_tick decode steps.
+        self.steps_per_tick = max(int(steps_per_tick), 1)
+        self.mesh = mesh
         if num_pages is None:
             num_pages = 1 + max_slots * max_pages_per_seq
-        self.pool = init_pool(self.cfg, num_pages, page_size)
+        self.pool = init_pool(self.cfg, num_pages, page_size, mesh=mesh)
         self.allocator = PageAllocator(num_pages)
 
         self.slots = [_Slot() for _ in range(max_slots)]
+        self.last_tick_active = 0
         self._queue: list[_Request] = []
         self._finished_buffer: list[PagedResult] = []
         self._next_id = itertools.count()
@@ -289,40 +324,70 @@ class ContinuousBatchingEngine:
 
     def _build_fns(self) -> None:
         import jax
+        import jax.numpy as jnp
 
         cfg = self.cfg
         attn_impl = self._attn_impl
+        eos_id = self.tokenizer.eos_id
 
-        @partial(jax.jit, donate_argnums=(4, 5))
-        def step(params, tok, lens, page_table, k_pages, v_pages, rng, temps):
+        @partial(jax.jit, static_argnames=("steps",), donate_argnums=(4, 5))
+        def step_n(params, tok, lens, page_table, k_pages, v_pages, rng, temps,
+                   budgets, steps):
+            """``steps`` decode sub-steps fused into one dispatch (lax.scan).
+
+            Per-row ``budgets`` bound how far each row may advance (token
+            budget / page capacity, mirrored host-side); rows halt early on
+            EOS. Frozen rows keep their lens/tok and write to scratch.
+            Returns per-step sampled tokens and execution mask [steps, B].
+            """
             from sentio_tpu.runtime.sampling import sample_tokens
 
-            logits, k_pages, v_pages = paged_decode_forward(
-                params, cfg, tok, lens, page_table, k_pages, v_pages,
-                attn_impl=attn_impl,
+            def body(carry, idx):
+                tok, lens, k_pages, v_pages, rng, halted = carry
+                active = (~halted) & (idx < budgets)
+                logits, k_pages, v_pages = paged_decode_forward(
+                    params, cfg, tok, lens, page_table, k_pages, v_pages,
+                    attn_impl=attn_impl, write_mask=active,
+                )
+                rng, sub = jax.random.split(rng)
+                nxt = sample_tokens(logits, sub, temps)
+                tok = jnp.where(active, nxt, tok)
+                lens = jnp.where(active, lens + 1, lens)
+                halted = halted | (active & (nxt == eos_id))
+                return (tok, lens, k_pages, v_pages, rng, halted), (nxt, active)
+
+            b = tok.shape[0]
+            init = (tok, lens, k_pages, v_pages, rng, jnp.zeros(b, bool))
+            (tok, lens, k_pages, v_pages, rng, _), (toks, mask) = jax.lax.scan(
+                body, init, jnp.arange(steps)
             )
-            rng, sub = jax.random.split(rng)
-            nxt = sample_tokens(logits, sub, temps)
-            return nxt, k_pages, v_pages, rng
+            return toks, mask, k_pages, v_pages, rng
 
-        self._step = step
+        self._step_n = step_n
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def do_scatter(k_pages, v_pages, k_cache, v_cache, page_table):
-            return scatter_prefill(k_pages, v_pages, k_cache, v_cache, page_table)
+        @partial(jax.jit, donate_argnums=(7, 8))
+        def prefill_scatter(params, ids, positions, lens, rng, temps, scat,
+                            k_pages, v_pages):
+            """Batched admission in ONE dispatch: contiguous prefill forward,
+            cache scatter into each row's pages, first-token sample from each
+            row's last prompt logit. Pad rows scatter to scratch page 0."""
+            from sentio_tpu.models.llama import init_cache, llama_forward
+            from sentio_tpu.runtime.sampling import sample_tokens
 
-        self._scatter = do_scatter
-
-        @jax.jit
-        def prefill(params, ids, positions, cache):
-            from sentio_tpu.models.llama import llama_forward
-
+            b, width = ids.shape
+            cache = init_cache(cfg, b, width)
             logits, cache = llama_forward(
                 params, cfg, ids, positions=positions, cache=cache, cache_index=0
             )
-            return logits, cache
+            k_pages, v_pages = scatter_prefill(
+                k_pages, v_pages, cache["k"], cache["v"], scat
+            )
+            last = jnp.take_along_axis(logits, (lens - 1)[:, None, None], axis=1)[:, 0]
+            rng, sub = jax.random.split(rng)
+            first = sample_tokens(last, sub, temps)
+            return first, k_pages, v_pages, rng
 
-        self._prefill = prefill
+        self._prefill_scatter = prefill_scatter
 
     # --------------------------------------------------------------- public
 
@@ -341,7 +406,9 @@ class ContinuousBatchingEngine:
         weights and compiled programs are kept."""
         import jax
 
-        self.pool = init_pool(self.cfg, self.allocator.num_pages, self.page_size)
+        self.pool = init_pool(
+            self.cfg, self.allocator.num_pages, self.page_size, mesh=self.mesh
+        )
         self.allocator = PageAllocator(self.allocator.num_pages)
         self.slots = [_Slot() for _ in range(self.max_slots)]
         self._queue.clear()
@@ -368,8 +435,10 @@ class ContinuousBatchingEngine:
         return [done[i] for i in ids]
 
     def step(self) -> list[PagedResult]:
-        """One engine tick: admit waiting requests, one fused decode step,
-        retire finished slots. Returns results completed this tick."""
+        """One engine tick: admit waiting requests, one fused multi-step
+        decode dispatch, retire finished slots. Returns results completed
+        this tick."""
+        self.last_tick_active = 0
         self._admit()
         out, self._finished_buffer = self._finished_buffer, []
         if any(s.active for s in self.slots):
@@ -381,9 +450,17 @@ class ContinuousBatchingEngine:
     def _free_slot_indices(self) -> list[int]:
         return [i for i, s in enumerate(self.slots) if not s.active]
 
-    def _admit(self) -> None:
-        import jax.numpy as jnp
+    ADMIT_BUCKETS = (1, 2, 4, 8)
 
+    def _prefill_width(self, n_tokens: int) -> int:
+        width = bucket_size(
+            max(n_tokens, self.page_size), tuple(
+                b for b in self.PREFILL_BUCKETS if b % self.page_size == 0
+            ) or (self.page_size,),
+        )
+        return ((width + self.page_size - 1) // self.page_size) * self.page_size
+
+    def _admit(self) -> None:
         free = self._free_slot_indices()
         if not free or not self._queue:
             return
@@ -400,7 +477,6 @@ class ContinuousBatchingEngine:
             window = self.max_pages_per_seq * self.page_size
             reserve = min(req.max_new + 2, window // 2)
             tok_ids = tok_ids[: window - reserve]
-            need_now = (len(tok_ids) + self.page_size - 1) // self.page_size
             need_total = min(
                 (len(tok_ids) + req.max_new + self.page_size - 1) // self.page_size,
                 self.max_pages_per_seq,
@@ -429,53 +505,75 @@ class ContinuousBatchingEngine:
         if not batch:
             return
 
-        # one prefill per admitted row: width-bucketed contiguous forward,
-        # then scatter the cache into that row's pages. Rows are prefilled
-        # individually (B=1) so each (width) bucket compiles once.
-        from sentio_tpu.models.llama import init_cache
-        from sentio_tpu.runtime.sampling import sample_tokens
-
-        import jax
-
-        for slot_idx, req, tok_ids in batch:
-            width = bucket_size(
-                max(len(tok_ids), self.page_size), tuple(
-                    b for b in self.PREFILL_BUCKETS if b % self.page_size == 0
-                ) or (self.page_size,),
-            )
-            width = ((width + self.page_size - 1) // self.page_size) * self.page_size
-            ids = np.full((1, width), self.tokenizer.pad_id, np.int32)
-            ids[0, : len(tok_ids)] = tok_ids
-            positions = np.arange(width, dtype=np.int32)[None, :]
-            cache = init_cache(self.cfg, 1, width)
-            logits, cache = self._prefill(
-                self.params, jnp.asarray(ids), jnp.asarray(positions), cache
-            )
-            # table for the scatter: blocks holding prompt → this row's pages,
-            # padding blocks → scratch 0
-            nb = width // self.page_size
-            used = (len(tok_ids) + self.page_size - 1) // self.page_size
-            scat = np.zeros((1, nb), np.int32)
-            scat[0, :used] = self.slots[slot_idx].pages[:used]
-            self.pool.k, self.pool.v = self._scatter(
-                self.pool.k, self.pool.v, cache["k"], cache["v"], jnp.asarray(scat)
-            )
-            # first generated token comes from the prefill logits
-            self._rng, sub = jax.random.split(self._rng)
-            first = sample_tokens(
-                logits[:, len(tok_ids) - 1], sub, req.temperature
-            )
-            self._last_tok[slot_idx] = int(first[0])
+        # batched admission: rows group by prefill-width bucket, each group
+        # splits into batch-bucket chunks → admitting N same-width requests
+        # costs ceil(N / max_batch_bucket) prefill dispatches, not N
+        groups: dict[int, list[tuple[int, _Request, list[int]]]] = {}
+        for item in batch:
+            groups.setdefault(self._prefill_width(len(item[2])), []).append(item)
+        max_rows = max(self.ADMIT_BUCKETS)
+        for width, members in sorted(groups.items()):
+            for start in range(0, len(members), max_rows):
+                self._prefill_chunk(width, members[start : start + max_rows])
 
         # freshly admitted rows already have token 0 sampled; emit it now so
         # EOS-as-first-token retires before wasting a decode tick
         self._finished_buffer.extend(self._post_sample({i for i, _, _ in batch}))
 
-    def _decode_tick(self) -> list[PagedResult]:
-        import jax
+    def _prefill_chunk(
+        self, width: int, chunk: list[tuple[int, _Request, list[int]]]
+    ) -> None:
+        """One prefill+scatter+sample dispatch for up to max(ADMIT_BUCKETS)
+        same-width-bucket rows (rows pad up to a batch bucket)."""
         import jax.numpy as jnp
 
-        nxt, self.pool.k, self.pool.v, self._rng = self._step(
+        rows = bucket_size(len(chunk), self.ADMIT_BUCKETS)
+        nb = width // self.page_size
+        ids = np.full((rows, width), self.tokenizer.pad_id, np.int32)
+        lens = np.ones(rows, np.int32)
+        temps = np.zeros(rows, np.float32)
+        scat = np.zeros((rows, nb), np.int32)  # pad rows/blocks → scratch 0
+        for r, (slot_idx, req, tok_ids) in enumerate(chunk):
+            ids[r, : len(tok_ids)] = tok_ids
+            lens[r] = len(tok_ids)
+            temps[r] = req.temperature
+            used = (len(tok_ids) + self.page_size - 1) // self.page_size
+            scat[r, :used] = self.slots[slot_idx].pages[:used]
+        positions = np.broadcast_to(
+            np.arange(width, dtype=np.int32)[None, :], (rows, width)
+        ).copy()
+        first, self.pool.k, self.pool.v, self._rng = self._prefill_scatter(
+            self.params, jnp.asarray(ids), jnp.asarray(positions),
+            jnp.asarray(lens), self._rng, jnp.asarray(temps), jnp.asarray(scat),
+            self.pool.k, self.pool.v,
+        )
+        first = np.asarray(first)
+        for r, (slot_idx, _req, _ids) in enumerate(chunk):
+            self._last_tok[slot_idx] = int(first[r])
+
+    def _decode_tick(self) -> list[PagedResult]:
+        import jax.numpy as jnp
+
+        steps = self.steps_per_tick
+        budgets = np.zeros(self.max_slots, np.int32)
+        finished: list[PagedResult] = []
+        for i, slot in enumerate(self.slots):
+            if not slot.active:
+                continue
+            capacity = len(slot.pages) * self.page_size
+            budgets[i] = max(
+                min(slot.max_new - len(slot.emitted), capacity - 1 - slot.length, steps),
+                0,
+            )
+            if budgets[i] == 0:  # defensive: a zero-budget row can't progress
+                finished.append(self._retire(i, "length"))
+        # rows sharing THIS fused dispatch — the honest occupancy number
+        # (post-tick slot counts miss requests that retire inside the tick)
+        self.last_tick_active = int((budgets > 0).sum())
+        if not budgets.any():
+            return finished
+
+        toks, mask, self.pool.k, self.pool.v, self._rng = self._step_n(
             self.params,
             jnp.asarray(self._last_tok),
             jnp.asarray(self._lens),
@@ -484,48 +582,75 @@ class ContinuousBatchingEngine:
             self.pool.v,
             self._rng,
             jnp.asarray(self._temps),
+            jnp.asarray(budgets),
+            steps=steps,
         )
-        nxt = np.asarray(nxt)
+        toks = np.asarray(toks)  # [steps, B]
+        mask = np.asarray(mask)
+
+        # host replay of the device scan: each executed sub-step is exactly
+        # one old-style tick — write counted, token folded, retirement checked
         for i, slot in enumerate(self.slots):
             if not slot.active:
                 continue
-            slot.length += 1
-            self._lens[i] = slot.length
-            self._last_tok[i] = nxt[i]
-        return self._post_sample(set(range(self.max_slots)))
+            for s in range(steps):
+                if not mask[s, i]:
+                    break  # per-row mask is monotone: budget out or halted
+                slot.length += 1
+                self._lens[i] = slot.length
+                self._last_tok[i] = int(toks[s, i])
+                result = self._fold_and_maybe_retire(i)
+                if result is not None:
+                    finished.append(result)
+                    break
+        return finished
 
     def _post_sample(self, rows: set) -> list[PagedResult]:
-        """Fold the freshly sampled token of each row in ``rows`` into its
-        slot; retire rows that hit EOS or their token budget."""
+        """Fold the freshly sampled (admission-time) token of each row in
+        ``rows`` into its slot; retire rows that hit EOS or their budget."""
         finished: list[PagedResult] = []
         for i in sorted(rows):
-            slot = self.slots[i]
-            if not slot.active:
+            if not self.slots[i].active:
                 continue
-            tok = int(self._last_tok[i])
-            hit_eos = tok == self.tokenizer.eos_id
-            if not hit_eos:
-                slot.emitted.append(tok)
-            hit_len = len(slot.emitted) >= slot.max_new
-            out_of_pages = slot.length + 1 >= len(slot.pages) * self.page_size
-            if hit_eos or hit_len or out_of_pages:
-                finished.append(
-                    PagedResult(
-                        request_id=slot.request_id,
-                        text=self.tokenizer.decode(slot.emitted),
-                        tokens=list(slot.emitted),
-                        prompt_tokens=slot.prompt_tokens,
-                        finish_reason="stop" if hit_eos else "length",
-                    )
-                )
-                self.allocator.free(slot.pages)
-                slot.active = False
-                slot.pages = []
-                self._page_table[i] = 0
-                self._lens[i] = 0
-                self._temps[i] = 0.0
-                self._last_tok[i] = 0
+            result = self._fold_and_maybe_retire(i)
+            if result is not None:
+                finished.append(result)
         return finished
+
+    def _fold_and_maybe_retire(self, i: int) -> Optional[PagedResult]:
+        """Fold ``_last_tok[i]`` (sampled, not yet forwarded) into slot ``i``;
+        retire on EOS / token budget / page capacity. The ONE place the
+        retirement conditions live — admission-time and decode-replay paths
+        must never diverge, and the decode budgets mirror these bounds."""
+        slot = self.slots[i]
+        tok = int(self._last_tok[i])
+        hit_eos = tok == self.tokenizer.eos_id
+        if not hit_eos:
+            slot.emitted.append(tok)
+        hit_len = len(slot.emitted) >= slot.max_new
+        out_of_pages = slot.length + 1 >= len(slot.pages) * self.page_size
+        if hit_eos or hit_len or out_of_pages:
+            return self._retire(i, "stop" if hit_eos else "length")
+        return None
+
+    def _retire(self, i: int, reason: str) -> PagedResult:
+        """Free a slot's pages and zero its device-mirror row."""
+        slot = self.slots[i]
+        result = PagedResult(
+            request_id=slot.request_id,
+            text=self.tokenizer.decode(slot.emitted),
+            tokens=list(slot.emitted),
+            prompt_tokens=slot.prompt_tokens,
+            finish_reason=reason,
+        )
+        self.allocator.free(slot.pages)
+        slot.active = False
+        slot.pages = []
+        self._page_table[i] = 0
+        self._lens[i] = 0
+        self._temps[i] = 0.0
+        self._last_tok[i] = 0
+        return result
 
     # ---------------------------------------------------------------- stats
 

@@ -177,7 +177,12 @@ class PagedGenerationService:
                     self._broken = self._broken or not reset_ok
                     self._fail_all_locked("decode tick failed")
                 return
-            active = sum(s.active for s in self.engine.slots)
+            # in-tick occupancy from the engine: rows that shared the fused
+            # decode dispatch (post-tick slot counts would miss requests that
+            # retired inside the tick)
+            active = getattr(self.engine, "last_tick_active", None)
+            if active is None:
+                active = sum(s.active for s in self.engine.slots)
             with self._mutex:
                 self._ticks += 1
                 self._active_sum += active

@@ -195,6 +195,8 @@ class DependencyContainer:
                 max_slots=cfg.max_batch_size,
                 page_size=cfg.kv_page_size,
                 max_pages_per_seq=cfg.kv_max_pages_per_seq,
+                steps_per_tick=cfg.decode_steps_per_tick,
+                mesh=self.mesh,  # pool kv-heads shard over tp with the weights
             )
             return PagedGenerationService(paged)
 
