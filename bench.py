@@ -1,20 +1,31 @@
 """End-to-end RAG serving benchmark — runs on whatever jax.devices() offers
 (the driver runs it on one real TPU chip; CPU works for smoke tests).
 
-Measures p50 end-to-end latency of the full retrieve → rerank → select →
-generate → verify pipeline with EVERY model in-process on the device: the
-bi-encoder embeds the query, the exact dense index matmuls over an in-HBM
-corpus, BM25 scores host-side concurrently, the cross-encoder reranks, and
-the decoder generates + self-audits. This is the pipeline the reference
-serves over four remote HTTP hops (SURVEY.md §3.1).
+Three phases, all through the DEFAULT serving path (paged KV continuous
+batching — concurrent callers share fused decode dispatches):
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-``vs_baseline`` is the speedup vs the only latency figure the reference
-ships — its 2000 ms p95 alerting target (deploy/kubernetes/monitoring.yaml
-there); >1.0 means faster. Details go to stderr.
+A. **RAG e2e** — the full retrieve → rerank → select → generate → verify
+   graph with every model in-process on the device, driven by N concurrent
+   clients. Reports per-request p50/p95, QPS, per-node p50 breakdown, and
+   decode-batch occupancy.
+B. **Measured baseline** — the reference's architecture shape (HTTP hops to
+   loopback mock models, python-loop retrieval math; eval/baseline.py) over
+   the SAME corpus and queries. ``vs_baseline`` is measured-vs-measured: a
+   deliberate LOWER bound for the reference (zero network latency, zero
+   model compute — real deployments add 10-400 ms WAN per hop).
+C. **Decode at scale** — continuous-batched generation on the largest
+   Llama-class model that fits single-chip HBM in bf16 (~1.4B by default,
+   BENCH_SERVE_SCALE=8b for an 8B-layer-geometry variant), reporting
+   tokens/s, MFU (= tok/s x 2 x params / peak bf16 FLOPs), and HBM
+   bandwidth utilization of the decode loop.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+Details go to stderr.
 
 Env knobs: BENCH_FAST=1 (tiny models, quick smoke), BENCH_QUERIES=N,
-BENCH_CORPUS=N, BENCH_NEW_TOKENS=N.
+BENCH_CORPUS=N, BENCH_NEW_TOKENS=N, BENCH_CONCURRENCY=N,
+BENCH_SKIP_SCALE=1 (skip phase C), BENCH_SERVE_SCALE=1b|8b,
+BENCH_SCALE_TOKENS=N.
 """
 
 from __future__ import annotations
@@ -24,7 +35,9 @@ import os
 import sys
 import time
 
-REFERENCE_P95_TARGET_MS = 2000.0
+# v5e peak specs for the MFU / bandwidth denominators
+PEAK_BF16_FLOPS = 197e12
+PEAK_HBM_GBS = 819.0
 
 
 def log(*args) -> None:
@@ -59,20 +72,27 @@ def build_corpus(n: int) -> list:
     return docs
 
 
-def main() -> None:
-    t_start = time.perf_counter()
-    fast = os.environ.get("BENCH_FAST") == "1"
-    n_queries = int(os.environ.get("BENCH_QUERIES", "12" if not fast else "4"))
-    n_corpus = int(os.environ.get("BENCH_CORPUS", "2048" if not fast else "64"))
-    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "48" if not fast else "8"))
-
+def count_params(params) -> int:
     import jax
 
-    from sentio_tpu.config import EmbedderConfig, GeneratorConfig, RerankConfig, Settings
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+def _percentile(vals, q):
+    vals = sorted(vals)
+    if not vals:
+        return 0.0
+    return vals[min(int(len(vals) * q), len(vals) - 1)]
+
+
+def phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
+                new_tokens, concurrency):
+    """Full graph with paged continuous batching, N concurrent clients."""
+    import threading
+
+    from sentio_tpu.config import EmbedderConfig, GeneratorConfig, RerankConfig
     from sentio_tpu.graph.factory import GraphConfig, build_basic_graph
     from sentio_tpu.graph.state import create_initial_state
-    from sentio_tpu.models.llama import LlamaConfig
-    from sentio_tpu.models.transformer import EncoderConfig
     from sentio_tpu.ops.bm25 import BM25Index
     from sentio_tpu.ops.dense_index import TpuDenseIndex
     from sentio_tpu.ops.embedder import TpuEmbedder
@@ -81,6 +101,251 @@ def main() -> None:
     from sentio_tpu.ops.retrievers import DenseRetriever, HybridRetriever, SparseRetriever
     from sentio_tpu.ops.verifier import AnswerVerifier
     from sentio_tpu.runtime.engine import GeneratorEngine
+    from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+    from sentio_tpu.runtime.service import PagedGenerationService
+
+    log("phase A: building corpus + indexes ...")
+    embedder = TpuEmbedder(
+        EmbedderConfig(provider="tpu", batch_size=128), model_config=enc_cfg
+    )
+    t0 = time.perf_counter()
+    corpus_vecs = embedder.embed_many([d.text for d in docs])
+    embed_s = time.perf_counter() - t0
+    docs_per_s = len(docs) / max(embed_s, 1e-9)
+    log(f"  embedded {len(docs)} docs in {embed_s:.1f}s ({docs_per_s:.0f} docs/s)")
+
+    dense_index = TpuDenseIndex(dim=enc_cfg.dim)
+    dense_index.add(docs, corpus_vecs)
+    bm25 = BM25Index().build(docs)
+    retriever = HybridRetriever(
+        retrievers=[DenseRetriever(embedder, dense_index), SparseRetriever(bm25)],
+        config=settings.retrieval,
+    )
+    reranker = CrossEncoderReranker(RerankConfig(batch_size=32), model_config=enc_cfg)
+    engine = GeneratorEngine(
+        config=GeneratorConfig(model_preset="bench", max_new_tokens=new_tokens),
+        model_config=llm_cfg,
+    )
+    paged = ContinuousBatchingEngine(
+        model_config=llm_cfg, params=engine.params, tokenizer=engine.tokenizer,
+        max_slots=max(concurrency, 4), page_size=16,
+        max_pages_per_seq=llm_cfg.max_len // 16, steps_per_tick=16,
+        max_tick_steps=64,
+        # random-init weights greedy-sample EOS almost immediately — fixed-
+        # length generation measures the cost real tuned models actually pay
+        ignore_eos=True,
+    )
+    service = PagedGenerationService(paged)
+    generator = LLMGenerator(
+        provider=TpuProvider(engine=engine, service=service), config=settings.generator
+    )
+    verifier = AnswerVerifier(generator=generator, config=settings.generator)
+    graph = build_basic_graph(
+        retriever, generator, reranker=reranker, verifier=verifier,
+        config=GraphConfig(settings=settings),
+    )
+
+    log("phase A: warmup (compilation, full-concurrency burst) ...")
+    t0 = time.perf_counter()
+    warm_threads = [
+        threading.Thread(
+            target=graph.invoke,
+            args=(create_initial_state(queries[i % len(queries)], metadata={"mode": "fast"}),),
+        )
+        for i in range(concurrency)
+    ]
+    for t in warm_threads:
+        t.start()
+    for t in warm_threads:
+        t.join()
+    log(f"  warmup done in {time.perf_counter() - t0:.1f}s")
+
+    latencies: list[float] = []
+    node_ms: dict[str, list[float]] = {}
+    lock = threading.Lock()
+    pending = [queries[i % len(queries)] for i in range(n_queries)]
+    stats_before = service.stats()
+
+    def worker():
+        while True:
+            with lock:
+                if not pending:
+                    return
+                q = pending.pop()
+            t0 = time.perf_counter()
+            state = graph.invoke(create_initial_state(q, metadata={"mode": "fast"}))
+            dt = (time.perf_counter() - t0) * 1000.0
+            with lock:
+                latencies.append(dt)
+                for node, ms in (state["metadata"].get("node_timings_ms") or {}).items():
+                    node_ms.setdefault(node, []).append(ms)
+
+    t_run = time.perf_counter()
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_run
+    stats = service.stats()
+    service.close()
+
+    ticks = stats["ticks"] - stats_before["ticks"]
+    active = stats["avg_active_slots"] * stats["ticks"] - (
+        stats_before["avg_active_slots"] * stats_before["ticks"]
+    )
+    result = {
+        "p50_ms": round(_percentile(latencies, 0.50), 1),
+        "p95_ms": round(_percentile(latencies, 0.95), 1),
+        "qps": round(len(latencies) / wall, 2),
+        "concurrency": concurrency,
+        "n_queries": len(latencies),
+        "node_p50_ms": {
+            k: round(_percentile(v, 0.50), 1) for k, v in sorted(node_ms.items())
+        },
+        "avg_active_slots": round(active / max(ticks, 1), 2),
+        "max_active_slots": stats["max_active_slots"],
+        "ingest_docs_per_s": round(docs_per_s, 1),
+    }
+    log(f"phase A: p50={result['p50_ms']}ms p95={result['p95_ms']}ms "
+        f"qps={result['qps']} occupancy={result['avg_active_slots']} "
+        f"nodes={result['node_p50_ms']}")
+    return result
+
+
+def phase_b_baseline(docs, queries, n_queries, dim, rtt_ms=0.0):
+    """Reference-architecture loopback baseline on the same corpus/queries.
+    ``rtt_ms`` > 0 injects a per-hop delay approximating WAN latency to the
+    remote model APIs the reference actually calls (still zero model
+    compute, so even the rtt variant is a lower bound)."""
+    from sentio_tpu.eval.baseline import measure_baseline
+
+    log(f"phase B: measuring reference-architecture loopback baseline "
+        f"(rtt={rtt_ms:.0f}ms) ...")
+    qs = [(queries[i % len(queries)], "na") for i in range(n_queries)]
+    result = measure_baseline(docs, qs, dim=dim, rtt_ms=rtt_ms)
+    log(f"phase B: baseline(rtt={rtt_ms:.0f}) p50={result.p50_ms:.1f}ms "
+        f"qps={result.qps:.2f} (zero model compute)")
+    return {
+        "p50_ms": round(result.p50_ms, 1),
+        "p95_ms": round(result.p95_ms, 1),
+        "qps": round(result.qps, 2),
+        "rtt_ms": rtt_ms,
+        "http_calls": result.extras.get("http_calls", {}),
+    }
+
+
+def serve_scale_config(kind: str):
+    from sentio_tpu.models.llama import LlamaConfig
+
+    if kind == "8b":
+        # Llama-3-8B layer geometry (dim 4096 / mlp 14336 / GQA 32:8), layer
+        # count cut to fit 16 GB HBM with the KV pool: ~3.5B params ~ 7 GB
+        return LlamaConfig(
+            vocab_size=32_000, dim=4096, n_layers=12, n_heads=32, n_kv_heads=8,
+            mlp_dim=14_336, max_len=2048, rope_theta=500_000.0,
+        )
+    # ~1.4B: MXU-aligned dims, GQA 16:8
+    return LlamaConfig(
+        vocab_size=32_000, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
+        mlp_dim=8192, max_len=2048, rope_theta=500_000.0,
+    )
+
+
+def phase_c_scale(kind: str, new_tokens: int, concurrency: int):
+    """Continuous-batched decode throughput at HBM-filling model scale."""
+    import threading
+
+    from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+    from sentio_tpu.runtime.service import PagedGenerationService
+
+    cfg = serve_scale_config(kind)
+    log(f"phase C: init {kind} serve-scale model "
+        f"(dim={cfg.dim} L={cfg.n_layers} vocab={cfg.vocab_size}) ...")
+    t0 = time.perf_counter()
+    engine = ContinuousBatchingEngine(
+        model_config=cfg, max_slots=concurrency, page_size=16,
+        max_pages_per_seq=1024 // 16, steps_per_tick=16, max_tick_steps=64,
+        ignore_eos=True,
+    )
+    n_params = count_params(engine.params)
+    log(f"  {n_params / 1e9:.2f}B params on device in {time.perf_counter() - t0:.1f}s")
+
+    prompt = ("Benchmark prompt: explain how a systolic array performs matrix "
+              "multiplication and why bfloat16 doubles its throughput. " * 3)
+    service = PagedGenerationService(engine)
+    log("phase C: warmup (compilation, full-concurrency burst) ...")
+    t0 = time.perf_counter()
+    warm = {}
+
+    def warm_worker(i):
+        warm[i] = service.generate(prompt, max_new_tokens=engine.max_tick_steps)
+
+    threads = [threading.Thread(target=warm_worker, args=(i,)) for i in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    log(f"  warmup done in {time.perf_counter() - t0:.1f}s")
+
+    results = {}
+
+    def worker(i):
+        results[i] = service.generate(
+            prompt + f" variant {i}", max_new_tokens=new_tokens, temperature=0.0
+        )
+
+    stats_before = service.stats()
+    sub_steps_before = engine.total_sub_steps
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    stats = service.stats()
+    service.close()
+
+    total_tokens = sum(len(r.tokens) for r in results.values())
+    tok_s = total_tokens / wall
+    # each executed device sub-step streams the weights once (the fused scan
+    # runs its full static length regardless of per-row halting)
+    steps_s = max(engine.total_sub_steps - sub_steps_before, 1) / wall
+    weight_bytes = n_params * 2
+    out = {
+        "model": kind,
+        "params_b": round(n_params / 1e9, 2),
+        "tokens": total_tokens,
+        "wall_s": round(wall, 2),
+        "tokens_per_s": round(tok_s, 1),
+        "mfu_pct": round(tok_s * 2 * n_params / PEAK_BF16_FLOPS * 100, 3),
+        # decode is bandwidth-bound: each fused step streams the weights once
+        "hbm_util_pct": round(steps_s * weight_bytes / (PEAK_HBM_GBS * 1e9) * 100, 1),
+        "concurrency": concurrency,
+        "max_active_slots": stats["max_active_slots"],
+    }
+    log(f"phase C: {out['tokens_per_s']} tok/s on {out['params_b']}B params "
+        f"(MFU {out['mfu_pct']}%, HBM {out['hbm_util_pct']}%) over {wall:.1f}s")
+    return out
+
+
+def main() -> None:
+    t_start = time.perf_counter()
+    fast = os.environ.get("BENCH_FAST") == "1"
+    n_queries = int(os.environ.get("BENCH_QUERIES", "24" if not fast else "4"))
+    n_corpus = int(os.environ.get("BENCH_CORPUS", "2048" if not fast else "64"))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "48" if not fast else "8"))
+    concurrency = int(os.environ.get("BENCH_CONCURRENCY", "8" if not fast else "2"))
+    skip_scale = os.environ.get("BENCH_SKIP_SCALE") == "1" or fast
+    serve_scale = os.environ.get("BENCH_SERVE_SCALE", "1b")
+    scale_tokens = int(os.environ.get("BENCH_SCALE_TOKENS", "64"))
+
+    import jax
+
+    from sentio_tpu.config import Settings
+    from sentio_tpu.models.llama import LlamaConfig
+    from sentio_tpu.models.transformer import EncoderConfig
 
     devices = jax.devices()
     log(f"devices: {len(devices)} x {devices[0].platform} ({devices[0].device_kind})")
@@ -100,42 +365,14 @@ def main() -> None:
 
     settings = Settings()
     settings.generator.max_new_tokens = new_tokens
-    settings.generator.context_token_budget = 1200
+    settings.generator.verifier_max_tokens = 64
+    # ByteTokenizer ~ 1 token/char vs the selector's 4-chars/token heuristic:
+    # keep assembled prompts inside the model window (see eval/runner.py)
+    settings.generator.context_token_budget = max(
+        (llm_cfg.max_len - new_tokens - 256) // 4, 32
+    )
 
-    log("building corpus + indexes ...")
     docs = build_corpus(n_corpus)
-    embedder = TpuEmbedder(
-        EmbedderConfig(provider="tpu", batch_size=128), model_config=enc_cfg
-    )
-    t0 = time.perf_counter()
-    corpus_vecs = embedder.embed_many([d.text for d in docs])
-    embed_s = time.perf_counter() - t0
-    log(f"embedded {n_corpus} docs in {embed_s:.1f}s "
-        f"({n_corpus / max(embed_s, 1e-9):.0f} docs/s)")
-
-    dense_index = TpuDenseIndex(dim=enc_cfg.dim)
-    dense_index.add(docs, corpus_vecs)
-    bm25 = BM25Index().build(docs)
-
-    retriever = HybridRetriever(
-        retrievers=[DenseRetriever(embedder, dense_index), SparseRetriever(bm25)],
-        config=settings.retrieval,
-    )
-    reranker = CrossEncoderReranker(
-        RerankConfig(batch_size=32), model_config=enc_cfg
-    )
-    engine = GeneratorEngine(
-        config=GeneratorConfig(model_preset="bench", max_new_tokens=new_tokens),
-        model_config=llm_cfg,
-    )
-    generator = LLMGenerator(provider=TpuProvider(engine=engine), config=settings.generator)
-    verifier = AnswerVerifier(generator=generator, config=settings.generator)
-
-    graph = build_basic_graph(
-        retriever, generator, reranker=reranker, verifier=verifier,
-        config=GraphConfig(settings=settings),
-    )
-
     queries = [
         "What does the MXU systolic array do in bfloat16?",
         "How does JAX compile functions with XLA sharding?",
@@ -144,33 +381,33 @@ def main() -> None:
         "What fuses sparse and dense retrieval before generation?",
     ]
 
-    log("warmup (compilation) ...")
-    t0 = time.perf_counter()
-    graph.invoke(create_initial_state(queries[0], metadata={"mode": "fast"}))
-    log(f"warmup done in {time.perf_counter() - t0:.1f}s")
+    rag = phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
+                      new_tokens, concurrency)
+    baseline = phase_b_baseline(docs, queries, n_queries, dim=enc_cfg.dim)
+    baseline_wan = None if fast else phase_b_baseline(
+        docs, queries, n_queries, dim=enc_cfg.dim,
+        rtt_ms=float(os.environ.get("BENCH_BASELINE_RTT_MS", "40")),
+    )
+    scale = None if skip_scale else phase_c_scale(serve_scale, scale_tokens, 8)
 
-    latencies = []
-    for i in range(n_queries):
-        q = queries[i % len(queries)]
-        t0 = time.perf_counter()
-        state = graph.invoke(create_initial_state(q, metadata={"mode": "fast"}))
-        dt = (time.perf_counter() - t0) * 1000.0
-        latencies.append(dt)
-        log(f"  q{i}: {dt:.0f} ms  path={state['metadata']['graph_path']}")
-
-    latencies.sort()
-    p50 = latencies[len(latencies) // 2]
-    p95 = latencies[min(int(len(latencies) * 0.95), len(latencies) - 1)]
     total_s = time.perf_counter() - t_start
-    log(f"p50={p50:.0f}ms p95={p95:.0f}ms over {n_queries} queries; "
-        f"bench wall {total_s:.0f}s")
+    log(f"bench wall {total_s:.0f}s")
 
-    print(json.dumps({
+    payload = {
         "metric": "rag_chat_e2e_p50_latency",
-        "value": round(p50, 1),
+        "value": rag["p50_ms"],
         "unit": "ms",
-        "vs_baseline": round(REFERENCE_P95_TARGET_MS / p50, 2),
-    }))
+        # measured-vs-measured: the loopback architecture baseline on the
+        # same corpus/queries (a LOWER bound for the reference — zero RTT,
+        # zero model compute)
+        "vs_baseline": round(baseline["p50_ms"] / max(rag["p50_ms"], 1e-9), 3),
+        "rag": rag,
+        "baseline": baseline,
+        **({"baseline_wan": baseline_wan} if baseline_wan else {}),
+        **({"serve_scale": scale} if scale else {}),
+        "wall_s": round(total_s, 1),
+    }
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":

@@ -114,6 +114,9 @@ class RetrievalConfig:
     # persisted TpuDenseIndex to load at startup ("" = start empty); BM25
     # rehydrates from the loaded documents
     index_path: str = ""
+    # persisted cached-web-results index consulted before fusion (reference
+    # CACHE_COLLECTION_NAME "web_cache", hybrid.py:96-107 there); "" = off
+    web_cache_path: str = ""
 
     @classmethod
     def from_env(cls) -> "RetrievalConfig":
@@ -137,6 +140,7 @@ class RetrievalConfig:
             qdrant_url=_env_str(["QDRANT_URL"], "http://localhost:6333"),
             qdrant_api_key=_env_str(["QDRANT_API_KEY"], ""),
             index_path=_env_str(["INDEX_PATH"], ""),
+            web_cache_path=_env_str(["WEB_CACHE_PATH", "CACHE_COLLECTION_PATH"], ""),
         )
 
 
@@ -234,8 +238,11 @@ class GeneratorConfig:
     # contiguous engine remains for streaming and as an escape hatch
     use_paged_decode: bool = True
     # decode sub-steps fused into one device dispatch per engine tick —
-    # amortizes host round trips; admission waits at most one tick
-    decode_steps_per_tick: int = 8
+    # amortizes host round trips; admission waits at most one tick. With an
+    # empty queue the engine grows ticks toward the max so long generations
+    # cost few host fetches (the per-tick fetch is ~RTT on remote devices)
+    decode_steps_per_tick: int = 16
+    decode_max_tick_steps: int = 64
     prefill_buckets: tuple[int, ...] = (256, 512, 1024, 2048, 4096)
     temperature_by_mode: tuple[tuple[str, float], ...] = (
         ("fast", 0.0),
@@ -270,7 +277,8 @@ class GeneratorConfig:
             kv_max_pages_per_seq=_env_int(["KV_MAX_PAGES_PER_SEQ"], 64),
             max_batch_size=_env_int(["LLM_MAX_BATCH"], 8),
             use_paged_decode=_env_bool(["USE_PAGED_KV", "USE_PAGED_DECODE"], True),
-            decode_steps_per_tick=_env_int(["DECODE_STEPS_PER_TICK"], 8),
+            decode_steps_per_tick=_env_int(["DECODE_STEPS_PER_TICK"], 16),
+            decode_max_tick_steps=_env_int(["DECODE_MAX_TICK_STEPS"], 64),
         )
 
 

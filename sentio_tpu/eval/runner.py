@@ -207,6 +207,12 @@ def run_eval(
                 # per-sequence window = the model's full context — prompts
                 # sized by context_token_budget above always fit
                 max_pages_per_seq=llm_cfg.max_len // 16,
+                steps_per_tick=16,
+                max_tick_steps=64,
+                # random-init weights greedy-sample EOS almost immediately;
+                # fixed-length generation keeps configs 4/5 measuring the
+                # full decode+verify cost real tuned models pay
+                ignore_eos=True,
             )
             service = PagedGenerationService(paged)
             generator = LLMGenerator(

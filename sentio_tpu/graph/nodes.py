@@ -80,38 +80,50 @@ def create_reranker_node(reranker, settings: Optional[Settings] = None):
     return rerank_node
 
 
+CHARS_PER_TOKEN = 4  # the selector's ≈4-chars/token budget heuristic
+
+
+def select_documents(
+    docs: list, budget_tokens: int
+) -> tuple[list[Document], int]:
+    """Sort by best score, dedup by id, enforce the ≈4-chars/token context
+    budget (reference nodes.py:276-338). Shared by the graph's select node
+    and the SSE streaming path so the two can never drift."""
+    docs = sorted(docs, key=lambda d: d.score(), reverse=True)
+    seen: set[str] = set()
+    budget_chars = budget_tokens * CHARS_PER_TOKEN
+    used = 0
+    selected: list[Document] = []
+    for doc in docs:
+        if doc.id in seen:
+            continue
+        seen.add(doc.id)
+        text = doc.content
+        if not text.strip():
+            continue
+        cost = len(text)
+        if used + cost > budget_chars and selected:
+            continue  # keep scanning: a shorter doc may still fit
+        selected.append(doc)
+        used += cost
+        if used >= budget_chars:
+            break
+    return selected, used
+
+
 def create_document_selector_node(settings: Optional[Settings] = None):
     settings = settings or get_settings()
     budget_tokens = settings.generator.context_token_budget
 
     def select_node(state: RAGState) -> dict[str, Any]:
         docs = state.get("reranked_documents") or state.get("retrieved_documents") or []
-        # sort by best score, dedup by id (reference nodes.py:276-338)
-        docs = sorted(docs, key=lambda d: d.score(), reverse=True)
-        seen: set[str] = set()
-        budget_chars = budget_tokens * 4  # ≈4 chars/token heuristic
-        used = 0
-        selected: list[Document] = []
-        for doc in docs:
-            if doc.id in seen:
-                continue
-            seen.add(doc.id)
-            text = doc.content
-            if not text.strip():
-                continue
-            cost = len(text)
-            if used + cost > budget_chars and selected:
-                continue  # keep scanning: a shorter doc may still fit
-            selected.append(doc)
-            used += cost
-            if used >= budget_chars:
-                break
+        selected, used = select_documents(docs, budget_tokens)
         return {
             "selected_documents": selected,
             "metadata": {
                 "num_selected": len(selected),
                 "context_chars": used,
-                "context_budget_chars": budget_chars,
+                "context_budget_chars": budget_tokens * CHARS_PER_TOKEN,
             },
         }
 

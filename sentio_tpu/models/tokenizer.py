@@ -109,7 +109,17 @@ class ByteTokenizer:
         return ids
 
     def decode(self, ids: Sequence[int]) -> str:
-        return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", errors="replace")
+        """Bytes decode; specials are dropped, but UNUSED vocab slots (the
+        MXU-alignment padding above the specials) render as the replacement
+        char — a random-init model sampling them must yield visible output,
+        not a silently empty string (which reads as 'no answer' downstream)."""
+        out = bytearray()
+        for i in ids:
+            if 0 <= i < 256:
+                out.append(i)
+            elif i > self.sep_id:  # unused padded-vocab slot
+                out.extend("�".encode())
+        return out.decode("utf-8", errors="replace")
 
 
 class WordHashTokenizer:

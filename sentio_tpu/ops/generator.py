@@ -82,6 +82,19 @@ class TpuProvider:
         return result.text
 
     def stream(self, prompt: str, max_new_tokens: int, temperature: float) -> Iterator[str]:
+        if self.service is not None and hasattr(self.service, "generate_stream"):
+            yielded_any = False
+            try:
+                for piece in self.service.generate_stream(
+                    prompt, max_new_tokens=max_new_tokens, temperature=temperature
+                ):
+                    yielded_any = True
+                    yield piece
+                return
+            except Exception:  # noqa: BLE001 — contiguous engine is the escape hatch
+                # restarting after partial output would duplicate the answer
+                if yielded_any or self.engine is None:
+                    raise
         yield from self.engine.stream(
             prompt, max_new_tokens=max_new_tokens, temperature=temperature
         )

@@ -84,11 +84,19 @@ class SparseRetriever(BaseRetriever):
 class HybridRetriever(BaseRetriever):
     """Fuses any number of legs. Candidate pools are over-fetched (top_k * 2,
     min 10) before fusion so the fused head has depth, matching the
-    reference's pool-then-truncate behavior."""
+    reference's pool-then-truncate behavior.
+
+    ``web_cache`` (optional) is the reference's cached-web-results pre-hit
+    (/root/reference/src/core/retrievers/hybrid.py:96-107,146-182): a
+    secondary collection consulted alongside the legs whose hits are
+    PREPENDED to the dense leg before fusion, so previously fetched web
+    results outrank fresh corpus hits at equal rank. A failing cache leg
+    degrades silently, like every other leg."""
 
     retrievers: Sequence[BaseRetriever] = ()
     config: RetrievalConfig = field(default_factory=RetrievalConfig)
     scorers: Sequence[ScorerPlugin] = ()
+    web_cache: Optional[BaseRetriever] = None
     name: str = "hybrid"
 
     def _weights(self) -> list[float]:
@@ -100,17 +108,35 @@ class HybridRetriever(BaseRetriever):
 
     async def aretrieve(self, query: str, top_k: int = 10) -> list[Document]:
         pool = max(top_k * 2, 10)
-        legs = await asyncio.gather(
-            *[r.aretrieve(query, pool) for r in self.retrievers],
-            return_exceptions=True,
-        )
+        fetchers = [r.aretrieve(query, pool) for r in self.retrievers]
+        if self.web_cache is not None:
+            fetchers.append(self.web_cache.aretrieve(query, pool))
+        legs = await asyncio.gather(*fetchers, return_exceptions=True)
+        cache_hits: list[Document] = []
+        if self.web_cache is not None:
+            cache_leg = legs[-1]
+            legs = legs[:-1]
+            if not isinstance(cache_leg, Exception):
+                cache_hits = list(cache_leg)
         ok_lists: list[list[Document]] = []
         ok_weights: list[float] = []
-        for leg, weight in zip(legs, self._weights()):
+        ok_names: list[str] = []
+        for retriever, leg, weight in zip(self.retrievers, legs, self._weights()):
             if isinstance(leg, Exception):
                 continue  # degraded: a failed leg drops out, fusion continues
             ok_lists.append(leg)
             ok_weights.append(weight)
+            ok_names.append(getattr(retriever, "name", ""))
+        if cache_hits:
+            # prepend to the dense leg (ref hybrid.py:213 `all_dense_hits =
+            # dense_cache_hits + dense_hits`), deduped by id, cache first
+            if "dense" in ok_names:
+                j = ok_names.index("dense")
+                seen = {d.id for d in cache_hits}
+                ok_lists[j] = cache_hits + [d for d in ok_lists[j] if d.id not in seen]
+            else:  # no dense leg survived: the cache rides as its own leg
+                ok_lists.append(cache_hits)
+                ok_weights.append(self.config.dense_weight)
         if not ok_lists:
             raise RetrieverError("all retrieval legs failed")
         fused = fuse(
@@ -153,9 +179,11 @@ def create_retriever(
     dense_index: Optional[TpuDenseIndex] = None,
     bm25_index: Optional[BM25Index] = None,
     scorers: Optional[Sequence[ScorerPlugin]] = None,
+    web_cache_index: Optional[TpuDenseIndex] = None,
 ) -> BaseRetriever:
     """Strategy registry (reference: retrievers/factory.py:21-196): ``dense``,
-    ``bm25``, or ``hybrid`` from config; hybrid tolerates a missing leg."""
+    ``bm25``, or ``hybrid`` from config; hybrid tolerates a missing leg and
+    consults the optional cached-web-results index before fusing."""
     settings = settings or get_settings()
     strategy = settings.retrieval.strategy
     dense = DenseRetriever(embedder, dense_index) if embedder is not None and dense_index is not None else None
@@ -173,9 +201,13 @@ def create_retriever(
         legs = [r for r in (dense, sparse) if r is not None]
         if not legs:
             raise RetrieverError("hybrid strategy needs at least one leg")
+        web_cache = None
+        if web_cache_index is not None and embedder is not None:
+            web_cache = DenseRetriever(embedder, web_cache_index, name="web_cache")
         return HybridRetriever(
             retrievers=legs,
             config=settings.retrieval,
             scorers=scorers or (),
+            web_cache=web_cache,
         )
     raise RetrieverError(f"unknown retrieval strategy {strategy!r}")

@@ -335,10 +335,12 @@ class GeneratorEngine:
                 break
             emitted.append(t)
             text = self.tokenizer.decode(emitted)
-            # only flush complete (replacement-char-free) tails
-            if not text.endswith("�") and len(text) > len(flushed):
-                yield text[len(flushed):]
-                flushed = text
+            # withhold at most the final char: a trailing '�' may be an
+            # incomplete UTF-8 sequence the next token resolves
+            safe = text[:-1] if text.endswith("�") else text
+            if len(safe) > len(flushed):
+                yield safe[len(flushed):]
+                flushed = safe
             tok, cache, self._rng = self._decode_step(
                 self.params, tok[:, None], lens, cache, self._rng,
                 jnp.asarray(temp, jnp.float32), top_k,

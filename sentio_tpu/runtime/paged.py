@@ -226,6 +226,9 @@ class _Slot:
     temperature: float = 0.0
     emitted: list[int] = field(default_factory=list)
     active: bool = False
+    # first sampled token still on device (admission defers its fetch; the
+    # next tick's packed output materializes it host-side)
+    pending_first: bool = False
 
 
 @dataclass
@@ -272,6 +275,8 @@ class ContinuousBatchingEngine:
         rng_seed: int = 0,
         use_pallas: Optional[bool] = None,
         steps_per_tick: int = 8,
+        max_tick_steps: Optional[int] = None,
+        ignore_eos: bool = False,
         mesh=None,
     ) -> None:
         import jax
@@ -292,6 +297,14 @@ class ContinuousBatchingEngine:
         # devices, and real overhead even locally) amortize over the chunk.
         # Admission latency grows by at most steps_per_tick decode steps.
         self.steps_per_tick = max(int(steps_per_tick), 1)
+        # with an EMPTY queue nothing waits on admission, so ticks may grow
+        # to this cap (rounded to a bucket) — the whole remaining generation
+        # of the longest row can ride one dispatch + one fetch
+        self.max_tick_steps = max(int(max_tick_steps), self.steps_per_tick) \
+            if max_tick_steps is not None else self.steps_per_tick
+        # benchmark workloads: random-init weights frequently greedy-sample
+        # EOS immediately; fixed-length generation measures the real cost
+        self.ignore_eos = bool(ignore_eos)
         self.mesh = mesh
         if num_pages is None:
             num_pages = 1 + max_slots * max_pages_per_seq
@@ -300,8 +313,15 @@ class ContinuousBatchingEngine:
 
         self.slots = [_Slot() for _ in range(max_slots)]
         self.last_tick_active = 0
+        # device sub-steps actually executed (the scan runs its full static
+        # length; every sub-step streams the weights once) — throughput and
+        # HBM-utilization math must use this, not ticks x steps_per_tick
+        self.total_sub_steps = 0
         self._queue: list[_Request] = []
         self._finished_buffer: list[PagedResult] = []
+        # (first_tokens_device_array, [slot_idx, ...]) per admission chunk,
+        # consumed by the next decode tick
+        self._pending_first: list = []
         self._next_id = itertools.count()
         self._rng = jax.random.PRNGKey(rng_seed + 1)
         # host mirrors of device state, re-uploaded when admission changes them
@@ -330,6 +350,8 @@ class ContinuousBatchingEngine:
         attn_impl = self._attn_impl
         eos_id = self.tokenizer.eos_id
 
+        ignore_eos = self.ignore_eos
+
         @partial(jax.jit, static_argnames=("steps",), donate_argnums=(4, 5))
         def step_n(params, tok, lens, page_table, k_pages, v_pages, rng, temps,
                    budgets, steps):
@@ -338,7 +360,11 @@ class ContinuousBatchingEngine:
             Per-row ``budgets`` bound how far each row may advance (token
             budget / page capacity, mirrored host-side); rows halt early on
             EOS. Frozen rows keep their lens/tok and write to scratch.
-            Returns per-step sampled tokens and execution mask [steps, B].
+            Returns per-step sampled tokens [steps, B] — the ONLY array the
+            host fetches per tick. The execution mask is not returned: the
+            host replay reconstructs it exactly from its own budgets plus
+            first-EOS (fetches dominate per-tick cost on remote-attached
+            devices, ~RTT each, so one array, one fetch).
             """
             from sentio_tpu.runtime.sampling import sample_tokens
 
@@ -353,17 +379,33 @@ class ContinuousBatchingEngine:
                 nxt = sample_tokens(logits, sub, temps)
                 tok = jnp.where(active, nxt, tok)
                 lens = jnp.where(active, lens + 1, lens)
-                halted = halted | (active & (nxt == eos_id))
-                return (tok, lens, k_pages, v_pages, rng, halted), (nxt, active)
+                if not ignore_eos:
+                    halted = halted | (active & (nxt == eos_id))
+                return (tok, lens, k_pages, v_pages, rng, halted), nxt
 
-            b = tok.shape[0]
-            init = (tok, lens, k_pages, v_pages, rng, jnp.zeros(b, bool))
-            (tok, lens, k_pages, v_pages, rng, _), (toks, mask) = jax.lax.scan(
+            tok_in = tok
+            # rows whose (deferred) first token is already EOS never run
+            halted0 = (tok == eos_id) if not ignore_eos else jnp.zeros_like(tok, bool)
+            init = (tok, lens, k_pages, v_pages, rng, halted0)
+            (tok, lens, k_pages, v_pages, rng, _), toks = jax.lax.scan(
                 body, init, jnp.arange(steps)
             )
-            return toks, mask, k_pages, v_pages, rng
+            # packed [1 + steps, B]: row 0 echoes the INPUT tokens so freshly
+            # admitted rows' device-resident first tokens reach the host in
+            # the same single fetch as the tick outputs
+            return jnp.concatenate([tok_in[None, :], toks], axis=0), \
+                k_pages, v_pages, rng
 
         self._step_n = step_n
+
+        @jax.jit
+        def merge_first(tok, first, idxs):
+            """Scatter admission's device-resident first tokens into the
+            tick's token input. ``idxs`` pads to ``first``'s length with an
+            out-of-range index; mode='drop' discards the pad rows."""
+            return tok.at[idxs].set(first, mode="drop")
+
+        self._merge_first = merge_first
 
         @partial(jax.jit, donate_argnums=(7, 8))
         def prefill_scatter(params, ids, positions, lens, rng, temps, scat,
@@ -413,6 +455,7 @@ class ContinuousBatchingEngine:
         self.slots = [_Slot() for _ in range(self.max_slots)]
         self._queue.clear()
         self._finished_buffer.clear()
+        self._pending_first.clear()
         self._page_table[:] = 0
         self._lens[:] = 0
         self._temps[:] = 0.0
@@ -435,9 +478,9 @@ class ContinuousBatchingEngine:
         return [done[i] for i in ids]
 
     def step(self) -> list[PagedResult]:
-        """One engine tick: admit waiting requests, one fused multi-step
-        decode dispatch, retire finished slots. Returns results completed
-        this tick."""
+        """One engine tick: admit waiting requests (prefill dispatches, no
+        fetch), one fused multi-step decode dispatch, ONE host fetch, retire
+        finished slots. Returns results completed this tick."""
         self.last_tick_active = 0
         self._admit()
         out, self._finished_buffer = self._finished_buffer, []
@@ -507,7 +550,10 @@ class ContinuousBatchingEngine:
 
         # batched admission: rows group by prefill-width bucket, each group
         # splits into batch-bucket chunks → admitting N same-width requests
-        # costs ceil(N / max_batch_bucket) prefill dispatches, not N
+        # costs ceil(N / max_batch_bucket) prefill dispatches, not N. The
+        # sampled first tokens STAY ON DEVICE (slot.pending_first): the next
+        # tick merges them into its token input and its single packed fetch
+        # carries them back — admission adds zero host round trips.
         groups: dict[int, list[tuple[int, _Request, list[int]]]] = {}
         for item in batch:
             groups.setdefault(self._prefill_width(len(item[2])), []).append(item)
@@ -515,10 +561,6 @@ class ContinuousBatchingEngine:
         for width, members in sorted(groups.items()):
             for start in range(0, len(members), max_rows):
                 self._prefill_chunk(width, members[start : start + max_rows])
-
-        # freshly admitted rows already have token 0 sampled; emit it now so
-        # EOS-as-first-token retires before wasting a decode tick
-        self._finished_buffer.extend(self._post_sample({i for i, _, _ in batch}))
 
     def _prefill_chunk(
         self, width: int, chunk: list[tuple[int, _Request, list[int]]]
@@ -542,79 +584,117 @@ class ContinuousBatchingEngine:
         positions = np.broadcast_to(
             np.arange(width, dtype=np.int32)[None, :], (rows, width)
         ).copy()
+        # args stay host numpy: a jit call ships them asynchronously, while
+        # an explicit jnp.asarray is a SYNCHRONOUS upload (~RTT each on
+        # remote-attached devices)
         first, self.pool.k, self.pool.v, self._rng = self._prefill_scatter(
-            self.params, jnp.asarray(ids), jnp.asarray(positions),
-            jnp.asarray(lens), self._rng, jnp.asarray(temps), jnp.asarray(scat),
+            self.params, ids, positions, lens, self._rng, temps, scat,
             self.pool.k, self.pool.v,
         )
-        first = np.asarray(first)
-        for r, (slot_idx, _req, _ids) in enumerate(chunk):
-            self._last_tok[slot_idx] = int(first[r])
+        slot_idxs = [slot_idx for slot_idx, _req, _ids in chunk]
+        for slot_idx in slot_idxs:
+            self.slots[slot_idx].pending_first = True
+        self._pending_first.append((first, slot_idxs))
 
     def _decode_tick(self) -> list[PagedResult]:
         import jax.numpy as jnp
 
-        steps = self.steps_per_tick
-        budgets = np.zeros(self.max_slots, np.int32)
+        pending, self._pending_first = self._pending_first, []
+        remaining = np.zeros(self.max_slots, np.int32)
         finished: list[PagedResult] = []
         for i, slot in enumerate(self.slots):
             if not slot.active:
                 continue
             capacity = len(slot.pages) * self.page_size
-            budgets[i] = max(
-                min(slot.max_new - len(slot.emitted), capacity - 1 - slot.length, steps),
-                0,
+            # a pending (still-on-device) first token counts against the
+            # budget exactly as if it had been folded at admission time
+            base_emit = len(slot.emitted) + (1 if slot.pending_first else 0)
+            remaining[i] = max(
+                min(slot.max_new - base_emit, capacity - 1 - slot.length), 0
             )
-            if budgets[i] == 0:  # defensive: a zero-budget row can't progress
+            if remaining[i] == 0 and not slot.pending_first:
+                # defensive: a zero-budget row with nothing in flight can't
+                # progress (pending rows fold their first token below)
                 finished.append(self._retire(i, "length"))
+        # adaptive tick size, TWO compiled variants only: queued requests cap
+        # the tick (admission waits at most steps_per_tick sub-steps); an
+        # empty queue runs the big tick so long generations cost few fetches.
+        # Over-long ticks waste masked sub-steps, which cost far less than an
+        # extra host round trip.
+        steps = self.steps_per_tick if self._queue else self.max_tick_steps
+        budgets = np.minimum(remaining, steps).astype(np.int32)
         # rows sharing THIS fused dispatch — the honest occupancy number
         # (post-tick slot counts miss requests that retire inside the tick)
-        self.last_tick_active = int((budgets > 0).sum())
+        self.last_tick_active = int(
+            ((budgets > 0) | [s.active and s.pending_first for s in self.slots]).sum()
+        )
         if not budgets.any():
+            # nothing can decode, but deferred first tokens may still need
+            # folding (e.g. every admitted request wants max_new == 1)
+            for first_dev, slot_idxs in pending:
+                vals = np.asarray(first_dev)
+                for r, i in enumerate(slot_idxs):
+                    if not self.slots[i].active:
+                        continue
+                    self.slots[i].pending_first = False
+                    self._last_tok[i] = int(vals[r])
+                    result = self._fold_and_maybe_retire(i)
+                    if result is not None:
+                        finished.append(result)
             return finished
 
-        toks, mask, self.pool.k, self.pool.v, self._rng = self._step_n(
+        # token input rides ON DEVICE: host mirror for established rows,
+        # admission's device-resident first tokens scattered in via the
+        # jitted merge (jit dispatches are async; eager index-update ops and
+        # explicit jnp.asarray uploads each block ~RTT on remote devices)
+        # mirrors are snapshotted (.copy()): the host replay below mutates
+        # them while the async transfer may still be in flight
+        tok_in = self._last_tok.copy()
+        for first_dev, slot_idxs in pending:
+            idxs = np.full(first_dev.shape[0], self.max_slots, np.int32)
+            idxs[: len(slot_idxs)] = slot_idxs
+            tok_in = self._merge_first(tok_in, first_dev, idxs)
+
+        packed, self.pool.k, self.pool.v, self._rng = self._step_n(
             self.params,
-            jnp.asarray(self._last_tok),
-            jnp.asarray(self._lens),
-            jnp.asarray(self._page_table),
+            tok_in,
+            self._lens.copy(),
+            self._page_table.copy(),
             self.pool.k,
             self.pool.v,
             self._rng,
-            jnp.asarray(self._temps),
-            jnp.asarray(budgets),
+            self._temps.copy(),
+            budgets,
             steps=steps,
         )
-        toks = np.asarray(toks)  # [steps, B]
-        mask = np.asarray(mask)
+        self.total_sub_steps += steps
+        # [1 + steps, B] — the ONE host fetch per engine tick
+        packed = np.asarray(packed)
 
         # host replay of the device scan: each executed sub-step is exactly
-        # one old-style tick — write counted, token folded, retirement checked
+        # one old-style tick — write counted, token folded, retirement
+        # checked. Execution mask reconstruction: a row runs until its budget
+        # (host-known) or the step after its first EOS (visible in packed) —
+        # identical to the device's halting rule (halted0 covers EOS-as-
+        # first-token for freshly admitted rows).
         for i, slot in enumerate(self.slots):
             if not slot.active:
                 continue
-            for s in range(steps):
-                if not mask[s, i]:
-                    break  # per-row mask is monotone: budget out or halted
+            if slot.pending_first:
+                slot.pending_first = False
+                self._last_tok[i] = int(packed[0, i])
+                result = self._fold_and_maybe_retire(i)
+                if result is not None:
+                    finished.append(result)
+                    continue
+            for s in range(int(budgets[i])):
                 slot.length += 1
                 self._lens[i] = slot.length
-                self._last_tok[i] = int(toks[s, i])
+                self._last_tok[i] = int(packed[1 + s, i])
                 result = self._fold_and_maybe_retire(i)
                 if result is not None:
                     finished.append(result)
                     break
-        return finished
-
-    def _post_sample(self, rows: set) -> list[PagedResult]:
-        """Fold the freshly sampled (admission-time) token of each row in
-        ``rows`` into its slot; retire rows that hit EOS or their budget."""
-        finished: list[PagedResult] = []
-        for i in sorted(rows):
-            if not self.slots[i].active:
-                continue
-            result = self._fold_and_maybe_retire(i)
-            if result is not None:
-                finished.append(result)
         return finished
 
     def _fold_and_maybe_retire(self, i: int) -> Optional[PagedResult]:
@@ -624,7 +704,7 @@ class ContinuousBatchingEngine:
         must never diverge, and the decode budgets mirror these bounds."""
         slot = self.slots[i]
         tok = int(self._last_tok[i])
-        hit_eos = tok == self.tokenizer.eos_id
+        hit_eos = tok == self.tokenizer.eos_id and not self.ignore_eos
         if not hit_eos:
             slot.emitted.append(tok)
         hit_len = len(slot.emitted) >= slot.max_new
@@ -645,6 +725,7 @@ class ContinuousBatchingEngine:
         )
         self.allocator.free(slot.pages)
         slot.active = False
+        slot.pending_first = False
         slot.pages = []
         self._page_table[i] = 0
         self._lens[i] = 0

@@ -25,9 +25,10 @@ uncontended lock before waiters wake). Submitters and the pump meet at
 from __future__ import annotations
 
 import logging
+import queue as _queue
 import threading
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Iterator, Optional
 
 from sentio_tpu.runtime.paged import ContinuousBatchingEngine, PagedResult
 
@@ -47,6 +48,10 @@ class _Ticket:
     temperature: float
     event: threading.Event = field(default_factory=threading.Event)
     result: Optional[PagedResult] = None
+    # streaming callers: the pump pushes ("toks", [ids...]) deltas after each
+    # tick and ("done", result) at retirement; None for plain generate()
+    stream_q: Optional[_queue.Queue] = None
+    sent_tokens: int = 0  # how many emitted tokens were already pushed
 
 
 class PagedGenerationService:
@@ -100,6 +105,62 @@ class PagedGenerationService:
             )
         assert ticket.result is not None
         return ticket.result
+
+    def generate_stream(
+        self,
+        prompt: str,
+        max_new_tokens: int = 64,
+        temperature: float = 0.0,
+        timeout_s: Optional[float] = None,
+    ) -> Iterator[str]:
+        """Streaming variant: yields decoded text increments as the shared
+        decode batch produces them (chunks of up to steps_per_tick tokens —
+        the streaming request STAYS in the continuous batch instead of
+        monopolizing a contiguous-cache engine). UTF-8 safe: bytes buffer
+        until they decode cleanly."""
+        ticket = _Ticket(prompt, max_new_tokens, temperature, stream_q=_queue.Queue())
+        with self._mutex:
+            if self._closed:
+                raise RuntimeError("generation service is closed")
+            if self._broken:
+                raise RuntimeError("paged decode engine is down (reset failed)")
+            self._inbox.append(ticket)
+            self._ensure_pump()
+
+        tokenizer = self.engine.tokenizer
+        deadline = timeout_s or self.default_timeout_s
+        emitted: list[int] = []
+        flushed = ""
+        while True:
+            try:
+                kind, payload = ticket.stream_q.get(timeout=deadline)
+            except _queue.Empty:
+                raise GenerationTimeout(
+                    f"stream produced nothing for {deadline:.0f}s"
+                ) from None
+            if kind == "toks":
+                emitted.extend(payload)
+            else:  # "done"
+                result: PagedResult = payload
+                if result.finish_reason == "error":
+                    raise RuntimeError("paged decode failed mid-stream")
+                emitted = list(result.tokens)  # authoritative final sequence
+            text = tokenizer.decode(emitted)
+            if kind == "done":
+                # final flush is unconditional: the finished answer may
+                # genuinely end in a replacement char
+                if len(text) > len(flushed):
+                    yield text[len(flushed):]
+                return
+            # mid-stream: withhold AT MOST the final char — a trailing '�'
+            # may be an incomplete UTF-8 sequence that the next token
+            # resolves (a genuine replacement char flushes next round;
+            # holding the whole tail would stall streams whose chunks keep
+            # ending in replacement chars)
+            safe = text[:-1] if text.endswith("�") else text
+            if len(safe) > len(flushed):
+                yield safe[len(flushed):]
+                flushed = safe
 
     def close(self) -> None:
         with self._mutex:
@@ -187,11 +248,27 @@ class PagedGenerationService:
                 self._ticks += 1
                 self._active_sum += active
                 self._max_active = max(self._max_active, active)
+                # push newly emitted tokens to streaming tickets still in
+                # flight (the engine's slot.emitted grows by up to
+                # steps_per_tick per tick)
+                for slot in self.engine.slots:
+                    if not slot.active:
+                        continue
+                    ticket = self._tickets.get(slot.request_id)
+                    if ticket is None or ticket.stream_q is None:
+                        continue
+                    if len(slot.emitted) > ticket.sent_tokens:
+                        ticket.stream_q.put(
+                            ("toks", list(slot.emitted[ticket.sent_tokens:]))
+                        )
+                        ticket.sent_tokens = len(slot.emitted)
                 for result in finished:
                     self._completed += 1
                     ticket = self._tickets.pop(result.request_id, None)
                     if ticket is not None:
                         ticket.result = result
+                        if ticket.stream_q is not None:
+                            ticket.stream_q.put(("done", result))
                         ticket.event.set()
 
     def _fail_all_locked(self, reason: str) -> None:  # _mutex held
@@ -202,6 +279,8 @@ class PagedGenerationService:
                     request_id=-1, text="", tokens=[],
                     prompt_tokens=0, finish_reason="error",
                 )
+                if ticket.stream_q is not None:
+                    ticket.stream_q.put(("done", ticket.result))
                 ticket.event.set()
         self._tickets.clear()
         self._inbox.clear()

@@ -43,8 +43,13 @@ body{font-family:system-ui,sans-serif;max-width:780px;margin:2rem auto;padding:0
 #log{border:1px solid #ccc;border-radius:8px;padding:1rem;min-height:200px;white-space:pre-wrap}
 textarea{width:100%;box-sizing:border-box}
 .src{color:#666;font-size:.85em;margin-left:1em}
+#health{float:right;font-size:.9em}
+#dot{display:inline-block;width:.7em;height:.7em;border-radius:50%;background:#999}
+#upl{color:#666;font-size:.85em}
 </style></head><body>
-<h2>sentio-tpu</h2>
+<h2>sentio-tpu <span id="health"><span id="dot"></span> <span id="hstat">checking…</span></span></h2>
+<p><input type="file" id="file" accept=".txt,.md,.rst,.json,.csv" multiple>
+<button onclick="upload()">Ingest</button> <span id="upl"></span></p>
 <div id="log"></div>
 <p><textarea id="q" rows="3" placeholder="Ask a question..."></textarea>
 <button onclick="send()">Send</button></p>
@@ -59,6 +64,47 @@ async function send(){
   log.textContent+=(d.answer||JSON.stringify(d))+'\\n';
   (d.sources||[]).forEach((s,i)=>{log.textContent+='  ['+(i+1)+'] '+(s.metadata.source||s.id)+'\\n'});
 }
+// client-side chunking + per-chunk /embed, like the reference UI's upload
+function chunks(text,size=1500,overlap=200){
+  const out=[]; for(let i=0;i<text.length;i+=size-overlap){out.push(text.slice(i,i+size));
+    if(i+size>=text.length)break;} return out;
+}
+async function upload(){
+  const files=document.getElementById('file').files, st=document.getElementById('upl');
+  if(!files.length){st.textContent='pick a file first';return}
+  let done=0,total=0;
+  for(const f of files){
+    const text=await f.text(); const parts=chunks(text); total+=parts.length;
+    for(let i=0;i<parts.length;i++){
+      // the server rate-limits /embed per IP: back off on 429 and retry
+      // the SAME chunk instead of silently dropping the document tail
+      for(let tries=0;tries<20;tries++){
+        const r=await fetch('/embed',{method:'POST',headers:{'Content-Type':'application/json'},
+          body:JSON.stringify({content:parts[i],metadata:{source:f.name,chunk:i}})});
+        if(r.ok){done++;break}
+        if(r.status===429){
+          const wait=parseInt(r.headers.get('Retry-After')||'6',10);
+          st.textContent='rate limited; waiting '+wait+'s ('+done+'/'+total+')…';
+          await new Promise(res=>setTimeout(res,wait*1000));
+          continue;
+        }
+        break; // non-retryable error: count as failed, move on
+      }
+      st.textContent='ingesting '+done+'/'+total+' chunks…';
+    }
+  }
+  st.textContent='ingested '+done+'/'+total+' chunks';
+}
+// health badge, polled like the reference sidebar's backend check
+async function health(){
+  const dot=document.getElementById('dot'), hs=document.getElementById('hstat');
+  try{
+    const d=await (await fetch('/health')).json();
+    dot.style.background=d.status==='healthy'?'#2a2':'#d92';
+    hs.textContent=d.status+' · '+Math.round(d.uptime_s)+'s';
+  }catch(e){dot.style.background='#d22';hs.textContent='unreachable'}
+}
+health(); setInterval(health, 15000);
 </script></body></html>"""
 
 
@@ -260,14 +306,17 @@ async def _chat_stream(request: web.Request, container: DependencyContainer, req
         return False
 
     def produce() -> None:
-        # pipeline + degradation live in the handler, mirroring /chat
-        for piece in container.chat_handler.stream_chat_sync(
+        # pipeline + degradation live in the handler, mirroring /chat; the
+        # handler yields typed events — ("sources", [...]) before the first
+        # token, ("token", str) increments, ("verdict", {...}) after the
+        # stream (full graph-stage parity: select + verify ride the stream)
+        for kind, payload in container.chat_handler.stream_chat_sync(
             question=req.question,
             top_k=req.top_k,
             temperature=req.temperature,
             mode=req.mode,
         ):
-            if not put(("token", piece)):
+            if not put((kind, payload)):
                 return
         put(("done", ""))
 
@@ -275,11 +324,10 @@ async def _chat_stream(request: web.Request, container: DependencyContainer, req
     try:
         while True:
             kind, payload = await queue.get()
-            if kind == "token":
-                await response.write(f"data: {json.dumps({'token': payload})}\n\n".encode())
-            else:
+            if kind == "done":
                 await response.write(b"data: [DONE]\n\n")
                 break
+            await response.write(f"data: {json.dumps({kind: payload})}\n\n".encode())
     finally:
         stop.set()
         # drain so a producer blocked mid-put resolves, then join it

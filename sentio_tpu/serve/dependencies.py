@@ -134,6 +134,27 @@ class DependencyContainer:
         return self._get("sparse_index", build)
 
     @property
+    def web_cache_index(self):
+        """Persisted cached-web-results collection, consulted by the hybrid
+        retriever before fusion (reference's `web_cache` Qdrant collection,
+        hybrid.py:96-107 there). None unless a persisted index exists."""
+
+        def build():
+            from pathlib import Path
+
+            from sentio_tpu.ops.dense_index import TpuDenseIndex
+
+            path = self.settings.retrieval.web_cache_path
+            if not path or not Path(path).with_suffix(".json").exists():
+                return None
+            logger.info("loading web-cache index from %s", path)
+            return TpuDenseIndex.load(
+                path, mesh=self.mesh, dtype=self.settings.generator.dtype
+            )
+
+        return self._get("web_cache_index", build)
+
+    @property
     def retriever(self):
         def build():
             from sentio_tpu.ops.retrievers import create_retriever
@@ -143,6 +164,7 @@ class DependencyContainer:
                 embedder=self.embedder,
                 dense_index=self.dense_index,
                 bm25_index=self.sparse_index,
+                web_cache_index=self.web_cache_index,
             )
 
         return self._get("retriever", build)
@@ -196,6 +218,7 @@ class DependencyContainer:
                 page_size=cfg.kv_page_size,
                 max_pages_per_seq=cfg.kv_max_pages_per_seq,
                 steps_per_tick=cfg.decode_steps_per_tick,
+                max_tick_steps=cfg.decode_max_tick_steps,
                 mesh=self.mesh,  # pool kv-heads shard over tp with the weights
             )
             return PagedGenerationService(paged)

@@ -137,10 +137,12 @@ class ChatHandler:
         temperature: Optional[float] = None,
         mode: str = "balanced",
     ):
-        """Token-stream generator for SSE: retrieve → rerank → stream decode.
-        The pipeline wiring lives HERE (next to the non-streaming path) so
-        the two can't drift; failures degrade to the fallback text instead
-        of surfacing raw errors to the stream (reference's ladder contract)."""
+        """Typed-event generator for SSE, with FULL graph-stage parity
+        (reference factory.py:191-208 — streaming traverses the same graph):
+        retrieve → rerank → select (dedup + token budget) → stream decode →
+        verify. Yields ("sources", [...]) once, ("token", str) per increment,
+        and ("verdict", {...}) after the stream when the verifier is on.
+        Failures degrade to the ladder text instead of raw errors."""
         try:
             docs = self.container.retriever.retrieve(
                 question, top_k=top_k or self.settings.retrieval.top_k
@@ -150,13 +152,30 @@ class ChatHandler:
                 docs = reranker.rerank(
                     question, docs, top_k=self.settings.rerank.top_k
                 ).documents
-            yield from self.container.generator.stream(
-                question, docs, mode=mode, temperature=temperature
+            from sentio_tpu.graph.nodes import select_documents
+
+            selected, _used = select_documents(
+                list(docs), self.settings.generator.context_token_budget
             )
+            yield ("sources", [
+                {"id": d.id, "source": d.metadata.get("source", d.id),
+                 "score": d.score()} for d in selected
+            ])
+            chunks: list[str] = []
+            for piece in self.container.generator.stream(
+                question, selected, mode=mode, temperature=temperature
+            ):
+                chunks.append(piece)
+                yield ("token", piece)
+            verifier = self.container.verifier
+            answer = "".join(chunks)
+            if verifier is not None and answer:
+                result = verifier.verify(question, answer, selected)
+                yield ("verdict", result.to_dict())
         except Exception as exc:  # noqa: BLE001 — ladder, never a raw error
             logger.warning("stream pipeline failed (%s); degrading", exc)
             result = self._degraded_response(question, "stream", str(exc), time.perf_counter())
-            yield result["answer"]
+            yield ("token", result["answer"])
 
     # ---------------------------------------------------------------- async
 

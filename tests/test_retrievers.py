@@ -291,3 +291,78 @@ def test_semantic_and_mmr_share_one_embed(docs):
     sem.score("shared query", docs)
     mmr.score("shared query", docs)
     assert calls == [len(docs) + 1]  # second scorer reused the memoized batch
+
+
+class TestWebCachePreHit:
+    """Reference hybrid.py:96-107,146-182: a cached web-results collection is
+    consulted before fusing, its hits prepended to the dense leg."""
+
+    def _stack(self, docs):
+        from sentio_tpu.config import EmbedderConfig, RetrievalConfig
+        from sentio_tpu.models.document import Document
+        from sentio_tpu.ops.bm25 import BM25Index
+        from sentio_tpu.ops.dense_index import TpuDenseIndex
+        from sentio_tpu.ops.embedder import get_embedder
+        from sentio_tpu.ops.retrievers import (
+            DenseRetriever, HybridRetriever, SparseRetriever,
+        )
+
+        embedder = get_embedder(EmbedderConfig(provider="hash", dim=32))
+        index = TpuDenseIndex(dim=32)
+        index.add(docs, embedder.embed_many([d.text for d in docs]))
+        cache_doc = Document(
+            text="cached web result about the quick brown fox jumping",
+            id="web-1", metadata={"source": "web"},
+        )
+        cache_index = TpuDenseIndex(dim=32)
+        cache_index.add([cache_doc], embedder.embed_many([cache_doc.text]))
+        hybrid = HybridRetriever(
+            retrievers=[
+                DenseRetriever(embedder, index),
+                SparseRetriever(BM25Index().build(docs)),
+            ],
+            config=RetrievalConfig(),
+            web_cache=DenseRetriever(embedder, cache_index, name="web_cache"),
+        )
+        return hybrid
+
+    def test_cache_hits_outrank_fresh_dense(self, docs):
+        hybrid = self._stack(docs)
+        out = hybrid.retrieve("quick brown fox", top_k=5)
+        assert any(d.id == "web-1" for d in out), "cache hit must surface"
+        # without the cache leg the web doc cannot appear at all — the
+        # pre-hit is what injects it at dense rank 0 (docs both legs agree
+        # on may still outrank it, same as the reference's fusion)
+        hybrid.web_cache = None
+        out_plain = hybrid.retrieve("quick brown fox", top_k=5)
+        assert not any(d.id == "web-1" for d in out_plain)
+
+    def test_cache_leg_failure_degrades(self, docs):
+        class Boom:
+            name = "web_cache"
+
+            async def aretrieve(self, q, top_k=10):
+                raise RuntimeError("cache store down")
+
+        hybrid = self._stack(docs)
+        hybrid.web_cache = Boom()
+        out = hybrid.retrieve("quick brown fox", top_k=5)
+        assert out, "hybrid must keep serving when the cache leg dies"
+
+    def test_factory_wires_web_cache_index(self, settings, docs):
+        from sentio_tpu.config import EmbedderConfig
+        from sentio_tpu.ops.bm25 import BM25Index
+        from sentio_tpu.ops.dense_index import TpuDenseIndex
+        from sentio_tpu.ops.embedder import get_embedder
+        from sentio_tpu.ops.retrievers import create_retriever
+
+        embedder = get_embedder(EmbedderConfig(provider="hash", dim=32))
+        index = TpuDenseIndex(dim=32)
+        index.add(docs, embedder.embed_many([d.text for d in docs]))
+        cache_index = TpuDenseIndex(dim=32)
+        retriever = create_retriever(
+            settings=settings, embedder=embedder, dense_index=index,
+            bm25_index=BM25Index().build(docs), web_cache_index=cache_index,
+        )
+        assert retriever.web_cache is not None
+        assert retriever.web_cache.name == "web_cache"

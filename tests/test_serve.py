@@ -226,7 +226,13 @@ class TestHealthAndInfo:
         async def body(client, container):
             resp = await client.get("/")
             assert resp.status == 200
-            assert "sentio-tpu" in await resp.text()
+            page = await resp.text()
+            assert "sentio-tpu" in page
+            # upload flow + health badge (reference streamlit_app.py:27-318:
+            # client-side chunking into /embed, backend health indicator)
+            assert 'type="file"' in page and "/embed" in page
+            assert "chunks(" in page
+            assert "/health" in page and 'id="dot"' in page
 
         run(with_client(fast_settings(), body))
 
@@ -311,3 +317,94 @@ class TestPagedServing:
             assert stats["free_pages"] == stats["total_pages"] - 1
 
         run(with_client(settings, body))
+
+
+class TestStreamingParity:
+    """The SSE path must traverse the SAME graph semantics as /chat:
+    select (dedup + token budget) before streaming, verify after
+    (reference factory.py:191-208 — streaming uses identical stages)."""
+
+    def test_stream_carries_sources_tokens_and_verdict(self):
+        async def body(client, container):
+            await seed(client, ["alpha document about streaming"])
+            resp = await client.post(
+                "/chat", json={"question": "what about streaming?", "stream": True}
+            )
+            assert resp.status == 200
+            import json as _json
+
+            events = []
+            for line in (await resp.read()).decode().splitlines():
+                if line.startswith("data:"):
+                    data = line[5:].strip()
+                    if data == "[DONE]":
+                        events.append(("done", None))
+                    else:
+                        events.append(next(iter(_json.loads(data).items())))
+            kinds = [k for k, _ in events]
+            assert kinds[0] == "sources", kinds
+            assert "token" in kinds
+            assert "verdict" in kinds, "verifier must audit the streamed answer"
+            assert kinds[-1] == "done"
+            # verify comes after every token (post-stream audit)
+            assert kinds.index("verdict") > max(
+                i for i, k in enumerate(kinds) if k == "token"
+            )
+
+        settings = fast_settings()
+        settings.generator.use_verifier = True
+        run(with_client(settings, body))
+
+    def test_stream_enforces_selector_budget(self):
+        async def body(client, container):
+            # many docs, tiny budget: selection must cap what streams
+            await seed(client, [f"budget doc {i} " + "x" * 200 for i in range(8)])
+            settings = container.settings
+            settings.generator.context_token_budget = 60  # ~240 chars → 1 doc
+            resp = await client.post(
+                "/chat", json={"question": "budget doc", "top_k": 8, "stream": True}
+            )
+            import json as _json
+
+            sources = None
+            for line in (await resp.read()).decode().splitlines():
+                if line.startswith("data:") and '"sources"' in line:
+                    sources = _json.loads(line[5:].strip())["sources"]
+                    break
+            assert sources is not None, "stream must announce selected sources"
+            assert 1 <= len(sources) <= 2, (
+                f"token budget not enforced before streaming: {len(sources)} docs"
+            )
+
+        run(with_client(fast_settings(), body))
+
+
+class TestPagedStreamingService:
+    def test_generate_stream_matches_generate(self):
+        from sentio_tpu.models.llama import LlamaConfig
+        from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+        from sentio_tpu.runtime.service import PagedGenerationService
+
+        cfg = LlamaConfig.tiny()
+
+        def build():
+            return PagedGenerationService(ContinuousBatchingEngine(
+                model_config=cfg, max_slots=2, page_size=16,
+                max_pages_per_seq=8, steps_per_tick=4,
+            ))
+
+        svc_a, svc_b = build(), build()
+        try:
+            want = svc_a.generate("stream parity prompt", max_new_tokens=12,
+                                  temperature=0.0)
+            pieces = list(svc_b.generate_stream(
+                "stream parity prompt", max_new_tokens=12, temperature=0.0
+            ))
+            assert "".join(pieces) == want.text
+            # incremental: more than one chunk for a 12-token answer at
+            # steps_per_tick=4 (unless the model EOS'd in the first tick)
+            if len(want.tokens) > 4:
+                assert len(pieces) >= 2
+        finally:
+            svc_a.close()
+            svc_b.close()
