@@ -330,6 +330,63 @@ def phase_c_scale(kind: str, new_tokens: int, concurrency: int):
     return out
 
 
+def phase_d_kernels():
+    """Kernel-vs-XLA timings on the real chip: flash attention (prefill
+    shape) and the paged decode kernel (page-table walk vs gather). Each
+    timing wraps the op in jit and measures dispatch→fetch round trips, so
+    the delta isolates the kernel."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sentio_tpu.kernels.flash_attention import flash_attention
+    from sentio_tpu.kernels.paged_attention import paged_attention
+    from sentio_tpu.models.layers import attention, causal_mask
+    from sentio_tpu.runtime.paged import _paged_attn_xla
+
+    on_tpu = jax.default_backend() == "tpu"
+    rng = np.random.default_rng(0)
+
+    def timeit(fn, *args, n=8):
+        np.asarray(fn(*args))  # compile
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*args)
+        np.asarray(out)
+        return (time.perf_counter() - t0) / n * 1000.0
+
+    out = {}
+    # prefill-shaped causal attention: B4 T2048 H8 D64 bf16
+    b, t, h, d = 4, 2048, 8, 64
+    q, k, v = (jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.bfloat16)
+               for _ in range(3))
+    mask = causal_mask(t)
+    xla_fn = jax.jit(lambda q, k, v: attention(q, k, v, mask, jnp.bfloat16))
+    out["prefill_attn_xla_ms"] = round(timeit(xla_fn, q, k, v), 2)
+    if on_tpu:
+        flash_fn = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+        out["prefill_attn_flash_ms"] = round(timeit(flash_fn, q, k, v), 2)
+
+    # paged decode attention: 8 rows, 128-page pool, 16-token pages
+    bb, hh, hkv, dd, page, nb, pool = 8, 8, 4, 64, 16, 64, 513
+    qd = jnp.asarray(rng.standard_normal((bb, hh, dd)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((pool, page, hkv, dd)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((pool, page, hkv, dd)), jnp.bfloat16)
+    pt = jnp.asarray(rng.integers(1, pool, (bb, nb)), jnp.int32)
+    lens = jnp.asarray(rng.integers(64, nb * page - 1, (bb,)), jnp.int32)
+    gather_fn = jax.jit(
+        lambda q, k, v, t_, l_: _paged_attn_xla(q[:, None], k, v, t_, l_, hh // hkv)
+    )
+    out["paged_attn_xla_gather_ms"] = round(timeit(gather_fn, qd, kp, vp, pt, lens), 2)
+    if on_tpu:
+        out["paged_attn_pallas_ms"] = round(
+            timeit(lambda q, k, v, t_, l_: paged_attention(q, k, v, t_, l_),
+                   qd, kp, vp, pt, lens), 2,
+        )
+    log(f"phase D kernels: {out}")
+    return out
+
+
 def main() -> None:
     t_start = time.perf_counter()
     fast = os.environ.get("BENCH_FAST") == "1"
@@ -389,6 +446,7 @@ def main() -> None:
         rtt_ms=float(os.environ.get("BENCH_BASELINE_RTT_MS", "40")),
     )
     scale = None if skip_scale else phase_c_scale(serve_scale, scale_tokens, 8)
+    kernels = None if fast else phase_d_kernels()
 
     total_s = time.perf_counter() - t_start
     log(f"bench wall {total_s:.0f}s")
@@ -405,6 +463,7 @@ def main() -> None:
         "baseline": baseline,
         **({"baseline_wan": baseline_wan} if baseline_wan else {}),
         **({"serve_scale": scale} if scale else {}),
+        **({"kernels": kernels} if kernels else {}),
         "wall_s": round(total_s, 1),
     }
     print(json.dumps(payload))

@@ -40,18 +40,24 @@ NEG_INF = float(np.finfo(np.float32).min)
 __all__ = ["ring_attention", "ring_attention_sharded"]
 
 
-def _chunk_attend(q, k, v, q_offset, k_offset, causal: bool, sm_scale: float):
+def _chunk_attend(q, k, v, q_offset, k_offset, causal: bool, sm_scale: float,
+                  kv_lens=None):
     """Scores of local q [B,T,H,D] against one k/v chunk, with the global
-    causal mask derived from the two chunk offsets. Returns the raw masked
-    score matrix [B,H,T,S] in fp32; the online-softmax recurrence over
-    chunks lives in the caller's ring step."""
+    causal mask derived from the two chunk offsets. ``kv_lens`` [B] masks
+    keys at global positions >= the row's true length (right-padded
+    batches). Returns the raw masked score matrix [B,H,T,S] in fp32; the
+    online-softmax recurrence over chunks lives in the caller's ring step."""
     s = jnp.einsum("bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32)
     s = s * sm_scale
+    sk = k.shape[1]
+    k_pos = k_offset + jnp.arange(sk)[None, :]
     if causal:
-        t, sk = q.shape[1], k.shape[1]
+        t = q.shape[1]
         q_pos = q_offset + jnp.arange(t)[:, None]
-        k_pos = k_offset + jnp.arange(sk)[None, :]
         s = jnp.where((k_pos <= q_pos)[None, None, :, :], s, NEG_INF)
+    if kv_lens is not None:
+        valid = k_pos[0][None, :] < kv_lens[:, None]  # [B, S]
+        s = jnp.where(valid[:, None, None, :], s, NEG_INF)
     return s
 
 
@@ -59,13 +65,15 @@ def ring_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
+    kv_lens: Optional[jax.Array] = None,
     *,
     axis_name: str = AXIS_SP,
     causal: bool = True,
     sm_scale: Optional[float] = None,
 ) -> jax.Array:
     """Inside shard_map: q/k/v are the LOCAL sequence shards [B, T_loc, H, D]
-    (kv heads already expanded to H). Returns the local output shard."""
+    (kv heads already expanded to H); ``kv_lens`` [B] (replicated) masks
+    right-padding by GLOBAL key position. Returns the local output shard."""
     b, t_loc, h, d = q.shape
     sp = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
@@ -84,7 +92,7 @@ def ring_attention(
         s = _chunk_attend(
             q32, k_chunk.astype(jnp.float32), v_chunk.astype(jnp.float32),
             q_offset=my_idx * t_loc, k_offset=src_idx * t_loc,
-            causal=causal, sm_scale=scale,
+            causal=causal, sm_scale=scale, kv_lens=kv_lens,
         )
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, m_cur)

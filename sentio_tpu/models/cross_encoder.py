@@ -33,14 +33,17 @@ def cross_encoder_scores(
     ids: Array,
     mask: Array,
     type_ids: Array,
+    attn_fn=None,
 ) -> Array:
     """[B, T] pair encodings → [B] float32 relevance scores (unbounded;
     consumers sigmoid or rank directly — ranking only needs order).
 
     An optional ``pooler`` stage (dense + tanh over [CLS], present when
     converting RoBERTa/bge-class classification heads — models/convert.py)
-    runs between pooling and the scalar head."""
-    hidden = encoder_forward(params["encoder"], cfg, ids, mask, type_ids)
+    runs between pooling and the scalar head. ``attn_fn``: bidirectional
+    flash kernel (see sentio_tpu.kernels), XLA attention when None."""
+    hidden = encoder_forward(params["encoder"], cfg, ids, mask, type_ids,
+                             attn_fn=attn_fn)
     pooled = cls_pool(hidden)
     if "pooler" in params:
         pooled = jnp.tanh(L.dense(params["pooler"], pooled, jnp.float32))

@@ -87,8 +87,12 @@ def encoder_forward(
     ids: Array,
     mask: Array,
     type_ids: Optional[Array] = None,
+    attn_fn=None,
 ) -> Array:
-    """ids/mask: [B, T] (mask True = real token). Returns hidden [B, T, D]."""
+    """ids/mask: [B, T] (mask True = real token). Returns hidden [B, T, D].
+    ``attn_fn`` (see sentio_tpu.kernels.encoder_attn_fn): bidirectional
+    flash kernel taking (q, k, v, kv_lens); right-padded masks reduce to
+    per-row lengths, so kernels see lengths instead of a [B,T] mask."""
     dt = cfg.jdtype
     b, t = ids.shape
     positions = jnp.arange(t)[None, :]
@@ -101,13 +105,15 @@ def encoder_forward(
     x = L.layernorm(params["embed_norm"], x)
 
     attn_mask = (mask[:, None, None, :]).astype(bool)  # [B,1,1,T] keys masked
+    kv_lens = mask.astype(jnp.int32).sum(axis=1) if attn_fn is not None else None
     for i in range(cfg.n_layers):
         lp = params[f"layers_{i}"]
-        x = _block(lp, cfg, x, attn_mask)
+        x = _block(lp, cfg, x, attn_mask, attn_fn, kv_lens)
     return x
 
 
-def _block(lp: dict, cfg: EncoderConfig, x: Array, attn_mask: Array) -> Array:
+def _block(lp: dict, cfg: EncoderConfig, x: Array, attn_mask: Array,
+           attn_fn=None, kv_lens: Optional[Array] = None) -> Array:
     dt = cfg.jdtype
     b, t, d = x.shape
     h, hd = cfg.n_heads, cfg.head_dim
@@ -115,7 +121,10 @@ def _block(lp: dict, cfg: EncoderConfig, x: Array, attn_mask: Array) -> Array:
     q = L.dense(lp["attn"]["wq"], x, dt).reshape(b, t, h, hd)
     k = L.dense(lp["attn"]["wk"], x, dt).reshape(b, t, h, hd)
     v = L.dense(lp["attn"]["wv"], x, dt).reshape(b, t, h, hd)
-    attn_out = L.attention(q, k, v, attn_mask, dt).reshape(b, t, d)
+    if attn_fn is not None:
+        attn_out = attn_fn(q, k, v, kv_lens).reshape(b, t, d)
+    else:
+        attn_out = L.attention(q, k, v, attn_mask, dt).reshape(b, t, d)
     x = L.layernorm(lp["attn_norm"], x + L.dense(lp["attn"]["wo"], attn_out, dt))
 
     mlp = L.dense(lp["mlp"]["w_out"], jax.nn.gelu(L.dense(lp["mlp"]["w_in"], x, dt)), dt)

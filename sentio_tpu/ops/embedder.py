@@ -231,9 +231,16 @@ class TpuEmbedder(BaseEmbedder):
             self.params = shard_params(params, mesh, ENCODER_TP_RULES)
 
         cfg = self.model_config
+        # bidirectional flash kernel for the encoder pass — policy lives in
+        # kernels.select_encoder_attn_fn (shared with the cross-encoder)
+        from sentio_tpu.kernels import select_encoder_attn_fn
+
+        attn_fn = select_encoder_attn_fn(mesh, cfg.n_heads)
 
         def fwd(p, ids, mask):
-            return mean_pool(encoder_forward(p, cfg, ids, mask), mask)
+            return mean_pool(
+                encoder_forward(p, cfg, ids, mask, attn_fn=attn_fn), mask
+            )
 
         self._fwd = jax.jit(fwd)
 

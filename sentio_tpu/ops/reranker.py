@@ -139,9 +139,14 @@ class CrossEncoderReranker(Reranker):
             params = shard_params(params, mesh, ENCODER_TP_RULES)
         self.params = params
         cfg = self.model_config
+        # bidirectional flash kernel for pair scoring — policy lives in
+        # kernels.select_encoder_attn_fn (shared with the embedder)
+        from sentio_tpu.kernels import select_encoder_attn_fn
+
+        attn_fn = select_encoder_attn_fn(mesh, cfg.n_heads)
 
         def fwd(p, ids, mask, types):
-            return cross_encoder_scores(p, cfg, ids, mask, types)
+            return cross_encoder_scores(p, cfg, ids, mask, types, attn_fn=attn_fn)
 
         self._fwd = jax.jit(fwd)
 

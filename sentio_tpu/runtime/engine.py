@@ -93,14 +93,33 @@ class GeneratorEngine:
         from sentio_tpu.runtime.sampling import sample_tokens
 
         cfg = self.model_config
-        # Pallas flash attention for the prefill pass on TPU (the multi-token
-        # causal block); decode (T=1) keeps the fused XLA path. With a TP mesh
-        # the heads are sharded — a bare pallas_call under jit would force
-        # gathers, so the kernel is single-chip-only until it runs in
-        # shard_map (ring_attention covers the sharded long-context path).
-        from sentio_tpu.kernels import default_attn_fn
+        # Pallas flash attention for the prefill pass (the multi-token causal
+        # block); decode (T=1) keeps the fused XLA path. Under a mesh the
+        # kernel runs INSIDE shard_map: heads on tp (matching the wq/wk/wv
+        # column sharding), ring attention over sp for sequence-parallel
+        # long-context prefill.
+        from sentio_tpu.kernels import default_attn_fn, make_mesh_attn_fn
 
-        attn_fn = default_attn_fn() if self.mesh is None else None
+        if self.mesh is None:
+            attn_fn = default_attn_fn()
+        elif jax.default_backend() != "tpu":
+            attn_fn = None  # CPU test meshes: XLA attention under GSPMD
+        else:
+            base_fn = make_mesh_attn_fn(self.mesh)
+
+            def attn_fn(q, k, v, kv_lens=None):
+                import jax.numpy as jnp
+
+                from sentio_tpu.models import layers as L
+
+                try:
+                    return base_fn(q, k, v, kv_lens)
+                except ValueError:  # indivisible head/seq shapes → XLA path
+                    mask = L.causal_mask(q.shape[1])
+                    if kv_lens is not None:
+                        key_ok = jnp.arange(k.shape[1])[None, :] < kv_lens[:, None]
+                        mask = mask & key_ok[:, None, None, :]
+                    return L.attention(q, k, v, mask, q.dtype)
 
         @jax.jit
         def prefill(params, ids, positions, cache):

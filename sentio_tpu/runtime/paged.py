@@ -322,6 +322,10 @@ class ContinuousBatchingEngine:
         # (first_tokens_device_array, [slot_idx, ...]) per admission chunk,
         # consumed by the next decode tick
         self._pending_first: list = []
+        # optional callable the serving layer sets so ticks stay SHORT when
+        # callers are waiting upstream of the engine's own queue (the
+        # service inbox) — the engine queue alone can't see them
+        self.pressure_hint = None
         self._next_id = itertools.count()
         self._rng = jax.random.PRNGKey(rng_seed + 1)
         # host mirrors of device state, re-uploaded when admission changes them
@@ -437,6 +441,20 @@ class ContinuousBatchingEngine:
         rid = next(self._next_id)
         self._queue.append(_Request(rid, prompt, max_new_tokens, temperature))
         return rid
+
+    def cancel(self, request_id: int) -> bool:
+        """Abandon a request: queued → dropped; decoding → slot retired and
+        pages freed (the tokens so far are discarded). Must be called by the
+        engine's single driver thread, like every other engine method."""
+        for idx, req in enumerate(self._queue):
+            if req.request_id == request_id:
+                del self._queue[idx]
+                return True
+        for i, slot in enumerate(self.slots):
+            if slot.active and slot.request_id == request_id:
+                self._retire(i, "cancelled")
+                return True
+        return False
 
     def reset(self) -> None:
         """Rebuild all device/host decode state after a failed tick.
@@ -616,12 +634,16 @@ class ContinuousBatchingEngine:
                 # defensive: a zero-budget row with nothing in flight can't
                 # progress (pending rows fold their first token below)
                 finished.append(self._retire(i, "length"))
-        # adaptive tick size, TWO compiled variants only: queued requests cap
-        # the tick (admission waits at most steps_per_tick sub-steps); an
-        # empty queue runs the big tick so long generations cost few fetches.
+        # adaptive tick size, TWO compiled variants only: waiting requests
+        # (engine queue OR the serving layer's inbox, via pressure_hint) cap
+        # the tick so admission waits at most steps_per_tick sub-steps; an
+        # idle queue runs the big tick so long generations cost few fetches.
         # Over-long ticks waste masked sub-steps, which cost far less than an
         # extra host round trip.
-        steps = self.steps_per_tick if self._queue else self.max_tick_steps
+        pressured = bool(self._queue) or bool(
+            self.pressure_hint is not None and self.pressure_hint()
+        )
+        steps = self.steps_per_tick if pressured else self.max_tick_steps
         budgets = np.minimum(remaining, steps).astype(np.int32)
         # rows sharing THIS fused dispatch — the honest occupancy number
         # (post-tick slot counts miss requests that retire inside the tick)

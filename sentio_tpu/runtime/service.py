@@ -52,6 +52,9 @@ class _Ticket:
     # tick and ("done", result) at retirement; None for plain generate()
     stream_q: Optional[_queue.Queue] = None
     sent_tokens: int = 0  # how many emitted tokens were already pushed
+    # caller abandoned (timeout / disconnected stream): the pump cancels the
+    # engine request instead of decoding to max_new for nobody
+    cancelled: bool = False
 
 
 class PagedGenerationService:
@@ -99,6 +102,7 @@ class PagedGenerationService:
             self._inbox.append(ticket)
             self._ensure_pump()
         if not ticket.event.wait(timeout_s or self.default_timeout_s):
+            ticket.cancelled = True  # pump frees the slot on its next loop
             raise GenerationTimeout(
                 f"generation did not finish within "
                 f"{timeout_s or self.default_timeout_s:.0f}s"
@@ -131,36 +135,42 @@ class PagedGenerationService:
         deadline = timeout_s or self.default_timeout_s
         emitted: list[int] = []
         flushed = ""
-        while True:
-            try:
-                kind, payload = ticket.stream_q.get(timeout=deadline)
-            except _queue.Empty:
-                raise GenerationTimeout(
-                    f"stream produced nothing for {deadline:.0f}s"
-                ) from None
-            if kind == "toks":
-                emitted.extend(payload)
-            else:  # "done"
-                result: PagedResult = payload
-                if result.finish_reason == "error":
-                    raise RuntimeError("paged decode failed mid-stream")
-                emitted = list(result.tokens)  # authoritative final sequence
-            text = tokenizer.decode(emitted)
-            if kind == "done":
-                # final flush is unconditional: the finished answer may
-                # genuinely end in a replacement char
-                if len(text) > len(flushed):
-                    yield text[len(flushed):]
-                return
-            # mid-stream: withhold AT MOST the final char — a trailing '�'
-            # may be an incomplete UTF-8 sequence that the next token
-            # resolves (a genuine replacement char flushes next round;
-            # holding the whole tail would stall streams whose chunks keep
-            # ending in replacement chars)
-            safe = text[:-1] if text.endswith("�") else text
-            if len(safe) > len(flushed):
-                yield safe[len(flushed):]
-                flushed = safe
+        try:
+            while True:
+                try:
+                    kind, payload = ticket.stream_q.get(timeout=deadline)
+                except _queue.Empty:
+                    raise GenerationTimeout(
+                        f"stream produced nothing for {deadline:.0f}s"
+                    ) from None
+                if kind == "toks":
+                    emitted.extend(payload)
+                else:  # "done"
+                    result: PagedResult = payload
+                    if result.finish_reason == "error":
+                        raise RuntimeError("paged decode failed mid-stream")
+                    emitted = list(result.tokens)  # authoritative final sequence
+                text = tokenizer.decode(emitted)
+                if kind == "done":
+                    # final flush is unconditional: the finished answer may
+                    # genuinely end in a replacement char
+                    if len(text) > len(flushed):
+                        yield text[len(flushed):]
+                    return
+                # mid-stream: withhold AT MOST the final char — a trailing
+                # '�' may be an incomplete UTF-8 sequence that the next token
+                # resolves (a genuine replacement char flushes next round;
+                # holding the whole tail would stall streams whose chunks
+                # keep ending in replacement chars)
+                safe = text[:-1] if text.endswith("�") else text
+                if len(safe) > len(flushed):
+                    yield safe[len(flushed):]
+                    flushed = safe
+        finally:
+            # abandoned mid-decode (timeout, consumer disconnect → generator
+            # close): tell the pump to cancel instead of decoding for nobody
+            if ticket.result is None:
+                ticket.cancelled = True
 
     def close(self) -> None:
         with self._mutex:
@@ -196,9 +206,14 @@ class PagedGenerationService:
             self._pump.start()
 
     def _run(self) -> None:
+        # short ticks while callers wait in OUR inbox, not just the engine
+        # queue (len() reads are GIL-atomic; this is a hint, not a lock)
+        self.engine.pressure_hint = lambda: bool(self._inbox)
         while True:
             with self._mutex:
                 for ticket in self._inbox:
+                    if ticket.cancelled:
+                        continue
                     rid = self.engine.submit(
                         ticket.prompt,
                         max_new_tokens=ticket.max_new_tokens,
@@ -206,6 +221,11 @@ class PagedGenerationService:
                     )
                     self._tickets[rid] = ticket
                 self._inbox.clear()
+                # abandoned callers: stop decoding for nobody, free the slot
+                for rid, ticket in list(self._tickets.items()):
+                    if ticket.cancelled:
+                        self.engine.cancel(rid)
+                        self._tickets.pop(rid, None)
                 if self._closed or not self.engine.has_work:
                     # flag flips inside the mutex: a racing submit either
                     # lands in the inbox before this check (we continue) or

@@ -233,3 +233,57 @@ class TestEmbedderCoalescing:
         assert out.shape == (2, emb.dimension)
         assert emb._query_batcher.stats.batches == 0
         emb.close()
+
+
+class TestCancellation:
+    def test_timeout_cancels_engine_request(self, contiguous):
+        from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+        from sentio_tpu.runtime.service import GenerationTimeout, PagedGenerationService
+
+        eng = ContinuousBatchingEngine(
+            model_config=contiguous.model_config, params=contiguous.params,
+            tokenizer=contiguous.tokenizer, max_slots=2, page_size=16,
+            max_pages_per_seq=8, steps_per_tick=1,
+        )
+        svc = PagedGenerationService(eng)
+        try:
+            with pytest.raises(GenerationTimeout):
+                svc.generate("slow request", max_new_tokens=100, timeout_s=0.05)
+            # the pump must reclaim the abandoned slot's pages
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                s = svc.stats()
+                if s["free_pages"] == s["total_pages"] - 1 and s["active_slots"] == 0:
+                    break
+                time.sleep(0.05)
+            s = svc.stats()
+            assert s["active_slots"] == 0, s
+            assert s["free_pages"] == s["total_pages"] - 1, s
+        finally:
+            svc.close()
+
+    def test_abandoned_stream_cancels(self, contiguous):
+        from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+        from sentio_tpu.runtime.service import PagedGenerationService
+
+        eng = ContinuousBatchingEngine(
+            model_config=contiguous.model_config, params=contiguous.params,
+            tokenizer=contiguous.tokenizer, max_slots=2, page_size=16,
+            max_pages_per_seq=8, steps_per_tick=1,
+        )
+        svc = PagedGenerationService(eng)
+        try:
+            it = svc.generate_stream("stream to abandon", max_new_tokens=200)
+            next(it)  # consume a first chunk so decode is mid-flight
+            it.close()  # consumer disconnects
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                s = svc.stats()
+                if s["active_slots"] == 0 and s["queued_inbox"] == 0:
+                    break
+                time.sleep(0.05)
+            s = svc.stats()
+            assert s["active_slots"] == 0, s
+            assert s["free_pages"] == s["total_pages"] - 1, s
+        finally:
+            svc.close()
