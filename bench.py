@@ -259,13 +259,32 @@ def phase_c_scale(kind: str, new_tokens: int, concurrency: int):
     from sentio_tpu.runtime.paged import ContinuousBatchingEngine
     from sentio_tpu.runtime.service import PagedGenerationService
 
+    import jax
+
+    from sentio_tpu.models.llama import init_llama
+
     cfg = serve_scale_config(kind)
     log(f"phase C: init {kind} serve-scale model "
         f"(dim={cfg.dim} L={cfg.n_layers} vocab={cfg.vocab_size}) ...")
     t0 = time.perf_counter()
+    # store weights in bf16 (init_llama samples f32; converted checkpoints
+    # arrive bf16 — f32 residency would put the 8b geometry over HBM).
+    # jit fuses init+cast so only the bf16 tree materializes; an eager
+    # tree_map would hold BOTH trees (17 GB) and thrash the allocator.
+    init_bf16 = jax.jit(
+        lambda key: jax.tree_util.tree_map(
+            lambda x: x.astype(cfg.jdtype), init_llama(key, cfg)
+        )
+    )
+    params = init_bf16(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    window = 512 if kind == "8b" else 1024
     engine = ContinuousBatchingEngine(
-        model_config=cfg, max_slots=concurrency, page_size=16,
-        max_pages_per_seq=1024 // 16, steps_per_tick=16, max_tick_steps=64,
+        model_config=cfg, params=params, max_slots=concurrency, page_size=16,
+        max_pages_per_seq=window // 16, steps_per_tick=16,
+        # one compiled tick size for the 8b smoke — its scan compile through
+        # the remote-compile service runs minutes per variant
+        max_tick_steps=16 if kind == "8b" else 64,
         ignore_eos=True,
     )
     n_params = count_params(engine.params)
