@@ -130,7 +130,7 @@ def phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
         model_config=llm_cfg, params=engine.params, tokenizer=engine.tokenizer,
         max_slots=max(concurrency, 4), page_size=16,
         max_pages_per_seq=llm_cfg.max_len // 16, steps_per_tick=16,
-        max_tick_steps=64,
+        max_tick_steps=64, pipeline_depth=2,
         # random-init weights greedy-sample EOS almost immediately — fixed-
         # length generation measures the cost real tuned models actually pay
         ignore_eos=True,
@@ -285,7 +285,7 @@ def phase_c_scale(kind: str, new_tokens: int, concurrency: int):
         # one compiled tick size for the 8b smoke — its scan compile through
         # the remote-compile service runs minutes per variant
         max_tick_steps=16 if kind == "8b" else 64,
-        ignore_eos=True,
+        pipeline_depth=2, ignore_eos=True,
     )
     n_params = count_params(engine.params)
     log(f"  {n_params / 1e9:.2f}B params on device in {time.perf_counter() - t0:.1f}s")

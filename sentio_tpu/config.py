@@ -243,6 +243,9 @@ class GeneratorConfig:
     # cost few host fetches (the per-tick fetch is ~RTT on remote devices)
     decode_steps_per_tick: int = 16
     decode_max_tick_steps: int = 64
+    # 2 = dispatch tick N+1 before fetching tick N (host round trip overlaps
+    # device compute; results lag one tick). 1 = synchronous ticks.
+    decode_pipeline_depth: int = 2
     prefill_buckets: tuple[int, ...] = (256, 512, 1024, 2048, 4096)
     temperature_by_mode: tuple[tuple[str, float], ...] = (
         ("fast", 0.0),
@@ -279,6 +282,7 @@ class GeneratorConfig:
             use_paged_decode=_env_bool(["USE_PAGED_KV", "USE_PAGED_DECODE"], True),
             decode_steps_per_tick=_env_int(["DECODE_STEPS_PER_TICK"], 16),
             decode_max_tick_steps=_env_int(["DECODE_MAX_TICK_STEPS"], 64),
+            decode_pipeline_depth=_env_int(["DECODE_PIPELINE_DEPTH"], 2),
         )
 
 

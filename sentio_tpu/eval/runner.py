@@ -209,6 +209,7 @@ def run_eval(
                 max_pages_per_seq=llm_cfg.max_len // 16,
                 steps_per_tick=16,
                 max_tick_steps=64,
+                pipeline_depth=2,
                 # random-init weights greedy-sample EOS almost immediately;
                 # fixed-length generation keeps configs 4/5 measuring the
                 # full decode+verify cost real tuned models pay

@@ -229,6 +229,9 @@ class _Slot:
     # first sampled token still on device (admission defers its fetch; the
     # next tick's packed output materializes it host-side)
     pending_first: bool = False
+    # decode sub-steps granted to dispatched-but-unharvested ticks — budget
+    # math must count them or a pipelined tick would over-run the limits
+    inflight_steps: int = 0
 
 
 @dataclass
@@ -277,6 +280,7 @@ class ContinuousBatchingEngine:
         steps_per_tick: int = 8,
         max_tick_steps: Optional[int] = None,
         ignore_eos: bool = False,
+        pipeline_depth: int = 1,
         mesh=None,
     ) -> None:
         import jax
@@ -305,6 +309,14 @@ class ContinuousBatchingEngine:
         # benchmark workloads: random-init weights frequently greedy-sample
         # EOS immediately; fixed-length generation measures the real cost
         self.ignore_eos = bool(ignore_eos)
+        # depth 2 dispatches tick N+1 BEFORE fetching tick N's tokens, so
+        # the ~RTT host fetch overlaps device compute. Decode state (tok/
+        # lens/halted) is carried ON DEVICE between ticks; EOS halting and
+        # budget schedules are device/deterministic, so the speculative tick
+        # is always semantically correct — at worst it spends masked
+        # sub-steps on rows the harvest then retires. Depth 1 = synchronous;
+        # a single in-flight record means deeper values are not supported.
+        self.pipeline_depth = min(max(int(pipeline_depth), 1), 2)
         self.mesh = mesh
         if num_pages is None:
             num_pages = 1 + max_slots * max_pages_per_seq
@@ -326,6 +338,11 @@ class ContinuousBatchingEngine:
         # callers are waiting upstream of the engine's own queue (the
         # service inbox) — the engine queue alone can't see them
         self.pressure_hint = None
+        # device-resident decode carry (tok, lens, halted) threaded from the
+        # previous tick's outputs; None until the first dispatch
+        self._dev_state = None
+        # dispatched-but-unfetched tick awaiting harvest (pipeline_depth 2)
+        self._inflight: Optional[dict] = None
         self._next_id = itertools.count()
         self._rng = jax.random.PRNGKey(rng_seed + 1)
         # host mirrors of device state, re-uploaded when admission changes them
@@ -356,19 +373,20 @@ class ContinuousBatchingEngine:
 
         ignore_eos = self.ignore_eos
 
-        @partial(jax.jit, static_argnames=("steps",), donate_argnums=(4, 5))
-        def step_n(params, tok, lens, page_table, k_pages, v_pages, rng, temps,
-                   budgets, steps):
+        @partial(jax.jit, static_argnames=("steps",), donate_argnums=(5, 6))
+        def step_n(params, tok, lens, halted, page_table, k_pages, v_pages,
+                   rng, temps, budgets, steps):
             """``steps`` decode sub-steps fused into one dispatch (lax.scan).
 
             Per-row ``budgets`` bound how far each row may advance (token
             budget / page capacity, mirrored host-side); rows halt early on
             EOS. Frozen rows keep their lens/tok and write to scratch.
-            Returns per-step sampled tokens [steps, B] — the ONLY array the
-            host fetches per tick. The execution mask is not returned: the
-            host replay reconstructs it exactly from its own budgets plus
-            first-EOS (fetches dominate per-tick cost on remote-attached
-            devices, ~RTT each, so one array, one fetch).
+            Returns per-step sampled tokens [1+steps, B] — the ONLY array
+            the host fetches per tick — plus the carried (tok, lens, halted)
+            DEVICE state, so the next tick can dispatch without waiting for
+            this tick's fetch (pipelining) and without re-uploading host
+            mirrors. The execution mask is not returned: the host replay
+            reconstructs it exactly from its own budgets plus first-EOS.
             """
             from sentio_tpu.runtime.sampling import sample_tokens
 
@@ -389,27 +407,32 @@ class ContinuousBatchingEngine:
 
             tok_in = tok
             # rows whose (deferred) first token is already EOS never run
-            halted0 = (tok == eos_id) if not ignore_eos else jnp.zeros_like(tok, bool)
-            init = (tok, lens, k_pages, v_pages, rng, halted0)
-            (tok, lens, k_pages, v_pages, rng, _), toks = jax.lax.scan(
+            if not ignore_eos:
+                halted = halted | (tok == eos_id)
+            init = (tok, lens, k_pages, v_pages, rng, halted)
+            (tok, lens, k_pages, v_pages, rng, halted), toks = jax.lax.scan(
                 body, init, jnp.arange(steps)
             )
             # packed [1 + steps, B]: row 0 echoes the INPUT tokens so freshly
             # admitted rows' device-resident first tokens reach the host in
             # the same single fetch as the tick outputs
-            return jnp.concatenate([tok_in[None, :], toks], axis=0), \
-                k_pages, v_pages, rng
+            packed = jnp.concatenate([tok_in[None, :], toks], axis=0)
+            return packed, tok, lens, halted, k_pages, v_pages, rng
 
         self._step_n = step_n
 
         @jax.jit
-        def merge_first(tok, first, idxs):
-            """Scatter admission's device-resident first tokens into the
-            tick's token input. ``idxs`` pads to ``first``'s length with an
-            out-of-range index; mode='drop' discards the pad rows."""
-            return tok.at[idxs].set(first, mode="drop")
+        def merge_admitted(tok, lens, halted, first, new_lens, idxs):
+            """Scatter admission's device-resident first tokens (plus their
+            prompt lengths, and a cleared halt flag) into the carried decode
+            state. ``idxs`` pads to ``first``'s length with an out-of-range
+            index; mode='drop' discards the pad rows."""
+            tok = tok.at[idxs].set(first, mode="drop")
+            lens = lens.at[idxs].set(new_lens, mode="drop")
+            halted = halted.at[idxs].set(False, mode="drop")
+            return tok, lens, halted
 
-        self._merge_first = merge_first
+        self._merge_admitted = merge_admitted
 
         @partial(jax.jit, donate_argnums=(7, 8))
         def prefill_scatter(params, ids, positions, lens, rng, temps, scat,
@@ -474,6 +497,8 @@ class ContinuousBatchingEngine:
         self._queue.clear()
         self._finished_buffer.clear()
         self._pending_first.clear()
+        self._dev_state = None
+        self._inflight = None
         self._page_table[:] = 0
         self._lens[:] = 0
         self._temps[:] = 0.0
@@ -482,7 +507,11 @@ class ContinuousBatchingEngine:
 
     @property
     def has_work(self) -> bool:
-        return bool(self._queue) or any(s.active for s in self.slots)
+        return (
+            bool(self._queue)
+            or any(s.active for s in self.slots)
+            or self._inflight is not None
+        )
 
     def run_all(
         self, prompts: Sequence[str], max_new_tokens: int = 64, temperature: float = 0.0
@@ -498,12 +527,23 @@ class ContinuousBatchingEngine:
     def step(self) -> list[PagedResult]:
         """One engine tick: admit waiting requests (prefill dispatches, no
         fetch), one fused multi-step decode dispatch, ONE host fetch, retire
-        finished slots. Returns results completed this tick."""
+        finished slots. With ``pipeline_depth`` 2 the dispatch goes out
+        BEFORE the previous tick's fetch, overlapping the host round trip
+        with device compute (results then lag one tick). Returns results
+        completed this tick."""
         self.last_tick_active = 0
         self._admit()
+        record = self._dispatch_tick() if any(s.active for s in self.slots) else None
+        # buffer swap AFTER dispatch: defensive retires made while budgeting
+        # must ride THIS step's results (there may not be a next step)
         out, self._finished_buffer = self._finished_buffer, []
-        if any(s.active for s in self.slots):
-            out.extend(self._decode_tick())
+        if self.pipeline_depth <= 1:
+            if record is not None:
+                out.extend(self._harvest(record))
+        else:
+            prev, self._inflight = self._inflight, record
+            if prev is not None:
+                out.extend(self._harvest(prev))
         return out
 
     # -------------------------------------------------------------- private
@@ -556,6 +596,7 @@ class ContinuousBatchingEngine:
             slot.max_new = req.max_new
             slot.temperature = req.temperature
             slot.emitted = []
+            slot.inflight_steps = 0
             slot.active = True
             row = np.zeros(self.max_pages_per_seq, np.int32)
             row[: len(pages)] = pages
@@ -614,26 +655,33 @@ class ContinuousBatchingEngine:
             self.slots[slot_idx].pending_first = True
         self._pending_first.append((first, slot_idxs))
 
-    def _decode_tick(self) -> list[PagedResult]:
-        import jax.numpy as jnp
-
+    def _dispatch_tick(self) -> Optional[dict]:
+        """Compute per-row budgets, merge freshly admitted rows into the
+        device-carried decode state, and dispatch ONE fused multi-step scan.
+        No host fetch happens here — the returned record is harvested later
+        (immediately at pipeline depth 1, one step() later at depth 2)."""
         pending, self._pending_first = self._pending_first, []
         remaining = np.zeros(self.max_slots, np.int32)
-        finished: list[PagedResult] = []
         for i, slot in enumerate(self.slots):
             if not slot.active:
                 continue
             capacity = len(slot.pages) * self.page_size
-            # a pending (still-on-device) first token counts against the
-            # budget exactly as if it had been folded at admission time
-            base_emit = len(slot.emitted) + (1 if slot.pending_first else 0)
-            remaining[i] = max(
-                min(slot.max_new - base_emit, capacity - 1 - slot.length), 0
+            # a pending (still-on-device) first token and any sub-steps
+            # already granted to an unharvested tick count against the
+            # budget exactly as if they had been folded
+            base_emit = (
+                len(slot.emitted) + slot.inflight_steps
+                + (1 if slot.pending_first else 0)
             )
-            if remaining[i] == 0 and not slot.pending_first:
+            written = slot.length + slot.inflight_steps
+            remaining[i] = max(
+                min(slot.max_new - base_emit, capacity - 1 - written), 0
+            )
+            if (remaining[i] == 0 and not slot.pending_first
+                    and slot.inflight_steps == 0):
                 # defensive: a zero-budget row with nothing in flight can't
-                # progress (pending rows fold their first token below)
-                finished.append(self._retire(i, "length"))
+                # progress
+                self._finished_buffer.append(self._retire(i, "length"))
         # adaptive tick size, TWO compiled variants only: waiting requests
         # (engine queue OR the serving layer's inbox, via pressure_hint) cap
         # the tick so admission waits at most steps_per_tick sub-steps; an
@@ -645,14 +693,20 @@ class ContinuousBatchingEngine:
         )
         steps = self.steps_per_tick if pressured else self.max_tick_steps
         budgets = np.minimum(remaining, steps).astype(np.int32)
+        pending_slots = [i for _, idxs in pending for i in idxs
+                         if self.slots[i].active]
         # rows sharing THIS fused dispatch — the honest occupancy number
         # (post-tick slot counts miss requests that retire inside the tick)
         self.last_tick_active = int(
             ((budgets > 0) | [s.active and s.pending_first for s in self.slots]).sum()
         )
         if not budgets.any():
-            # nothing can decode, but deferred first tokens may still need
-            # folding (e.g. every admitted request wants max_new == 1)
+            if not pending_slots:
+                return None
+            # nothing can decode but deferred first tokens need folding
+            # (e.g. a max_new_tokens=1 burst): fetch them directly instead
+            # of dispatching a fully-masked scan that would stream the
+            # weights steps-many times just to echo the inputs back
             for first_dev, slot_idxs in pending:
                 vals = np.asarray(first_dev)
                 for r, i in enumerate(slot_idxs):
@@ -662,54 +716,83 @@ class ContinuousBatchingEngine:
                     self._last_tok[i] = int(vals[r])
                     result = self._fold_and_maybe_retire(i)
                     if result is not None:
-                        finished.append(result)
-            return finished
+                        self._finished_buffer.append(result)
+            return None
 
-        # token input rides ON DEVICE: host mirror for established rows,
-        # admission's device-resident first tokens scattered in via the
-        # jitted merge (jit dispatches are async; eager index-update ops and
-        # explicit jnp.asarray uploads each block ~RTT on remote devices)
-        # mirrors are snapshotted (.copy()): the host replay below mutates
-        # them while the async transfer may still be in flight
-        tok_in = self._last_tok.copy()
+        # decode state rides ON DEVICE, threaded from the previous tick's
+        # outputs (host mirrors seed the first tick); admission's device-
+        # resident first tokens / prompt lengths scatter in via the jitted
+        # merge. Jit dispatches are async; eager index-update ops and
+        # explicit jnp.asarray uploads each block ~RTT on remote devices.
+        if self._dev_state is None:
+            tok_in = self._last_tok.copy()
+            lens_in = self._lens.copy()
+            halted_in = np.zeros(self.max_slots, bool)
+        else:
+            tok_in, lens_in, halted_in = self._dev_state
         for first_dev, slot_idxs in pending:
             idxs = np.full(first_dev.shape[0], self.max_slots, np.int32)
             idxs[: len(slot_idxs)] = slot_idxs
-            tok_in = self._merge_first(tok_in, first_dev, idxs)
+            new_lens = np.zeros(first_dev.shape[0], np.int32)
+            new_lens[: len(slot_idxs)] = [
+                self.slots[i].length for i in slot_idxs
+            ]
+            tok_in, lens_in, halted_in = self._merge_admitted(
+                tok_in, lens_in, halted_in, first_dev, new_lens, idxs
+            )
 
-        packed, self.pool.k, self.pool.v, self._rng = self._step_n(
-            self.params,
-            tok_in,
-            self._lens.copy(),
-            self._page_table.copy(),
-            self.pool.k,
-            self.pool.v,
-            self._rng,
-            self._temps.copy(),
-            budgets,
-            steps=steps,
-        )
+        packed, tok_out, lens_out, halted_out, self.pool.k, self.pool.v, \
+            self._rng = self._step_n(
+                self.params,
+                tok_in,
+                lens_in,
+                halted_in,
+                self._page_table.copy(),
+                self.pool.k,
+                self.pool.v,
+                self._rng,
+                self._temps.copy(),
+                budgets,
+                steps=steps,
+            )
+        self._dev_state = (tok_out, lens_out, halted_out)
         self.total_sub_steps += steps
-        # [1 + steps, B] — the ONE host fetch per engine tick
-        packed = np.asarray(packed)
-
-        # host replay of the device scan: each executed sub-step is exactly
-        # one old-style tick — write counted, token folded, retirement
-        # checked. Execution mask reconstruction: a row runs until its budget
-        # (host-known) or the step after its first EOS (visible in packed) —
-        # identical to the device's halting rule (halted0 covers EOS-as-
-        # first-token for freshly admitted rows).
         for i, slot in enumerate(self.slots):
-            if not slot.active:
+            if slot.active:
+                slot.inflight_steps += int(budgets[i])
+        return {"packed": packed, "budgets": budgets,
+                "pending_slots": set(pending_slots),
+                # request ids pin each lane: a slot retired at harvest time
+                # and re-admitted before THIS record is harvested must not
+                # have the old request's speculative tokens replayed into it
+                "rids": [s.request_id for s in self.slots]}
+
+    def _harvest(self, record: dict) -> list[PagedResult]:
+        """Fetch a dispatched tick's packed tokens ([1 + steps, B] — the ONE
+        host fetch per tick) and replay the device scan host-side: each
+        executed sub-step is exactly one old-style tick — write counted,
+        token folded, retirement checked. Execution-mask reconstruction: a
+        row runs until its budget (host-known) or the step after its first
+        EOS (visible in packed) — identical to the device's halting rule."""
+        budgets = record["budgets"]
+        packed = np.asarray(record["packed"])
+        finished: list[PagedResult] = []
+        for i, slot in enumerate(self.slots):
+            if not slot.active or slot.request_id != record["rids"][i]:
+                continue  # lane retired+reused since dispatch: stale tokens
+            consumed = int(budgets[i])
+            if consumed or i in record["pending_slots"]:
+                slot.inflight_steps = max(slot.inflight_steps - consumed, 0)
+            else:
                 continue
-            if slot.pending_first:
+            if slot.pending_first and i in record["pending_slots"]:
                 slot.pending_first = False
                 self._last_tok[i] = int(packed[0, i])
                 result = self._fold_and_maybe_retire(i)
                 if result is not None:
                     finished.append(result)
                     continue
-            for s in range(int(budgets[i])):
+            for s in range(consumed):
                 slot.length += 1
                 self._lens[i] = slot.length
                 self._last_tok[i] = int(packed[1 + s, i])
@@ -748,6 +831,7 @@ class ContinuousBatchingEngine:
         self.allocator.free(slot.pages)
         slot.active = False
         slot.pending_first = False
+        slot.inflight_steps = 0
         slot.pages = []
         self._page_table[i] = 0
         self._lens[i] = 0

@@ -219,6 +219,7 @@ class DependencyContainer:
                 max_pages_per_seq=cfg.kv_max_pages_per_seq,
                 steps_per_tick=cfg.decode_steps_per_tick,
                 max_tick_steps=cfg.decode_max_tick_steps,
+                pipeline_depth=cfg.decode_pipeline_depth,
                 mesh=self.mesh,  # pool kv-heads shard over tp with the weights
             )
             return PagedGenerationService(paged)
