@@ -15,11 +15,31 @@ a page table mapping logical blocks to physical pages. That buys:
 * **instant reclaim** — finishing a request frees integer page ids, not
   device memory.
 
-Device side is pure-functional: ``paged_decode_step`` threads the page pool
+Device side is pure-functional: the fused tick threads the page pool
 through jit with donated buffers (the pool is updated in place, never
 copied). Host side, ``PageAllocator`` is a free-list and ``ContinuousBatchingEngine``
 owns slot admission / EOS retirement, mirroring the reference's resilience
 stance (a failing request fails alone, SURVEY.md §5).
+
+The engine is built around ONE cost model: device dispatches are async and
+effectively free, while every host-visible transfer is a round trip (~RTT —
+dominant through remote-attached chips, real overhead locally). Hence:
+
+* **multi-step fused ticks** — one ``lax.scan`` dispatch runs up to
+  ``max_tick_steps`` decode sub-steps with per-row budgets and EOS halting;
+  the host fetches ONE packed [1+steps, B] token array per tick and replays
+  the device's halting rule exactly (no mask transfer);
+* **batched admission, deferred first tokens** — queued requests prefill as
+  width-bucketed batches (prefill + cache scatter + first-token sample in
+  one dispatch), and the sampled first tokens stay on device until the next
+  tick's fetch carries them back;
+* **device-carried decode state** — token/position/halt arrays thread from
+  tick to tick as device arrays (host numpy rides jit calls, never eager
+  uploads), which enables
+* **pipelined ticks** (``pipeline_depth=2``) — tick N+1 dispatches BEFORE
+  tick N's fetch, overlapping the round trip with device compute; per-lane
+  request ids guard against stale replays when slots retire and are reused
+  mid-flight.
 
 Page 0 is reserved as a scratch page: free slots' page tables point at it,
 so masked lanes in the fused decode step write garbage somewhere harmless.

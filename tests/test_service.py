@@ -287,3 +287,60 @@ class TestCancellation:
             assert s["free_pages"] == s["total_pages"] - 1, s
         finally:
             svc.close()
+
+
+class TestPipelinedService:
+    def test_concurrent_requests_through_depth2_engine(self, contiguous):
+        from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+        from sentio_tpu.runtime.service import PagedGenerationService
+
+        eng = ContinuousBatchingEngine(
+            model_config=contiguous.model_config, params=contiguous.params,
+            tokenizer=contiguous.tokenizer, max_slots=4, page_size=16,
+            max_pages_per_seq=8, steps_per_tick=4, max_tick_steps=8,
+            pipeline_depth=2,
+        )
+        svc = PagedGenerationService(eng)
+        try:
+            out = {}
+
+            def call(i):
+                out[i] = svc.generate(f"pipelined service {i}", max_new_tokens=10,
+                                      temperature=0.0)
+
+            threads = [threading.Thread(target=call, args=(i,)) for i in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180)
+            assert len(out) == 6
+            refs = {
+                i: contiguous.generate([f"pipelined service {i}"],
+                                       max_new_tokens=10, temperature=0.0)[0]
+                for i in range(6)
+            }
+            for i in range(6):
+                assert out[i].tokens == refs[i].tokens
+            s = svc.stats()
+            assert s["free_pages"] == s["total_pages"] - 1
+        finally:
+            svc.close()
+
+    def test_streaming_through_depth2_engine(self, contiguous):
+        from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+        from sentio_tpu.runtime.service import PagedGenerationService
+
+        eng = ContinuousBatchingEngine(
+            model_config=contiguous.model_config, params=contiguous.params,
+            tokenizer=contiguous.tokenizer, max_slots=2, page_size=16,
+            max_pages_per_seq=8, steps_per_tick=4, pipeline_depth=2,
+        )
+        svc = PagedGenerationService(eng)
+        try:
+            want = contiguous.generate(["stream depth two"], max_new_tokens=12,
+                                       temperature=0.0)[0]
+            got = "".join(svc.generate_stream("stream depth two",
+                                              max_new_tokens=12, temperature=0.0))
+            assert got == want.text
+        finally:
+            svc.close()
