@@ -289,13 +289,16 @@ class GeneratorConfig:
 @dataclass
 class MeshConfig:
     """TPU mesh geometry. Axes: ``dp`` (data/batch over ICI), ``tp`` (tensor
-    sharding of model weights), ``sp`` (sequence/context parallel). A zero
-    means "infer from available devices" (all devices on dp unless tp_size
-    set). Multi-slice deployments add a leading ``dcn`` data axis."""
+    sharding of model weights), ``sp`` (sequence/context parallel), ``pp``
+    (pipeline stages over layers), ``ep`` (expert parallel for MoE layers).
+    A zero means "infer from available devices" (all devices on dp unless
+    tp_size set). Multi-slice deployments add a leading ``dcn`` data axis."""
 
     dp_size: int = 0
     tp_size: int = 1
     sp_size: int = 1
+    pp_size: int = 1
+    ep_size: int = 1
     dcn_size: int = 1
     backend: str = ""  # "" = jax default; "cpu" to force host platform
 
@@ -305,6 +308,8 @@ class MeshConfig:
             dp_size=_env_int(["MESH_DP"], 0),
             tp_size=_env_int(["MESH_TP"], 1),
             sp_size=_env_int(["MESH_SP"], 1),
+            pp_size=_env_int(["MESH_PP"], 1),
+            ep_size=_env_int(["MESH_EP"], 1),
             dcn_size=_env_int(["MESH_DCN"], 1),
             backend=_env_str(["MESH_BACKEND"], ""),
         )

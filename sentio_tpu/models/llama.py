@@ -179,6 +179,55 @@ def _mlp(lp: dict, cfg: LlamaConfig, x: Array) -> Array:
     return L.dense(lp["w_down"], gate * up, dt)
 
 
+def block_forward(
+    lp: dict,
+    cfg: LlamaConfig,
+    x: Array,
+    positions: Array,
+    cos: Array,
+    sin: Array,
+    pad_mask: Optional[Array] = None,
+    attn_fn=None,
+) -> Array:
+    """One pre-norm transformer block on activations x [B, T, D] — the
+    cache-free (training / scoring) path, factored out so the pipeline-parallel
+    executor (parallel/pipeline.py) can scan it over a stage's layer stack."""
+    attn_out, _ = _attn(
+        lp["attn"], cfg, L.rmsnorm(lp["attn_norm"], x, cfg.norm_eps),
+        positions, cos, sin, 0, None, 0, pad_mask, attn_fn,
+    )
+    x = x + attn_out
+    return x + _mlp(lp["mlp"], cfg, L.rmsnorm(lp["mlp_norm"], x, cfg.norm_eps))
+
+
+def stack_layer_params(params: dict, cfg: LlamaConfig) -> dict:
+    """Rearrange per-layer subtrees ``layers_i`` into one stacked pytree with
+    a leading layer dim: {"embed_tokens", "lm_head", "final_norm", "layers"}
+    where every leaf of ``layers`` is [n_layers, ...]. The stacked form is
+    what ``lax.scan`` consumes (one compiled block for L layers) and what the
+    pipeline executor shards over the ``pp`` mesh axis (leading dim = stage)."""
+    per_layer = [params[f"layers_{i}"] for i in range(cfg.n_layers)]
+    stacked = jax.tree.map(lambda *leaves: jnp.stack(leaves), *per_layer)
+    return {
+        "embed_tokens": params["embed_tokens"],
+        "lm_head": params["lm_head"],
+        "final_norm": params["final_norm"],
+        "layers": stacked,
+    }
+
+
+def unstack_layer_params(stacked: dict, cfg: LlamaConfig) -> dict:
+    """Inverse of :func:`stack_layer_params`."""
+    params = {
+        "embed_tokens": stacked["embed_tokens"],
+        "lm_head": stacked["lm_head"],
+        "final_norm": stacked["final_norm"],
+    }
+    for i in range(cfg.n_layers):
+        params[f"layers_{i}"] = jax.tree.map(lambda leaf: leaf[i], stacked["layers"])
+    return params
+
+
 def llama_forward(
     params: dict,
     cfg: LlamaConfig,
@@ -211,6 +260,9 @@ def llama_forward(
     x = L.embed(params["embed_tokens"], ids, dt)
     for i in range(cfg.n_layers):
         lp = params[f"layers_{i}"]
+        if cache is None:
+            x = block_forward(lp, cfg, x, positions, cos, sin, pad_mask, attn_fn)
+            continue
         attn_out, cache = _attn(
             lp["attn"], cfg, L.rmsnorm(lp["attn_norm"], x, cfg.norm_eps),
             positions, cos, sin, i, cache, cache_index, pad_mask, attn_fn,

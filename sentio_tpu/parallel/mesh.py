@@ -5,16 +5,22 @@ NCCL/MPI layer (SURVEY.md §2.12). Here distribution is first-class: a
 :class:`jax.sharding.Mesh` with named axes
 
 * ``dp`` — data parallel (batch of coalesced requests) over ICI,
-* ``tp`` — tensor parallel (model weight sharding) over ICI,
+* ``pp`` — pipeline parallel (layer stages, ppermute activation handoff),
+* ``ep`` — expert parallel (MoE expert sharding, all_to_all dispatch),
 * ``sp`` — sequence/context parallel (ring attention) over ICI,
+* ``tp`` — tensor parallel (model weight sharding) over ICI,
 
 and an optional leading ``dcn`` data axis for multi-slice pods. All
-collectives are XLA's (psum / all_gather / ppermute) — mesh geometry and
-sharding specs are the entire comm layer; there is no socket code to write.
+collectives are XLA's (psum / all_gather / ppermute / all_to_all) — mesh
+geometry and sharding specs are the entire comm layer; there is no socket
+code to write.
 
 Axis order matters on TPU: the innermost mesh dims map to the
 torus-contiguous device order produced by ``mesh_utils.create_device_mesh``,
-so tp (all-reduce heavy) is placed innermost to ride the fastest ICI links.
+so tp (all-reduce heavy) is placed innermost to ride the fastest ICI links,
+then sp (per-block ring hops), then ep (one all_to_all pair per MoE layer),
+then pp (one point-to-point handoff per stage per microbatch — the least
+bandwidth-hungry ICI axis), with dp/dcn outermost (gradient reductions only).
 """
 
 from __future__ import annotations
@@ -34,11 +40,13 @@ logger = logging.getLogger(__name__)
 
 AXIS_DCN = "dcn"
 AXIS_DP = "dp"
+AXIS_PP = "pp"
+AXIS_EP = "ep"
 AXIS_SP = "sp"
 AXIS_TP = "tp"
 
 # canonical axis order, outermost → innermost
-MESH_AXES = (AXIS_DCN, AXIS_DP, AXIS_SP, AXIS_TP)
+MESH_AXES = (AXIS_DCN, AXIS_DP, AXIS_PP, AXIS_EP, AXIS_SP, AXIS_TP)
 
 
 class MeshError(Exception):
@@ -51,16 +59,18 @@ class MeshSpec:
 
     dcn: int
     dp: int
+    pp: int
+    ep: int
     sp: int
     tp: int
 
     @property
-    def shape(self) -> tuple[int, int, int, int]:
-        return (self.dcn, self.dp, self.sp, self.tp)
+    def shape(self) -> tuple[int, int, int, int, int, int]:
+        return (self.dcn, self.dp, self.pp, self.ep, self.sp, self.tp)
 
     @property
     def n_devices(self) -> int:
-        return self.dcn * self.dp * self.sp * self.tp
+        return self.dcn * self.dp * self.pp * self.ep * self.sp * self.tp
 
 
 def resolve_spec(config: MeshConfig, n_devices: int) -> MeshSpec:
@@ -71,15 +81,17 @@ def resolve_spec(config: MeshConfig, n_devices: int) -> MeshSpec:
     """
     tp = max(1, config.tp_size)
     sp = max(1, config.sp_size)
+    pp = max(1, config.pp_size)
+    ep = max(1, config.ep_size)
     dcn = max(1, config.dcn_size)
-    fixed = tp * sp * dcn
+    fixed = tp * sp * pp * ep * dcn
     if n_devices % fixed != 0:
         raise MeshError(
-            f"{n_devices} devices not divisible by tp*sp*dcn={fixed} "
-            f"(tp={tp}, sp={sp}, dcn={dcn})"
+            f"{n_devices} devices not divisible by tp*sp*pp*ep*dcn={fixed} "
+            f"(tp={tp}, sp={sp}, pp={pp}, ep={ep}, dcn={dcn})"
         )
     dp = config.dp_size if config.dp_size > 0 else n_devices // fixed
-    spec = MeshSpec(dcn=dcn, dp=dp, sp=sp, tp=tp)
+    spec = MeshSpec(dcn=dcn, dp=dp, pp=pp, ep=ep, sp=sp, tp=tp)
     if spec.n_devices != n_devices:
         raise MeshError(
             f"mesh {spec.shape} needs {spec.n_devices} devices, have {n_devices}"
@@ -105,8 +117,8 @@ def build_mesh(
 
     if spec.dcn > 1:
         dev_array = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape=(1, spec.dp, spec.sp, spec.tp),
-            dcn_mesh_shape=(spec.dcn, 1, 1, 1),
+            mesh_shape=(1, spec.dp, spec.pp, spec.ep, spec.sp, spec.tp),
+            dcn_mesh_shape=(spec.dcn, 1, 1, 1, 1, 1),
             devices=devices,
         )
     else:

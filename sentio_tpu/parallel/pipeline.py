@@ -1,0 +1,217 @@
+"""Pipeline parallelism: GPipe-style microbatched execution over ``pp``.
+
+The reference scales horizontally with stateless pods (SURVEY.md §2.12) and
+has no concept of model partitioning; here layer-stage pipelining is a
+first-class mesh axis. Design (TPU-idiomatic, per the scaling-book recipe):
+
+* The decoder's layers are stacked ([L, ...] leaves, models/llama.py
+  ``stack_layer_params``) and the leading layer dim is sharded over the
+  ``pp`` mesh axis — each pp rank holds a contiguous stage of L/S layers.
+* Execution runs under ``jax.shard_map`` **manual over pp only**
+  (``axis_names={"pp"}``): activations hop stage-to-stage with one
+  ``lax.ppermute`` per schedule step, while dp/sp/tp sharding of the
+  activations and of each stage's weights stays in XLA's hands (partial
+  auto mode), so pipeline composes with tensor parallelism without manual
+  psums here.
+* The schedule is GPipe: M microbatches drain through S stages in
+  M + S - 1 steps (bubble fraction (S-1)/(M+S-1)); each rank scans its
+  local layer stack with ``lax.scan``. Backward is ``jax.grad`` through the
+  whole thing — ppermute/scan/where all have transpose rules, so no manual
+  backward schedule is needed (1F1B is a later optimization, not a
+  correctness requirement).
+
+Everything outside the layer stack — embedding, final norm, LM head, loss —
+runs outside the shard_map under ordinary jit, replicated over pp.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sentio_tpu.models import layers as L
+from sentio_tpu.models.llama import LlamaConfig, block_forward
+from sentio_tpu.parallel.mesh import AXIS_PP
+
+Array = jax.Array
+
+
+class PipelineError(Exception):
+    pass
+
+
+def stacked_param_shardings(stacked: dict, mesh: Mesh) -> dict:
+    """NamedShardings for a ``stack_layer_params`` tree: embed/head/final
+    norm replicated (they live outside the pipeline), stacked layers staged
+    over pp on the leading (layer) dim with the per-layer Megatron tp layout
+    (sharding.py LLAMA_TP_RULES) on the inner dims — one source of truth for
+    the tp layout, with AXIS_PP prepended here."""
+    from sentio_tpu.parallel.sharding import LLAMA_TP_RULES, path_str, spec_for
+
+    def layer_leaf(path, leaf):
+        # per-layer spec for the trailing dims, pp prepended for the stack dim
+        inner = spec_for(path_str(path), LLAMA_TP_RULES, leaf.ndim - 1)
+        entries = (AXIS_PP,) + tuple(inner)
+        # axes absent from this mesh degrade to replication
+        entries = tuple(a if a in mesh.axis_names else None for a in entries)
+        return NamedSharding(mesh, P(*entries))
+
+    return {
+        "embed_tokens": jax.tree.map(lambda _: NamedSharding(mesh, P()), stacked["embed_tokens"]),
+        "lm_head": jax.tree.map(lambda _: NamedSharding(mesh, P()), stacked["lm_head"]),
+        "final_norm": jax.tree.map(lambda _: NamedSharding(mesh, P()), stacked["final_norm"]),
+        "layers": jax.tree_util.tree_map_with_path(layer_leaf, stacked["layers"]),
+    }
+
+
+def shard_stacked_params(stacked: dict, mesh: Mesh) -> dict:
+    n_stages = mesh.shape[AXIS_PP]
+    n_layers = jax.tree.leaves(stacked["layers"])[0].shape[0]
+    if n_layers % n_stages != 0:
+        raise PipelineError(f"{n_layers} layers not divisible by pp={n_stages}")
+    return jax.device_put(stacked, stacked_param_shardings(stacked, mesh))
+
+
+def _stage_apply(local_layers: Any, cfg: LlamaConfig, x: Array,
+                 positions: Array, cos: Array, sin: Array,
+                 pad_mask: Optional[Array]) -> Array:
+    """Run this rank's layer stack over activations x [mb, T, D]. The
+    residual stream stays float32 end to end (f32 x + bf16 block output
+    promotes to f32), which matters twice: numerically it is the usual
+    practice for deep residual streams, and structurally XLA's partitioner
+    hard-crashes on bf16 scan carries / collectives inside a partial-auto
+    manual region ("Invalid binary instruction opcode copy") — the f32
+    carry sidesteps that while every matmul still runs in the model dtype
+    inside block_forward."""
+
+    def step(h, lp):
+        return block_forward(lp, cfg, h, positions, cos, sin, pad_mask), None
+
+    x, _ = lax.scan(step, x, local_layers)
+    return x
+
+
+def pipeline_apply(
+    stacked_layers: Any,
+    cfg: LlamaConfig,
+    stream: Array,
+    positions: Array,
+    cos: Array,
+    sin: Array,
+    mesh: Mesh,
+    pad_stream: Array,
+) -> Array:
+    """Push a microbatch stream [M, mb, T, D] through all layers, pipelined
+    over the pp axis. ``pad_stream`` is [M, mb, T] validity masks. Returns
+    the transformed stream with the same shape.
+
+    The output stream materializes on the last stage and is broadcast to all
+    pp ranks with one masked psum — the loss/head consumer is replicated over
+    pp, so every rank needs it. (A production refinement keeps the head/loss
+    inside the last stage and psums only the scalar; at framework scale the
+    stream is microbatched activations, not logits, so the broadcast is
+    M·mb·T·D bf16 — acceptable, and it keeps head sharding in auto mode.)
+
+    The stream is float32 end to end — both across the shard_map boundary
+    and as the carried/permuted residual inside (see _stage_apply): XLA's
+    partial-manual partitioner hard-crashes ("Invalid binary instruction
+    opcode copy") on bf16 values crossing into or carried within the manual
+    region. Matmul compute inside each block still runs in the model dtype.
+    """
+    n_stages = mesh.shape[AXIS_PP]
+    stream = stream.astype(jnp.float32)  # f32 residual stream (see _stage_apply)
+    if n_stages == 1:
+        # no stages → microbatching serves no purpose; run one merged batch
+        m_, mb_, t_, d_ = stream.shape
+        merged = stream.reshape(m_ * mb_, t_, d_)
+        pos = jnp.broadcast_to(positions[:1], (m_ * mb_, t_))
+        out = _stage_apply(stacked_layers, cfg, merged, pos, cos, sin,
+                           pad_stream.reshape(m_ * mb_, t_))
+        return out.reshape(m_, mb_, t_, d_)
+
+    n_micro = stream.shape[0]
+    n_steps = n_micro + n_stages - 1
+    perm = [(j, j + 1) for j in range(n_stages - 1)]
+
+    def per_rank(local_layers, local_stream, local_pads):
+        rank = lax.axis_index(AXIS_PP)
+        # shard_map hands each rank the full stream (replicated over pp);
+        # local_layers is this rank's [L/S, ...] stage.
+
+        def step(carry, t):
+            prev_y, out = carry
+            recv = lax.ppermute(prev_y, AXIS_PP, perm)
+            feed_idx = jnp.clip(t, 0, n_micro - 1)
+            feed = lax.dynamic_index_in_dim(local_stream, feed_idx, 0, keepdims=False)
+            x = jnp.where(rank == 0, feed, recv)
+            # at step t, rank r is processing microbatch t - r (clamped over
+            # the fill/drain bubbles) — pick that microbatch's pad mask
+            own_idx = jnp.clip(t - rank, 0, n_micro - 1)
+            pm = lax.dynamic_index_in_dim(local_pads, own_idx, 0, keepdims=False)
+            y = _stage_apply(local_layers, cfg, x, positions, cos, sin, pm)
+            out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            valid = (t >= n_stages - 1) & (rank == n_stages - 1)
+            cur = lax.dynamic_index_in_dim(out, out_idx, 0, keepdims=False)
+            out = lax.dynamic_update_index_in_dim(
+                out, jnp.where(valid, y, cur), out_idx, 0
+            )
+            return (y, out), None
+
+        zero = jnp.zeros_like(local_stream[0])
+        out0 = jnp.zeros_like(local_stream)
+        (_, out), _ = lax.scan(step, (zero, out0), jnp.arange(n_steps))
+        # only the last rank holds real outputs; broadcast across pp
+        out = jnp.where(rank == n_stages - 1, out, jnp.zeros_like(out))
+        return lax.psum(out, AXIS_PP)
+
+    fn = jax.shard_map(
+        per_rank,
+        mesh=mesh,
+        in_specs=(P(AXIS_PP), P(), P()),
+        out_specs=P(),
+        axis_names={AXIS_PP},
+        check_vma=False,
+    )
+    return fn(stacked_layers, stream, pad_stream)
+
+
+def pipeline_loss(
+    stacked: dict,
+    cfg: LlamaConfig,
+    ids: Array,
+    mask: Array,
+    mesh: Mesh,
+    n_micro: int = 2,
+) -> Array:
+    """Mean next-token cross-entropy computed through the layer pipeline —
+    the pp analogue of models/llama.py ``llama_loss``. ids/mask [B, T+1];
+    B must divide into n_micro microbatches."""
+    dt = cfg.jdtype
+    inp, tgt = ids[:, :-1], ids[:, 1:]
+    pm = mask[:, :-1]
+    b, t = inp.shape
+    if b % n_micro != 0:
+        raise PipelineError(f"batch {b} not divisible by n_micro={n_micro}")
+    mb = b // n_micro
+
+    positions = jnp.broadcast_to(jnp.arange(t)[None, :], (mb, t))
+    cos, sin = L.rope_frequencies(cfg.head_dim, max(t, cfg.max_len), cfg.rope_theta)
+
+    x = L.embed(stacked["embed_tokens"], inp, dt)            # [B, T, D]
+    stream = x.reshape(n_micro, mb, t, cfg.dim)
+    pad_stream = pm.reshape(n_micro, mb, t)
+
+    out = pipeline_apply(stacked["layers"], cfg, stream, positions, cos, sin,
+                         mesh, pad_stream)
+    h = out.reshape(b, t, cfg.dim)
+    h = L.rmsnorm(stacked["final_norm"], h, cfg.norm_eps)
+    logits = L.dense(stacked["lm_head"], h, dt).astype(jnp.float32)
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, tgt[:, :, None], axis=-1)[..., 0]
+    weights = mask[:, 1:].astype(jnp.float32)
+    return (nll * weights).sum() / jnp.maximum(weights.sum(), 1.0)

@@ -27,11 +27,11 @@ from jax.sharding import PartitionSpec as P
 class TestMesh:
     def test_resolve_defaults_all_dp(self):
         spec = resolve_spec(MeshConfig(), 8)
-        assert spec.shape == (1, 8, 1, 1)
+        assert spec.shape == (1, 8, 1, 1, 1, 1)
 
     def test_resolve_tp(self):
         spec = resolve_spec(MeshConfig(tp_size=4), 8)
-        assert spec.shape == (1, 2, 1, 4)
+        assert spec.shape == (1, 2, 1, 1, 1, 4)
 
     def test_resolve_rejects_indivisible(self):
         with pytest.raises(MeshError):
@@ -43,7 +43,7 @@ class TestMesh:
 
     def test_build_mesh_axes(self):
         mesh = build_mesh(MeshConfig(tp_size=2, sp_size=2))
-        assert dict(mesh.shape) == {"dcn": 1, "dp": 2, "sp": 2, "tp": 2}
+        assert dict(mesh.shape) == {"dcn": 1, "dp": 2, "pp": 1, "ep": 1, "sp": 2, "tp": 2}
         assert batch_multiple(mesh) == 2
 
     def test_mesh_uses_all_devices(self):
