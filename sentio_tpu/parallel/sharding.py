@@ -16,7 +16,7 @@ from typing import Any, Optional, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sentio_tpu.parallel.mesh import AXIS_DCN, AXIS_DP, AXIS_TP
+from sentio_tpu.parallel.mesh import AXIS_DCN, AXIS_DP, AXIS_EP, AXIS_TP
 
 # (path regex, PartitionSpec). First match wins; unmatched params replicate.
 # Param paths are "/"-joined pytree key paths, e.g. "layers_0/attn/wq/kernel".
@@ -35,6 +35,17 @@ LLAMA_TP_RULES: Rules = (
     # norms replicate
     (r".*norm.*", P()),
 )
+
+# MoE decoder: attention follows the Llama layout; expert-indexed weights
+# shard experts over ``ep`` on the leading dim (expert parallelism — the
+# dispatch/combine einsums become all_to_all-style collectives) and keep the
+# Megatron column/row split on the per-expert matmul dims over ``tp``. The
+# router is a tiny [d, E] projection — replicated.
+MOE_EP_RULES: Rules = (
+    (r".*moe/router/kernel$", P()),
+    (r".*moe/(w_gate|w_up)$", P(AXIS_EP, None, AXIS_TP)),
+    (r".*moe/w_down$", P(AXIS_EP, AXIS_TP, None)),
+) + tuple(LLAMA_TP_RULES)
 
 ENCODER_TP_RULES: Rules = (
     (r".*embed(_tokens|_positions)?/embedding$", P(None, None)),
