@@ -85,6 +85,34 @@ def _percentile(vals, q):
     return vals[min(int(len(vals) * q), len(vals) - 1)]
 
 
+def phase_0_rtt():
+    """Raw host↔device round-trip cost: dispatch a trivial jitted op on a
+    1-element array and fetch the result. Through a remote-attached chip
+    this is ~RTT of the tunnel and bounds every per-tick/per-fetch cost in
+    the phases below; on a locally attached chip it is sub-ms. Published so
+    a slow-tunnel day is visible IN the artifact instead of silently
+    inflating every latency number."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.zeros((1,), jnp.float32)
+    np.asarray(f(x))  # compile
+    samples = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        samples.append((time.perf_counter() - t0) * 1000.0)
+    out = {
+        "device_rtt_p50_ms": round(_percentile(samples, 0.50), 1),
+        "device_rtt_min_ms": round(min(samples), 1),
+    }
+    log(f"phase 0: device round-trip p50={out['device_rtt_p50_ms']}ms "
+        f"min={out['device_rtt_min_ms']}ms")
+    return out
+
+
 def phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
                 new_tokens, concurrency):
     """Full graph with paged continuous batching, N concurrent clients."""
@@ -425,6 +453,7 @@ def main() -> None:
 
     devices = jax.devices()
     log(f"devices: {len(devices)} x {devices[0].platform} ({devices[0].device_kind})")
+    rtt = phase_0_rtt()
 
     if fast:
         enc_cfg = EncoderConfig.tiny()
@@ -478,6 +507,7 @@ def main() -> None:
         # same corpus/queries (a LOWER bound for the reference — zero RTT,
         # zero model compute)
         "vs_baseline": round(baseline["p50_ms"] / max(rag["p50_ms"], 1e-9), 3),
+        **rtt,
         "rag": rag,
         "baseline": baseline,
         **({"baseline_wan": baseline_wan} if baseline_wan else {}),

@@ -146,12 +146,11 @@ def route_topk(
     # renormalize kept gates so each token's expert mix sums to 1
     combine = combine / jnp.maximum(gate_total[:, None, None], 1e-9)
 
-    # Switch aux loss over REAL tokens only: E * sum_e (fraction routed to
-    # e) * (mean router prob of e)
+    # Switch aux loss over REAL tokens only: E * sum_e (fraction ASSIGNED to
+    # e, pre-drop — capacity clipping must not cap the imbalance signal) *
+    # (mean router prob of e)
     n_valid = jnp.maximum(vmask.sum(), 1.0)
-    frac = dispatch.any(-1).astype(jnp.float32).sum(0) / jnp.maximum(
-        dispatch.any(-1).astype(jnp.float32).sum(), 1.0
-    )
+    frac = counts / jnp.maximum(counts.sum(), 1.0)
     mean_prob = (probs * vmask[:, None]).sum(0) / n_valid
     aux = (frac * mean_prob).sum() * e
     return dispatch, combine, aux

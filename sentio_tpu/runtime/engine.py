@@ -52,13 +52,21 @@ class GeneratorEngine:
         tokenizer=None,
         mesh=None,
         rng_seed: int = 0,
+        forward_fn=None,
+        sharding_rules=None,
     ) -> None:
+        """``forward_fn`` swaps the model family behind the serving seams:
+        any fn with ``llama_forward``'s (params, cfg, ids, positions, cache,
+        cache_index, pad_mask, attn_fn) → (logits, cache) contract — e.g.
+        ``models.moe.moe_serving_forward`` for expert-routed checkpoints
+        (pair it with ``sharding_rules=MOE_EP_RULES`` under a mesh)."""
         import jax
 
         from sentio_tpu.models.llama import init_llama
         from sentio_tpu.models.tokenizer import ByteTokenizer
 
         self.config = config or get_settings().generator
+        explicit_params = params
         if params is None and self.config.checkpoint_path:
             # real weights: a `cli convert llama` checkpoint + HF tokenizer
             from sentio_tpu.runtime.weights import load_model
@@ -78,8 +86,22 @@ class GeneratorEngine:
         if mesh is not None:
             from sentio_tpu.parallel.sharding import LLAMA_TP_RULES, shard_params
 
-            params = shard_params(params, mesh, LLAMA_TP_RULES)
+            rules = sharding_rules if sharding_rules is not None else LLAMA_TP_RULES
+            params = shard_params(params, mesh, rules)
         self.params = params
+        if forward_fn is None:
+            from sentio_tpu.models.llama import llama_forward
+
+            forward_fn = llama_forward
+        elif explicit_params is None:
+            # init_llama / the llama checkpoint loader produced dense params
+            # above — a non-default family would KeyError deep inside jit
+            raise ValueError(
+                "forward_fn overrides the model family; pass matching params "
+                "explicitly (the default init/checkpoint paths build dense "
+                "Llama trees)"
+            )
+        self.forward_fn = forward_fn
         self._rng = jax.random.PRNGKey(rng_seed + 17)
         self._build_fns()
 
@@ -89,8 +111,9 @@ class GeneratorEngine:
         import jax
         import jax.numpy as jnp
 
-        from sentio_tpu.models.llama import llama_forward
         from sentio_tpu.runtime.sampling import sample_tokens
+
+        llama_forward = self.forward_fn  # model-family seam (see __init__)
 
         cfg = self.model_config
         # Pallas flash attention for the prefill pass (the multi-token causal
@@ -122,10 +145,13 @@ class GeneratorEngine:
                     return L.attention(q, k, v, mask, q.dtype)
 
         @jax.jit
-        def prefill(params, ids, positions, cache):
+        def prefill(params, ids, positions, cache, pad_mask):
+            # pad_mask marks real (row, token) cells: llama ignores it on the
+            # cache path, routed families (MoE) need it so padding claims no
+            # expert capacity
             logits, cache = llama_forward(
                 params, cfg, ids, positions=positions, cache=cache, cache_index=0,
-                attn_fn=attn_fn,
+                pad_mask=pad_mask, attn_fn=attn_fn,
             )
             return logits, cache
 
@@ -162,7 +188,7 @@ class GeneratorEngine:
 
         @partial(jax.jit, static_argnames=("steps", "top_k", "eos_id"))
         def generate_fused(params, ids, positions, lens, cache, rng, temperature,
-                           steps, top_k, eos_id):
+                           steps, top_k, eos_id, pad_mask):
             """Prefill + first-token sample + the whole decode scan as ONE
             compiled program. The bulk path dispatches this once and fetches
             one output — on remote-attached devices every extra blocking
@@ -170,8 +196,9 @@ class GeneratorEngine:
             tunnel), which dwarfs the actual compute at serving batch sizes."""
             logits, cache = llama_forward(
                 params, cfg, ids, positions=positions, cache=cache, cache_index=0,
-                attn_fn=attn_fn,
+                pad_mask=pad_mask, attn_fn=attn_fn,
             )
+            row_valid = pad_mask.any(axis=1, keepdims=True)  # junk bucket rows
             last = jnp.take_along_axis(logits, (lens - 1)[:, None, None], axis=1)[:, 0]
             rng, sub = jax.random.split(rng)
             first = sample_tokens(last, sub, temperature, top_k=top_k)
@@ -180,7 +207,7 @@ class GeneratorEngine:
                 tok, lens, cache, rng, done = carry
                 logits, cache = llama_forward(
                     params, cfg, tok[:, None], positions=lens[:, None],
-                    cache=cache, cache_index=lens,
+                    cache=cache, cache_index=lens, pad_mask=row_valid,
                 )
                 rng, sub = jax.random.split(rng)
                 nxt = sample_tokens(logits[:, -1], sub, temperature, top_k=top_k)
@@ -221,6 +248,12 @@ class GeneratorEngine:
         ids = np.pad(ids, ((0, rows - n), (0, width - ids.shape[1])),
                      constant_values=self.tokenizer.pad_id)
         lens = np.pad(lens, (0, rows - n), constant_values=1)
+        # real (row, token) cells: padding tails AND junk bucket rows are
+        # False — llama ignores this on the cache path, routed families use
+        # it to keep padding out of expert capacity
+        pad_mask = (np.arange(width)[None, :] < lens[:, None]) & (
+            np.arange(rows) < n
+        )[:, None]
 
         window = min(
             self.model_config.max_len,
@@ -239,7 +272,7 @@ class GeneratorEngine:
         # ids/positions/lens stay HOST numpy: host math on them (lens.max(),
         # per-row slicing) must not trigger device round trips; they ride to
         # the device as jit-call args (async, no blocking device_put)
-        return ids, positions.copy(), lens, cache, n, window
+        return ids, positions.copy(), lens, cache, n, window, pad_mask
 
     STEP_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 
@@ -292,7 +325,7 @@ class GeneratorEngine:
         t0 = time.perf_counter()
         max_new = max_new_tokens or self.config.max_new_tokens
         temp = self.config.temperature() if temperature is None else temperature
-        ids, positions, lens, cache, n, window = self._encode_batch(prompts, max_new)
+        ids, positions, lens, cache, n, window, pad_mask = self._encode_batch(prompts, max_new)
         max_new = self._stable_steps(max_new, window - int(lens.max()))
 
         # one dispatch, one fetch: prefill + sampling + decode scan fused
@@ -300,6 +333,7 @@ class GeneratorEngine:
         toks = np.asarray(self._generate_fused(
             self.params, ids, positions, lens, cache, sub,
             jnp.asarray(temp, jnp.float32), max_new, top_k, self.tokenizer.eos_id,
+            pad_mask,
         ))
         dt_ms = (time.perf_counter() - t0) * 1000.0
 
@@ -336,10 +370,10 @@ class GeneratorEngine:
 
         max_new = max_new_tokens or self.config.max_new_tokens
         temp = self.config.temperature() if temperature is None else temperature
-        ids, positions, lens, cache, _, window = self._encode_batch([prompt], max_new)
+        ids, positions, lens, cache, _, window, pad_mask = self._encode_batch([prompt], max_new)
         max_new = self._stable_steps(max_new, window - int(lens.max()))
 
-        logits, cache = self._prefill(self.params, ids, positions, cache)
+        logits, cache = self._prefill(self.params, ids, positions, cache, pad_mask)
         last = jnp.take_along_axis(logits, (lens - 1)[:, None, None], axis=1)[:, 0]
         from sentio_tpu.runtime.sampling import sample_tokens
 
