@@ -166,27 +166,6 @@ class GeneratorEngine:
             return nxt, cache, rng
 
         @partial(jax.jit, static_argnames=("steps", "top_k", "eos_id"))
-        def decode_loop(params, first_tok, lens, cache, rng, temperature, steps, top_k, eos_id):
-            """Bulk loop, fully on device: scan over steps with done-masking."""
-            b = first_tok.shape[0]
-
-            def body(carry, _):
-                tok, lens, cache, rng, done = carry
-                logits, cache = llama_forward(
-                    params, cfg, tok[:, None], positions=lens[:, None],
-                    cache=cache, cache_index=lens,
-                )
-                rng, sub = jax.random.split(rng)
-                nxt = sample_tokens(logits[:, -1], sub, temperature, top_k=top_k)
-                nxt = jnp.where(done, eos_id, nxt)
-                done = done | (nxt == eos_id)
-                return (nxt, lens + 1, cache, rng, done), nxt
-
-            init = (first_tok, lens, cache, rng, jnp.zeros(b, bool))
-            (_, _, cache, _, _), toks = jax.lax.scan(body, init, None, length=steps)
-            return jnp.moveaxis(toks, 0, 1), cache  # [B, steps]
-
-        @partial(jax.jit, static_argnames=("steps", "top_k", "eos_id"))
         def generate_fused(params, ids, positions, lens, cache, rng, temperature,
                            steps, top_k, eos_id, pad_mask):
             """Prefill + first-token sample + the whole decode scan as ONE
@@ -205,9 +184,12 @@ class GeneratorEngine:
 
             def body(carry, _):
                 tok, lens, cache, rng, done = carry
+                # done rows leave routing too — a finished row must not keep
+                # claiming expert capacity from live rows
                 logits, cache = llama_forward(
                     params, cfg, tok[:, None], positions=lens[:, None],
-                    cache=cache, cache_index=lens, pad_mask=row_valid,
+                    cache=cache, cache_index=lens,
+                    pad_mask=row_valid & ~done[:, None],
                 )
                 rng, sub = jax.random.split(rng)
                 nxt = sample_tokens(logits[:, -1], sub, temperature, top_k=top_k)
@@ -225,7 +207,6 @@ class GeneratorEngine:
 
         self._prefill = prefill
         self._decode_step = decode_step
-        self._decode_loop = decode_loop
         self._generate_fused = generate_fused
 
     # --------------------------------------------------------------- helpers

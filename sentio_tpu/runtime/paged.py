@@ -207,8 +207,19 @@ def paged_decode_forward(params, cfg: LlamaConfig, tok, lens, page_table, k_page
         x = x + L.dense(lp["attn"]["wo"], out.reshape(b, 1, cfg.dim), dt)
 
         xm = L.rmsnorm(lp["mlp_norm"], x, cfg.norm_eps)
-        gate = jax.nn.silu(L.dense(lp["mlp"]["w_gate"], xm, dt))
-        x = x + L.dense(lp["mlp"]["w_down"], gate * L.dense(lp["mlp"]["w_up"], xm, dt), dt)
+        if "moe" in lp:
+            # routed-expert family (models/moe.py): frozen/free rows are
+            # masked out of routing so they claim no expert capacity
+            from sentio_tpu.models.moe import moe_mlp
+
+            routed, _ = moe_mlp(
+                lp["moe"], cfg, xm,
+                None if write_mask is None else write_mask[:, None],
+            )
+            x = x + routed
+        else:
+            gate = jax.nn.silu(L.dense(lp["mlp"]["w_gate"], xm, dt))
+            x = x + L.dense(lp["mlp"]["w_down"], gate * L.dense(lp["mlp"]["w_up"], xm, dt), dt)
 
     x = L.rmsnorm(params["final_norm"], x, cfg.norm_eps)
     logits = L.dense(params["lm_head"], x, dt)[:, 0]
@@ -302,7 +313,12 @@ class ContinuousBatchingEngine:
         ignore_eos: bool = False,
         pipeline_depth: int = 1,
         mesh=None,
+        forward_fn=None,
     ) -> None:
+        """``forward_fn`` swaps the prefill model family (llama_forward
+        contract); the fused decode tick detects the family per layer (a
+        ``moe`` subtree routes through models/moe.py). See
+        runtime/engine.py's identical seam."""
         import jax
 
         from sentio_tpu.models.llama import init_llama
@@ -310,9 +326,18 @@ class ContinuousBatchingEngine:
 
         self.cfg = model_config or LlamaConfig.tiny()
         self.tokenizer = tokenizer or ByteTokenizer(self.cfg.vocab_size)
+        if forward_fn is not None and params is None:
+            raise ValueError(
+                "forward_fn overrides the model family; pass matching params"
+            )
         self.params = params if params is not None else init_llama(
             jax.random.PRNGKey(rng_seed), self.cfg
         )
+        if forward_fn is None:
+            from sentio_tpu.models.llama import llama_forward
+
+            forward_fn = llama_forward
+        self.forward_fn = forward_fn
         self.max_slots = max_slots
         self.page_size = page_size
         self.max_pages_per_seq = max_pages_per_seq
@@ -389,6 +414,7 @@ class ContinuousBatchingEngine:
 
         cfg = self.cfg
         attn_impl = self._attn_impl
+        forward_fn = self.forward_fn
         eos_id = self.tokenizer.eos_id
 
         ignore_eos = self.ignore_eos
@@ -460,13 +486,17 @@ class ContinuousBatchingEngine:
             """Batched admission in ONE dispatch: contiguous prefill forward,
             cache scatter into each row's pages, first-token sample from each
             row's last prompt logit. Pad rows scatter to scratch page 0."""
-            from sentio_tpu.models.llama import init_cache, llama_forward
+            from sentio_tpu.models.llama import init_cache
             from sentio_tpu.runtime.sampling import sample_tokens
 
             b, width = ids.shape
             cache = init_cache(cfg, b, width)
-            logits, cache = llama_forward(
-                params, cfg, ids, positions=positions, cache=cache, cache_index=0
+            # pad tails and junk admission rows must not claim routed-expert
+            # capacity (llama ignores the mask on the cache path)
+            pad_mask = jnp.arange(width)[None, :] < lens[:, None]
+            logits, cache = forward_fn(
+                params, cfg, ids, positions=positions, cache=cache, cache_index=0,
+                pad_mask=pad_mask,
             )
             k_pages, v_pages = scatter_prefill(
                 k_pages, v_pages, cache["k"], cache["v"], scat

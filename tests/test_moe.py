@@ -204,6 +204,45 @@ class TestMoeServing:
         assert len(streamed) >= 1
 
 
+    def test_paged_engine_serves_moe(self, cfg):
+        """The DEFAULT serving path (paged continuous batching) runs MoE:
+        fused decode ticks route per layer, prefill goes through the family
+        seam. Ample capacity makes routing batch-size-independent, so paged
+        greedy must match the dense engine exactly (with tight capacity the
+        two are both valid but can drop different tokens, since capacity is
+        a function of the tokens-per-call)."""
+        from sentio_tpu.config import GeneratorConfig
+        from sentio_tpu.models.moe import init_moe, moe_serving_forward
+        from sentio_tpu.runtime.engine import GeneratorEngine
+        from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+
+        acfg = replace(cfg, capacity_factor=8.0)
+        params = init_moe(jax.random.PRNGKey(0), acfg)
+        prompts = ["routed experts on pages", "second request here"]
+
+        paged = ContinuousBatchingEngine(
+            model_config=acfg, params=params, forward_fn=moe_serving_forward,
+            max_slots=4, page_size=16, max_pages_per_seq=8, steps_per_tick=4,
+        )
+        res = paged.run_all(prompts, max_new_tokens=8, temperature=0.0)
+
+        eng = GeneratorEngine(
+            config=GeneratorConfig(model_preset="tiny", max_new_tokens=8),
+            model_config=acfg, params=params, forward_fn=moe_serving_forward,
+        )
+        dense = eng.generate(prompts, max_new_tokens=8, temperature=0.0)
+        assert [r.tokens for r in res] == [r.tokens for r in dense]
+
+    def test_paged_engine_rejects_family_without_params(self, cfg):
+        from sentio_tpu.models.moe import moe_serving_forward
+        from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+
+        with pytest.raises(ValueError, match="matching params"):
+            ContinuousBatchingEngine(
+                model_config=cfg, forward_fn=moe_serving_forward
+            )
+
+
 class TestExpertParallel:
     def test_ep_sharded_loss_matches(self, params, cfg):
         rng = np.random.default_rng(5)
