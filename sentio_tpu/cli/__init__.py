@@ -108,6 +108,8 @@ def _cmd_convert(args: argparse.Namespace) -> int:
 
     if args.family == "llama":
         params, cfg = C.load_llama_dir(args.src, dtype=args.dtype)
+    elif args.family == "moe":
+        params, cfg = C.load_moe_dir(args.src, dtype=args.dtype)
     elif args.family == "encoder":
         params, cfg = C.load_encoder_dir(args.src, dtype=args.dtype)
     elif args.family == "cross-encoder":
@@ -198,7 +200,7 @@ def main(argv: list[str] | None = None) -> int:
     p_trace.set_defaults(fn=_cmd_trace)
 
     p_conv = sub.add_parser("convert", help="convert a local HF checkpoint dir")
-    p_conv.add_argument("family", choices=["llama", "encoder", "cross-encoder"])
+    p_conv.add_argument("family", choices=["llama", "moe", "encoder", "cross-encoder"])
     p_conv.add_argument("src", help="HF checkpoint directory (config.json + weights)")
     p_conv.add_argument("dst", help="output framework checkpoint directory")
     p_conv.add_argument("--dtype", default="bfloat16")

@@ -98,9 +98,9 @@ def _linear(sd: Mapping[str, Any], prefix: str, with_bias: bool = True) -> dict:
 # ---------------------------------------------------------------- Llama LM
 
 
-def llama_config_from_hf(hf_cfg: Any, dtype: str = "bfloat16") -> LlamaConfig:
-    """transformers.LlamaConfig (or compatible) → LlamaConfig."""
-    return LlamaConfig(
+def _decoder_kwargs_from_hf(hf_cfg: Any, dtype: str) -> dict:
+    """Field mappings shared by every HF decoder family (llama, mixtral)."""
+    return dict(
         vocab_size=hf_cfg.vocab_size,
         dim=hf_cfg.hidden_size,
         n_layers=hf_cfg.num_hidden_layers,
@@ -112,6 +112,21 @@ def llama_config_from_hf(hf_cfg: Any, dtype: str = "bfloat16") -> LlamaConfig:
         dtype=dtype,
         norm_eps=getattr(hf_cfg, "rms_norm_eps", 1e-5),
     )
+
+
+def llama_config_from_hf(hf_cfg: Any, dtype: str = "bfloat16") -> LlamaConfig:
+    """transformers.LlamaConfig (or compatible) → LlamaConfig."""
+    return LlamaConfig(**_decoder_kwargs_from_hf(hf_cfg, dtype))
+
+
+def _attn_block(sd: Mapping[str, Any], p: str) -> dict:
+    """Per-layer attention projections shared by every HF decoder family."""
+    return {
+        "wq": _linear(sd, f"{p}.self_attn.q_proj", with_bias=False),
+        "wk": _linear(sd, f"{p}.self_attn.k_proj", with_bias=False),
+        "wv": _linear(sd, f"{p}.self_attn.v_proj", with_bias=False),
+        "wo": _linear(sd, f"{p}.self_attn.o_proj", with_bias=False),
+    }
 
 
 def convert_llama(state_dict: Mapping[str, Any], cfg: LlamaConfig) -> dict:
@@ -135,12 +150,7 @@ def convert_llama(state_dict: Mapping[str, Any], cfg: LlamaConfig) -> dict:
         p = f"model.layers.{i}"
         params[f"layers_{i}"] = {
             "attn_norm": {"scale": _np(sd[f"{p}.input_layernorm.weight"])},
-            "attn": {
-                "wq": _linear(sd, f"{p}.self_attn.q_proj", with_bias=False),
-                "wk": _linear(sd, f"{p}.self_attn.k_proj", with_bias=False),
-                "wv": _linear(sd, f"{p}.self_attn.v_proj", with_bias=False),
-                "wo": _linear(sd, f"{p}.self_attn.o_proj", with_bias=False),
-            },
+            "attn": _attn_block(sd, p),
             "mlp_norm": {"scale": _np(sd[f"{p}.post_attention_layernorm.weight"])},
             "mlp": {
                 "w_gate": _linear(sd, f"{p}.mlp.gate_proj", with_bias=False),
@@ -165,6 +175,103 @@ def _check_shapes_llama(params: dict, cfg: LlamaConfig) -> None:
     wk = params["layers_0"]["attn"]["wk"]["kernel"].shape
     if wk != (cfg.dim, kv_dim):
         raise ConversionError(f"layers_0.attn.wk: shape {wk}, expected {(cfg.dim, kv_dim)}")
+
+
+# ------------------------------------------------------------ Mixtral (MoE)
+
+
+def moe_config_from_hf(hf_cfg: Any, dtype: str = "bfloat16") -> "MoeConfig":
+    """transformers.MixtralConfig (or compatible) → MoeConfig."""
+    from sentio_tpu.models.moe import MoeConfig
+
+    return MoeConfig(
+        **_decoder_kwargs_from_hf(hf_cfg, dtype),
+        n_experts=getattr(hf_cfg, "num_local_experts", 8),
+        experts_per_token=getattr(hf_cfg, "num_experts_per_tok", 2),
+    )
+
+
+def convert_moe(state_dict: Mapping[str, Any], cfg: "MoeConfig") -> dict:
+    """``MixtralForCausalLM.state_dict()`` → params for ``moe_forward``.
+
+    HF stores each expert's SwiGLU as w1 (gate, [f, d]), w3 (up, [f, d]),
+    w2 (down, [d, f]) and the router as ``block_sparse_moe.gate`` ([E, d]);
+    here experts stack on a leading dim ([E, in, out], the ``ep`` sharding
+    axis) and all matmuls are input-major, so every tensor transposes.
+    """
+    sd = state_dict
+    embed = _np(sd["model.embed_tokens.weight"])
+    if "lm_head.weight" in sd:
+        lm_head = _np(sd["lm_head.weight"]).T.copy()
+    else:  # tied embeddings
+        lm_head = embed.T.copy()
+    params: dict = {
+        "embed_tokens": {"embedding": embed},
+        "lm_head": {"kernel": lm_head},
+        "final_norm": {"scale": _np(sd["model.norm.weight"])},
+    }
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}"
+        moe_p = f"{p}.block_sparse_moe"
+        params[f"layers_{i}"] = {
+            "attn_norm": {"scale": _np(sd[f"{p}.input_layernorm.weight"])},
+            "attn": _attn_block(sd, p),
+            "mlp_norm": {"scale": _np(sd[f"{p}.post_attention_layernorm.weight"])},
+            "moe": {
+                "router": {"kernel": _np(sd[f"{moe_p}.gate.weight"]).T.copy()},
+                "w_gate": np.stack([
+                    _np(sd[f"{moe_p}.experts.{e}.w1.weight"]).T
+                    for e in range(cfg.n_experts)
+                ]),
+                "w_up": np.stack([
+                    _np(sd[f"{moe_p}.experts.{e}.w3.weight"]).T
+                    for e in range(cfg.n_experts)
+                ]),
+                "w_down": np.stack([
+                    _np(sd[f"{moe_p}.experts.{e}.w2.weight"]).T
+                    for e in range(cfg.n_experts)
+                ]),
+            },
+        }
+    _check_shapes_moe(params, cfg)
+    return params
+
+
+def _check_shapes_moe(params: dict, cfg: "MoeConfig") -> None:
+    want = {
+        ("embed_tokens", "embedding"): (cfg.vocab_size, cfg.dim),
+        ("lm_head", "kernel"): (cfg.dim, cfg.vocab_size),
+    }
+    for path, shape in want.items():
+        got = params[path[0]][path[1]].shape
+        if tuple(got) != shape:
+            raise ConversionError(f"{'.'.join(path)}: shape {got}, expected {shape}")
+    moe = params["layers_0"]["moe"]
+    if moe["router"]["kernel"].shape != (cfg.dim, cfg.n_experts):
+        raise ConversionError(
+            f"layers_0.moe.router: shape {moe['router']['kernel'].shape}, "
+            f"expected {(cfg.dim, cfg.n_experts)}"
+        )
+    if moe["w_gate"].shape != (cfg.n_experts, cfg.dim, cfg.mlp_dim):
+        raise ConversionError(
+            f"layers_0.moe.w_gate: shape {moe['w_gate'].shape}, "
+            f"expected {(cfg.n_experts, cfg.dim, cfg.mlp_dim)}"
+        )
+    if moe["w_down"].shape != (cfg.n_experts, cfg.mlp_dim, cfg.dim):
+        raise ConversionError(
+            f"layers_0.moe.w_down: shape {moe['w_down'].shape}, "
+            f"expected {(cfg.n_experts, cfg.mlp_dim, cfg.dim)}"
+        )
+
+
+def load_moe_dir(model_dir: str | Path, dtype: str = "bfloat16"):
+    """Local Mixtral-family checkpoint directory → (params, config)."""
+    from transformers import AutoConfig
+
+    hf_cfg = AutoConfig.from_pretrained(str(model_dir), local_files_only=True)
+    cfg = moe_config_from_hf(hf_cfg, dtype=dtype)
+    params = cast_tree(convert_moe(load_state_dict(model_dir), cfg), dtype)
+    return params, cfg
 
 
 # ------------------------------------------------------- BERT/XLM-R encoder

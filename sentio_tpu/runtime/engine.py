@@ -67,39 +67,68 @@ class GeneratorEngine:
 
         self.config = config or get_settings().generator
         explicit_params = params
+        from_checkpoint = False
         if params is None and self.config.checkpoint_path:
-            # real weights: a `cli convert llama` checkpoint + HF tokenizer
-            from sentio_tpu.runtime.weights import load_model
+            # real weights: a `cli convert` checkpoint + HF tokenizer; the
+            # family rides the checkpoint meta (llama or moe) and the
+            # matching forward_fn is auto-selected from the restored config
+            from sentio_tpu.runtime.weights import WeightsError, load_model
 
             params, model_config, ck_tok = load_model(
-                self.config.checkpoint_path, expect_family="llama",
+                self.config.checkpoint_path,
                 tokenizer_path=self.config.tokenizer_path,
             )
+            if not isinstance(model_config, LlamaConfig):
+                raise WeightsError(
+                    f"checkpoint {self.config.checkpoint_path!r} holds a "
+                    f"{type(model_config).__name__} model — the generator "
+                    "engine serves decoder families (llama, moe)"
+                )
             tokenizer = tokenizer or ck_tok
+            from_checkpoint = True
         self.model_config = model_config or (
             LlamaConfig.tiny() if self.config.model_preset == "tiny" else LlamaConfig.llama3_8b()
         )
         self.tokenizer = tokenizer or ByteTokenizer(self.model_config.vocab_size)
         self.mesh = mesh
-        if params is None:
-            params = init_llama(jax.random.PRNGKey(rng_seed), self.model_config)
-        if mesh is not None:
-            from sentio_tpu.parallel.sharding import LLAMA_TP_RULES, shard_params
+        from sentio_tpu.models.llama import llama_forward
+        from sentio_tpu.models.moe import MoeConfig, moe_serving_forward
 
-            rules = sharding_rules if sharding_rules is not None else LLAMA_TP_RULES
+        is_moe = isinstance(self.model_config, MoeConfig)
+        if params is None:
+            # random-init at the config's family (the fake-model test mode)
+            if is_moe:
+                from sentio_tpu.models.moe import init_moe
+
+                params = init_moe(jax.random.PRNGKey(rng_seed), self.model_config)
+            else:
+                params = init_llama(jax.random.PRNGKey(rng_seed), self.model_config)
+        if mesh is not None:
+            from sentio_tpu.parallel.sharding import (
+                LLAMA_TP_RULES,
+                MOE_EP_RULES,
+                shard_params,
+            )
+
+            default_rules = MOE_EP_RULES if is_moe else LLAMA_TP_RULES
+            rules = sharding_rules if sharding_rules is not None else default_rules
             params = shard_params(params, mesh, rules)
         self.params = params
         if forward_fn is None:
-            from sentio_tpu.models.llama import llama_forward
-
-            forward_fn = llama_forward
-        elif explicit_params is None:
-            # init_llama / the llama checkpoint loader produced dense params
-            # above — a non-default family would KeyError deep inside jit
+            forward_fn = moe_serving_forward if is_moe else llama_forward
+        elif forward_fn in (moe_serving_forward, llama_forward):
+            # the two in-tree families are cheap to cross-check
+            if (forward_fn is moe_serving_forward) != is_moe:
+                raise ValueError(
+                    f"forward_fn {forward_fn.__name__} does not match the "
+                    f"{type(self.model_config).__name__} model family"
+                )
+        elif explicit_params is None and not from_checkpoint:
+            # a custom family's fn against default-initialized params would
+            # KeyError deep inside jit
             raise ValueError(
                 "forward_fn overrides the model family; pass matching params "
-                "explicitly (the default init/checkpoint paths build dense "
-                "Llama trees)"
+                "explicitly (the default init builds the config family's tree)"
             )
         self.forward_fn = forward_fn
         self._rng = jax.random.PRNGKey(rng_seed + 17)

@@ -326,17 +326,32 @@ class ContinuousBatchingEngine:
 
         self.cfg = model_config or LlamaConfig.tiny()
         self.tokenizer = tokenizer or ByteTokenizer(self.cfg.vocab_size)
-        if forward_fn is not None and params is None:
-            raise ValueError(
-                "forward_fn overrides the model family; pass matching params"
-            )
-        self.params = params if params is not None else init_llama(
-            jax.random.PRNGKey(rng_seed), self.cfg
-        )
-        if forward_fn is None:
-            from sentio_tpu.models.llama import llama_forward
+        from sentio_tpu.models.llama import llama_forward
+        from sentio_tpu.models.moe import MoeConfig, moe_serving_forward
 
-            forward_fn = llama_forward
+        is_moe = isinstance(self.cfg, MoeConfig)
+        explicit_params = params
+        if params is None:
+            if is_moe:
+                from sentio_tpu.models.moe import init_moe
+
+                params = init_moe(jax.random.PRNGKey(rng_seed), self.cfg)
+            else:
+                params = init_llama(jax.random.PRNGKey(rng_seed), self.cfg)
+        self.params = params
+        if forward_fn is None:
+            forward_fn = moe_serving_forward if is_moe else llama_forward
+        elif forward_fn in (moe_serving_forward, llama_forward):
+            if (forward_fn is moe_serving_forward) != is_moe:
+                raise ValueError(
+                    f"forward_fn {forward_fn.__name__} does not match the "
+                    f"{type(self.cfg).__name__} model family"
+                )
+        elif explicit_params is None:
+            raise ValueError(
+                "forward_fn overrides the model family; pass matching params "
+                "explicitly (the default init builds the config family's tree)"
+            )
         self.forward_fn = forward_fn
         self.max_slots = max_slots
         self.page_size = page_size

@@ -29,6 +29,7 @@ logger = logging.getLogger(__name__)
 
 _FAMILY_CONFIGS = {
     "llama": ("sentio_tpu.models.llama", "LlamaConfig"),
+    "moe": ("sentio_tpu.models.moe", "MoeConfig"),
     "encoder": ("sentio_tpu.models.transformer", "EncoderConfig"),
     "cross-encoder": ("sentio_tpu.models.transformer", "EncoderConfig"),
 }

@@ -91,6 +91,102 @@ def tiny_hf_bert():
     return model, cfg
 
 
+@pytest.fixture(scope="module")
+def tiny_hf_mixtral():
+    # vocab 512 ≥ ByteTokenizer's 261 floor so the serving round-trip test
+    # can use the default tokenizer
+    cfg = transformers.MixtralConfig(
+        vocab_size=512,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        max_position_embeddings=64,
+        rope_theta=10_000.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.MixtralForCausalLM(cfg).eval()
+    return model, cfg
+
+
+class TestMoeConversion:
+    def test_logits_match_torch(self, tiny_hf_mixtral):
+        """HF Mixtral routes top-k with NO capacity limit; ample capacity
+        makes our dispatch equivalent, so logits must agree."""
+        from dataclasses import replace
+
+        from sentio_tpu.models.convert import convert_moe, moe_config_from_hf
+        from sentio_tpu.models.moe import moe_forward
+
+        model, hf_cfg = tiny_hf_mixtral
+        cfg = replace(
+            moe_config_from_hf(hf_cfg, dtype="float32"), capacity_factor=8.0
+        )
+        params = convert_moe(model.state_dict(), cfg)
+
+        ids = np.array([[1, 5, 9, 2, 77, 33], [3, 8, 120, 4, 6, 11]], np.int32)
+        with torch.no_grad():
+            ref = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+
+        got, _, _ = moe_forward(params, cfg, jnp.asarray(ids))
+        np.testing.assert_allclose(np.asarray(got), ref, atol=5e-4, rtol=5e-3)
+
+    def test_config_mapping(self, tiny_hf_mixtral):
+        from sentio_tpu.models.convert import moe_config_from_hf
+
+        _, hf_cfg = tiny_hf_mixtral
+        cfg = moe_config_from_hf(hf_cfg)
+        assert cfg.n_experts == 4
+        assert cfg.experts_per_token == 2
+        assert cfg.dim == 32 and cfg.n_kv_heads == 2
+
+    def test_checkpoint_roundtrip_serves(self, tiny_hf_mixtral, tmp_path):
+        """convert → save_pytree → load_model → GeneratorEngine greedy."""
+        from dataclasses import replace
+
+        from sentio_tpu.config import GeneratorConfig
+        from sentio_tpu.models.convert import convert_moe, moe_config_from_hf
+        from sentio_tpu.models.moe import moe_serving_forward
+        from sentio_tpu.runtime.checkpoint import save_pytree
+        from sentio_tpu.runtime.engine import GeneratorEngine
+        from sentio_tpu.runtime.weights import load_model
+
+        model, hf_cfg = tiny_hf_mixtral
+        cfg = replace(moe_config_from_hf(hf_cfg, dtype="float32"))
+        params = convert_moe(model.state_dict(), cfg)
+        ck = str(tmp_path / "moe-ck")
+        save_pytree(ck, params, meta={"family": "moe", "config": cfg.__dict__})
+
+        loaded, loaded_cfg, _ = load_model(ck, expect_family="moe")
+        assert loaded_cfg.n_experts == cfg.n_experts
+
+        eng = GeneratorEngine(
+            config=GeneratorConfig(model_preset="tiny", max_new_tokens=6),
+            model_config=loaded_cfg, params=loaded,
+            forward_fn=moe_serving_forward,
+        )
+        out = eng.generate(["hello"], max_new_tokens=6, temperature=0.0)[0]
+        assert len(out.tokens) >= 1
+
+        # config-driven path: checkpoint_path alone must auto-select the
+        # MoE family from the checkpoint meta (no explicit forward_fn)
+        auto = GeneratorEngine(
+            config=GeneratorConfig(
+                model_preset="tiny", max_new_tokens=6, checkpoint_path=ck
+            ),
+        )
+        from sentio_tpu.models.moe import MoeConfig
+
+        assert isinstance(auto.model_config, MoeConfig)
+        assert auto.forward_fn is moe_serving_forward
+        auto_out = auto.generate(["hello"], max_new_tokens=6, temperature=0.0)[0]
+        assert auto_out.tokens == out.tokens
+
+
 class TestEncoderConversion:
     def test_hidden_states_match_torch(self, tiny_hf_bert):
         model, hf_cfg = tiny_hf_bert

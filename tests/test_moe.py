@@ -233,14 +233,31 @@ class TestMoeServing:
         dense = eng.generate(prompts, max_new_tokens=8, temperature=0.0)
         assert [r.tokens for r in res] == [r.tokens for r in dense]
 
-    def test_paged_engine_rejects_family_without_params(self, cfg):
+    def test_engines_reject_family_mismatch(self, cfg):
+        from sentio_tpu.models.llama import LlamaConfig, llama_forward
         from sentio_tpu.models.moe import moe_serving_forward
         from sentio_tpu.runtime.paged import ContinuousBatchingEngine
 
-        with pytest.raises(ValueError, match="matching params"):
+        # moe forward against a dense config
+        with pytest.raises(ValueError, match="does not match"):
             ContinuousBatchingEngine(
-                model_config=cfg, forward_fn=moe_serving_forward
+                model_config=LlamaConfig.tiny(), forward_fn=moe_serving_forward
             )
+        # dense forward against a moe config
+        with pytest.raises(ValueError, match="does not match"):
+            ContinuousBatchingEngine(model_config=cfg, forward_fn=llama_forward)
+
+    def test_moe_config_alone_auto_selects_family(self, cfg):
+        """A MoeConfig with no params random-inits MoE weights and routes —
+        never silently degrades to a dense Llama."""
+        from sentio_tpu.models.moe import moe_serving_forward
+        from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+
+        paged = ContinuousBatchingEngine(
+            model_config=cfg, max_slots=2, page_size=16, max_pages_per_seq=4,
+        )
+        assert paged.forward_fn is moe_serving_forward
+        assert "moe" in paged.params["layers_0"]
 
 
 class TestExpertParallel:
