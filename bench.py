@@ -434,14 +434,66 @@ def phase_d_kernels():
     return out
 
 
+def ensure_live_backend(probe_timeout_s: float = 180.0) -> str:
+    """Probe the default JAX backend in a SUBPROCESS before the parent
+    initializes it. A remote-attached chip whose tunnel is wedged hangs the
+    first device call indefinitely — observed in practice: the device served
+    traffic for hours, then dispatch froze mid-session. A hung probe child is
+    killable; a hung parent jax init is not. On failure the parent pins
+    itself to CPU (JAX_PLATFORMS must be set before backend init) so the
+    bench still produces an artifact, marked ``device_fallback``."""
+    import subprocess
+
+    accel_expected = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+    if not accel_expected and os.environ.get("JAX_PLATFORMS") == "cpu":
+        return ""  # CPU-pinned smoke/CI runs: nothing to probe, no hang risk
+
+    probe = (
+        "import jax, numpy as np, jax.numpy as jnp\n"
+        "f = jax.jit(lambda x: x + 1.0)\n"
+        "np.asarray(f(jnp.zeros((1,), jnp.float32)))\n"
+        "print(jax.default_backend())\n"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", probe],
+            capture_output=True, text=True, timeout=probe_timeout_s,
+        )
+        backend = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "?"
+        if r.returncode == 0 and not (accel_expected and backend == "cpu"):
+            log(f"backend probe ok: {backend}")
+            return ""
+        if r.returncode == 0:
+            # the accelerator plugin swallowed its registration failure and
+            # the child silently fell back to host CPU — mark it, or phase C
+            # would report CPU numbers as device numbers
+            reason = "accelerator plugin expected but child initialized cpu"
+        else:
+            reason = f"probe rc={r.returncode}: {r.stderr[-300:]}"
+    except subprocess.TimeoutExpired:
+        reason = f"probe hung >{probe_timeout_s:.0f}s (wedged device/tunnel)"
+    log(f"backend probe FAILED ({reason}); falling back to CPU")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return reason
+
+
 def main() -> None:
     t_start = time.perf_counter()
+    fallback_reason = ensure_live_backend()
     fast = os.environ.get("BENCH_FAST") == "1"
     n_queries = int(os.environ.get("BENCH_QUERIES", "24" if not fast else "4"))
     n_corpus = int(os.environ.get("BENCH_CORPUS", "2048" if not fast else "64"))
     new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "48" if not fast else "8"))
     concurrency = int(os.environ.get("BENCH_CONCURRENCY", "8" if not fast else "2"))
-    skip_scale = os.environ.get("BENCH_SKIP_SCALE") == "1" or fast
+    # phase C inits >1B params — pointless (and driver-timeout-hostile) on
+    # the CPU fallback path
+    skip_scale = (
+        os.environ.get("BENCH_SKIP_SCALE") == "1" or fast or bool(fallback_reason)
+    )
     serve_scale = os.environ.get("BENCH_SERVE_SCALE", "1b")
     scale_tokens = int(os.environ.get("BENCH_SCALE_TOKENS", "64"))
 
@@ -508,6 +560,7 @@ def main() -> None:
         # zero model compute)
         "vs_baseline": round(baseline["p50_ms"] / max(rag["p50_ms"], 1e-9), 3),
         **rtt,
+        **({"device_fallback": fallback_reason} if fallback_reason else {}),
         "rag": rag,
         "baseline": baseline,
         **({"baseline_wan": baseline_wan} if baseline_wan else {}),
