@@ -24,8 +24,9 @@ Details go to stderr.
 
 Env knobs: BENCH_FAST=1 (tiny models, quick smoke), BENCH_QUERIES=N,
 BENCH_CORPUS=N, BENCH_NEW_TOKENS=N, BENCH_CONCURRENCY=N,
-BENCH_SKIP_SCALE=1 (skip phase C), BENCH_SERVE_SCALE=1b|8b,
-BENCH_SCALE_TOKENS=N.
+BENCH_SKIP_SCALE=1 (skip phase C), BENCH_SERVE_SCALE=1b|8b|moe,
+BENCH_SCALE_TOKENS=N, BENCH_SPECULATIVE=1 (add phase E: plain-vs-
+speculative decode on the serve-scale target, greedy-exact).
 """
 
 from __future__ import annotations
@@ -273,6 +274,17 @@ def serve_scale_config(kind: str):
             vocab_size=32_000, dim=4096, n_layers=12, n_heads=32, n_kv_heads=8,
             mlp_dim=14_336, max_len=2048, rope_theta=500_000.0,
         )
+    if kind == "moe":
+        # Mixtral-style sparse geometry: ~2.6B total params but only ~0.8B
+        # active per token (top-2 of 8 experts) — decode streams the full
+        # expert weights, so tok/s vs the dense 1b shows the routing cost
+        from sentio_tpu.models.moe import MoeConfig
+
+        return MoeConfig(
+            vocab_size=32_000, dim=1024, n_layers=12, n_heads=16, n_kv_heads=8,
+            mlp_dim=4096, max_len=2048, rope_theta=500_000.0,
+            n_experts=8, experts_per_token=2,
+        )
     # ~1.4B: MXU-aligned dims, GQA 16:8
     return LlamaConfig(
         vocab_size=32_000, dim=2048, n_layers=16, n_heads=16, n_kv_heads=8,
@@ -290,18 +302,20 @@ def phase_c_scale(kind: str, new_tokens: int, concurrency: int):
     import jax
 
     from sentio_tpu.models.llama import init_llama
+    from sentio_tpu.models.moe import MoeConfig, init_moe
 
     cfg = serve_scale_config(kind)
+    init_fn = init_moe if isinstance(cfg, MoeConfig) else init_llama
     log(f"phase C: init {kind} serve-scale model "
         f"(dim={cfg.dim} L={cfg.n_layers} vocab={cfg.vocab_size}) ...")
     t0 = time.perf_counter()
-    # store weights in bf16 (init_llama samples f32; converted checkpoints
+    # store weights in bf16 (init samples f32; converted checkpoints
     # arrive bf16 — f32 residency would put the 8b geometry over HBM).
     # jit fuses init+cast so only the bf16 tree materializes; an eager
     # tree_map would hold BOTH trees (17 GB) and thrash the allocator.
     init_bf16 = jax.jit(
         lambda key: jax.tree_util.tree_map(
-            lambda x: x.astype(cfg.jdtype), init_llama(key, cfg)
+            lambda x: x.astype(cfg.jdtype), init_fn(key, cfg)
         )
     )
     params = init_bf16(jax.random.PRNGKey(0))
@@ -374,6 +388,78 @@ def phase_c_scale(kind: str, new_tokens: int, concurrency: int):
     }
     log(f"phase C: {out['tokens_per_s']} tok/s on {out['params_b']}B params "
         f"(MFU {out['mfu_pct']}%, HBM {out['hbm_util_pct']}%) over {wall:.1f}s")
+    return out
+
+
+def phase_e_speculative(kind: str, new_tokens: int):
+    """Plain vs speculative greedy decode on the serve-scale target with a
+    4-layer draft (same vocab). Opt-in (BENCH_SPECULATIVE=1): adds ~2 model
+    inits + 2 bulk generates of chip time. Exactness is asserted, so the
+    speedup column can be trusted as same-output."""
+    import jax
+
+    from sentio_tpu.config import GeneratorConfig
+    from sentio_tpu.models.llama import LlamaConfig, init_llama
+    from sentio_tpu.runtime.engine import GeneratorEngine
+    from sentio_tpu.runtime.speculative import SpeculativeDecoder
+
+    cfg = serve_scale_config(kind)
+    if type(cfg) is not LlamaConfig:
+        log("phase E: speculative bench supports dense targets only; skipping")
+        return None
+    log(f"phase E: speculative decode, {kind} target + 4-layer draft ...")
+    init_bf16 = jax.jit(
+        lambda key, c=cfg: jax.tree_util.tree_map(
+            lambda x: x.astype(c.jdtype), init_llama(key, c)
+        )
+    )
+    engine = GeneratorEngine(
+        config=GeneratorConfig(model_preset="bench", max_new_tokens=new_tokens),
+        model_config=cfg, params=init_bf16(jax.random.PRNGKey(0)),
+    )
+    draft_cfg = LlamaConfig(
+        vocab_size=cfg.vocab_size, dim=cfg.dim // 2, n_layers=4,
+        n_heads=cfg.n_heads // 2, n_kv_heads=max(cfg.n_kv_heads // 2, 1),
+        mlp_dim=cfg.mlp_dim // 2, max_len=cfg.max_len,
+        rope_theta=cfg.rope_theta,
+    )
+    draft_params = jax.jit(
+        lambda key: jax.tree_util.tree_map(
+            lambda x: x.astype(draft_cfg.jdtype), init_llama(key, draft_cfg)
+        )
+    )(jax.random.PRNGKey(1))
+    spec = SpeculativeDecoder(engine, draft_params, draft_cfg, k=4)
+
+    prompts = ["Explain how paged attention amortizes page table walks."] * 4
+    # warmup both paths at the TIMED step count — `steps` is a jit static
+    # arg, so a shorter warmup would push the full-length compile into the
+    # timed region and the "speedup" would compare compile times
+    engine.generate(prompts, max_new_tokens=new_tokens, temperature=0.0)
+    spec.generate(prompts, max_new_tokens=new_tokens)
+    spec.stats = {"rounds": 0, "tokens": 0}  # acceptance stats: timed run only
+
+    t0 = time.perf_counter()
+    plain = engine.generate(prompts, max_new_tokens=new_tokens, temperature=0.0)
+    plain_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    fast = spec.generate(prompts, max_new_tokens=new_tokens)
+    spec_s = time.perf_counter() - t0
+    # greedy-exactness holds up to argmax ties under float reassociation
+    # (T=1 decode vs T=k+1 verify reduce in different orders); report any
+    # divergence rather than aborting the whole bench after the expensive
+    # phases already ran
+    mismatched = sum(
+        f.tokens != p.tokens for f, p in zip(fast, plain)
+    )
+
+    out = {
+        "plain_tok_s": round(sum(len(r.tokens) for r in plain) / plain_s, 1),
+        "spec_tok_s": round(sum(len(r.tokens) for r in fast) / spec_s, 1),
+        "speedup": round(plain_s / max(spec_s, 1e-9), 2),
+        "tokens_per_verify": round(spec.tokens_per_round, 2),
+        "mismatched_rows": mismatched,
+    }
+    log(f"phase E: {out}")
     return out
 
 
@@ -547,6 +633,11 @@ def main() -> None:
     )
     scale = None if skip_scale else phase_c_scale(serve_scale, scale_tokens, 8)
     kernels = None if fast else phase_d_kernels()
+    speculative = (
+        phase_e_speculative(serve_scale, scale_tokens)
+        if os.environ.get("BENCH_SPECULATIVE") == "1" and not skip_scale
+        else None
+    )
 
     total_s = time.perf_counter() - t_start
     log(f"bench wall {total_s:.0f}s")
@@ -566,6 +657,7 @@ def main() -> None:
         **({"baseline_wan": baseline_wan} if baseline_wan else {}),
         **({"serve_scale": scale} if scale else {}),
         **({"kernels": kernels} if kernels else {}),
+        **({"speculative": speculative} if speculative else {}),
         "wall_s": round(total_s, 1),
     }
     print(json.dumps(payload))

@@ -173,6 +173,8 @@ class GeneratorEngine:
                         mask = mask & key_ok[:, None, None, :]
                     return L.attention(q, k, v, mask, q.dtype)
 
+        self._attn_fn = attn_fn  # exposed for the speculative decoder
+
         @jax.jit
         def prefill(params, ids, positions, cache, pad_mask):
             # pad_mask marks real (row, token) cells: llama ignores it on the

@@ -1,0 +1,261 @@
+"""Speculative decoding: draft-and-verify generation, exact under greedy.
+
+Decode is bandwidth-bound — every step streams the full target weights for
+one token per row. A small draft model proposes ``k`` tokens autoregressively
+(cheap: draft weights are a fraction of the target's), then the target
+scores all of them in ONE forward of T = k+1 (amortizing its weight stream
+over up to k+1 emitted tokens). Greedy acceptance keeps the longest prefix
+where the target's own argmax agrees with the draft, then emits the
+target's correction token — so the emitted sequence is bit-identical to
+target-only greedy decoding; the draft only changes HOW FAST tokens appear,
+never WHICH tokens (asserted by tests).
+
+TPU-shaped implementation: the whole generate loop is one
+``lax.while_loop`` on device — per round, an inner ``lax.scan`` drafts k
+tokens, one batched target forward verifies, and ragged per-row acceptance
+advances each row independently. The host dispatches once and fetches one
+token buffer; no per-round round trips.
+
+Cache discipline: both models write k/v at absolute positions; rejected
+positions hold stale entries BEYOND each row's accepted length, which are
+never attended (causal masks are position-based) and are overwritten by the
+next round's writes at the same offsets. Rollback is therefore free — no
+cache copying.
+
+Reference seam: the reference's generator is a remote chat API
+(/root/reference/src/core/llm/providers/openai.py:117) with no control over
+decoding; speculative execution is only possible because the models live
+in-process here.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+
+class SpeculativeError(Exception):
+    pass
+
+
+def build_spec_generate(target_fwd, target_cfg, draft_fwd, draft_cfg, eos_id: int,
+                        attn_fn=None):
+    """Compile the fused speculative generate: (params_t, params_d, ids,
+    positions, lens, tcache, dcache, steps, k) → (out [B, steps+k+1],
+    n_rounds) — all device side.
+
+    ``steps`` bounds emitted tokens per row; each while-loop round emits
+    between 1 and k+1 tokens per live row.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @partial(jax.jit, static_argnames=("steps", "k"))
+    def spec_generate(params_t, params_d, ids, positions, lens, tcache, dcache,
+                      steps, k, pad_mask):
+        b, width = ids.shape
+        row_valid = pad_mask.any(axis=1, keepdims=True)  # junk bucket rows
+
+        # prefill both models over the prompt (one dispatch each, fused
+        # here); pad_mask keeps padding out of routed-expert capacity and
+        # attn_fn keeps prefill numerics identical to the engine's own
+        # prefill (kernel-vs-XLA float differences can flip argmax ties)
+        t_logits, tcache = target_fwd(
+            params_t, target_cfg, ids, positions=positions, cache=tcache,
+            cache_index=0, pad_mask=pad_mask, attn_fn=attn_fn,
+        )
+        _, dcache = draft_fwd(
+            params_d, draft_cfg, ids, positions=positions, cache=dcache,
+            cache_index=0, pad_mask=pad_mask, attn_fn=attn_fn,
+        )
+        last = jnp.take_along_axis(t_logits, (lens - 1)[:, None, None], axis=1)[:, 0]
+        cur = jnp.argmax(last, axis=-1).astype(jnp.int32)  # first token, target greedy
+
+        out_w = steps + k + 1
+        out0 = jnp.full((b, out_w), eos_id, jnp.int32)
+        # emitted[b] counts tokens written for row b; cur sits at cache
+        # position lens[b] and is already "emitted" conceptually at offset 0
+        out0 = out0.at[:, 0].set(cur)
+        emitted0 = jnp.ones((b,), jnp.int32)
+        done0 = cur == eos_id
+
+        def round_body(state):
+            cur, lens, emitted, done, tcache, dcache, out, rounds = state
+            live = row_valid & ~done[:, None]
+
+            # ---- draft autoregressively (T=1 scan over the draft). k+1
+            # steps, not k: the last step's PROPOSAL is discarded, but its
+            # input is d_k, whose k/v write at slot lens+k is needed when a
+            # fully-accepted round advances lens past it — without it the
+            # draft cache keeps a permanently-unwritten, attended slot and
+            # acceptance decays exactly when the draft is good.
+            def draft_step(carry, _):
+                tok, dlens, dcache = carry
+                logits, dcache = draft_fwd(
+                    params_d, draft_cfg, tok[:, None], positions=dlens[:, None],
+                    cache=dcache, cache_index=dlens, pad_mask=live,
+                )
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return (nxt, dlens + 1, dcache), nxt
+
+            (_, _, dcache), drafts = jax.lax.scan(
+                draft_step, (cur, lens, dcache), None, length=k + 1
+            )
+            drafts = jnp.moveaxis(drafts, 0, 1)[:, :k]  # [B, k]
+
+            # ---- target verifies cur + drafts in one T=k+1 forward
+            block = jnp.concatenate([cur[:, None], drafts], axis=1)  # [B, k+1]
+            pos = lens[:, None] + jnp.arange(k + 1)[None, :]
+            t_logits, tcache = target_fwd(
+                params_t, target_cfg, block, positions=pos, cache=tcache,
+                cache_index=lens,
+                pad_mask=jnp.broadcast_to(live, (b, k + 1)),
+            )
+            targets = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B, k+1]
+
+            # ---- longest agreeing prefix: accept drafts[j] while it equals
+            # targets[j] (the target's choice AFTER cur, d1..dj-1)
+            agree = drafts == targets[:, :k]                       # [B, k]
+            n_accept = jnp.cumprod(agree.astype(jnp.int32), axis=1).sum(axis=1)
+            # tokens emitted this round per live row: accepted drafts plus
+            # the target's correction/bonus token
+            emit_n = n_accept + 1                                   # [B] in 1..k+1
+
+            # round tokens [B, k+1]: d1..dm, t_{m+1}, padding after
+            j = jnp.arange(k + 1)[None, :]
+            correction = jnp.take_along_axis(targets, n_accept[:, None], axis=1)
+            round_toks = jnp.where(
+                j < n_accept[:, None], jnp.pad(drafts, ((0, 0), (0, 1))),
+                jnp.where(j == n_accept[:, None], correction, eos_id),
+            )
+
+            # EOS inside the accepted run truncates emission for that row
+            is_eos = round_toks == eos_id
+            before_eos = jnp.cumsum(jnp.cumsum(is_eos, axis=1), axis=1) <= 1
+            emit_n = jnp.minimum(emit_n, before_eos.sum(axis=1))
+            hit_eos = (jnp.cumsum(is_eos, axis=1) > 0) & (j < emit_n[:, None])
+            row_done = done | hit_eos.any(axis=1)
+
+            emit_n = jnp.where(done, 0, emit_n)
+
+            # ---- scatter this round's tokens at each row's offset
+            def write_row(out_row, toks_row, off, n):
+                upd = jax.lax.dynamic_update_slice(out_row, toks_row, (off,))
+                keep = jnp.arange(out_row.shape[0])
+                return jnp.where(
+                    (keep >= off) & (keep < off + n), upd, out_row
+                )
+
+            out = jax.vmap(write_row)(out, round_toks, emitted, emit_n)
+
+            cur = jnp.where(done, cur, correction[:, 0])
+            lens = lens + emit_n
+            emitted = emitted + emit_n
+            # a row retires when it hits EOS or exhausts its own budget —
+            # otherwise fast rows would keep speculating garbage (and
+            # growing lens) while slow rows finish
+            row_done = row_done | (emitted >= steps)
+            return (cur, lens, emitted, row_done, tcache, dcache, out, rounds + 1)
+
+        def cond(state):
+            _, _, _, done, _, _, _, _ = state
+            return jnp.any(~done)
+
+        state = (cur, lens, emitted0, done0, tcache, dcache, out0, jnp.zeros((), jnp.int32))
+        _, _, emitted, _, _, _, out, rounds = jax.lax.while_loop(
+            cond, round_body, state
+        )
+        return out, emitted, rounds
+
+    return spec_generate
+
+
+class SpeculativeDecoder:
+    """Draft-model wrapper for a GeneratorEngine-style target.
+
+    Greedy-exact: ``generate`` emits the same tokens as the target engine's
+    plain greedy decode; the ``k`` drafted tokens per round only reduce the
+    number of target weight streams per token. Exposes acceptance stats so
+    operators can judge whether their draft earns its keep.
+    """
+
+    def __init__(self, engine, draft_params, draft_config, k: int = 4,
+                 draft_fwd=None) -> None:
+        if draft_config.vocab_size != engine.model_config.vocab_size:
+            raise SpeculativeError(
+                f"draft vocab {draft_config.vocab_size} != target "
+                f"{engine.model_config.vocab_size} — same tokenizer required"
+            )
+        if k < 1:
+            raise SpeculativeError(f"k must be >= 1, got {k}")
+        if engine.mesh is not None:
+            # the spec caches would need the engine's mesh shardings and the
+            # verify forward its shard_map attention — not wired yet; fail
+            # loudly instead of silently decoding off-mesh
+            raise SpeculativeError("mesh-backed engines are not supported yet")
+        from sentio_tpu.models.llama import llama_forward
+
+        self.engine = engine
+        self.draft_params = draft_params
+        self.draft_config = draft_config
+        self.k = int(k)
+        self.stats = {"rounds": 0, "tokens": 0}
+        self._fn = build_spec_generate(
+            engine.forward_fn, engine.model_config,
+            draft_fwd or llama_forward, draft_config,
+            engine.tokenizer.eos_id,
+            attn_fn=engine._attn_fn,
+        )
+
+    def generate(self, prompts, max_new_tokens: Optional[int] = None):
+        """Batched greedy generation through the speculative loop. Returns
+        the same GenerationResult list as ``engine.generate(temperature=0)``."""
+        import time as _time
+
+        import jax.numpy as jnp
+
+        from sentio_tpu.models.llama import init_cache
+        from sentio_tpu.runtime.engine import GenerationResult
+
+        eng = self.engine
+        t0 = _time.perf_counter()
+        max_new = max_new_tokens or eng.config.max_new_tokens
+        ids, positions, lens, tcache, n, window, pad_mask = eng._encode_batch(
+            prompts, max_new + self.k + 1
+        )
+        max_new = eng._stable_steps(max_new, window - int(lens.max()) - self.k - 1)
+        dcache = init_cache(self.draft_config, ids.shape[0], window)
+
+        out, emitted, rounds = self._fn(
+            eng.params, self.draft_params, ids, positions, jnp.asarray(lens),
+            tcache, dcache, max_new, self.k, jnp.asarray(pad_mask),
+        )
+        out = np.asarray(out)
+        emitted = np.asarray(emitted)
+        self.stats["rounds"] += int(rounds)
+        self.stats["tokens"] += int(emitted[:n].sum())
+
+        results = []
+        eos = eng.tokenizer.eos_id
+        for i in range(n):
+            row = out[i, : min(int(emitted[i]), max_new)].tolist()
+            if eos in row:
+                row, reason = row[: row.index(eos)], "stop"
+            else:
+                reason = "length"
+            results.append(
+                GenerationResult(
+                    text=eng.tokenizer.decode(row), tokens=row,
+                    prompt_tokens=int(lens[i]), finish_reason=reason,
+                    latency_ms=(_time.perf_counter() - t0) * 1000.0,
+                )
+            )
+        return results
+
+    @property
+    def tokens_per_round(self) -> float:
+        """Mean emitted tokens per target verify — 1.0 means the draft never
+        helps; k+1 is the ceiling."""
+        return self.stats["tokens"] / max(self.stats["rounds"], 1)

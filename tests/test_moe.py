@@ -282,6 +282,30 @@ class TestExpertParallel:
         router_spec = sharded["layers_0"]["moe"]["router"]["kernel"].sharding.spec
         assert all(entry is None for entry in router_spec)
 
+    def test_paged_serving_on_ep_mesh(self, cfg):
+        """Mesh-sharded MoE through the DEFAULT serving path: experts on ep,
+        kv pool heads on tp, greedy tokens matching the single-device run."""
+        from sentio_tpu.models.moe import init_moe, moe_serving_forward
+        from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+
+        acfg = replace(cfg, capacity_factor=8.0)
+        params = init_moe(jax.random.PRNGKey(0), acfg)
+        mesh = build_mesh(MeshConfig(dp_size=2, ep_size=2, tp_size=2))
+        sharded = shard_params(params, mesh, MOE_EP_RULES)
+        prompts = ["experts on a mesh", "second lane"]
+
+        served = ContinuousBatchingEngine(
+            model_config=acfg, params=sharded, mesh=mesh,
+            forward_fn=moe_serving_forward,
+            max_slots=4, page_size=16, max_pages_per_seq=8, steps_per_tick=4,
+        ).run_all(prompts, max_new_tokens=8, temperature=0.0)
+
+        single = ContinuousBatchingEngine(
+            model_config=acfg, params=params, forward_fn=moe_serving_forward,
+            max_slots=4, page_size=16, max_pages_per_seq=8, steps_per_tick=4,
+        ).run_all(prompts, max_new_tokens=8, temperature=0.0)
+        assert [r.tokens for r in served] == [r.tokens for r in single]
+
     def test_ep_train_step(self, params, cfg):
         import optax
 

@@ -1,0 +1,120 @@
+"""Speculative decoding (runtime/speculative.py): greedy exactness against
+the plain engine, acceptance accounting, EOS/budget handling."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sentio_tpu.config import GeneratorConfig
+from sentio_tpu.models.llama import LlamaConfig, init_llama
+from sentio_tpu.runtime.engine import GeneratorEngine
+from sentio_tpu.runtime.speculative import SpeculativeDecoder, SpeculativeError
+
+
+@pytest.fixture(scope="module")
+def target_engine():
+    cfg = LlamaConfig.tiny()
+    return GeneratorEngine(
+        config=GeneratorConfig(model_preset="tiny", max_new_tokens=16),
+        model_config=cfg,
+        params=init_llama(jax.random.PRNGKey(0), cfg),
+    )
+
+
+class TestGreedyExactness:
+    def test_same_weights_draft_accepts_everything(self, target_engine):
+        """Draft == target: every proposal agrees, so the decoder must emit
+        target-greedy tokens at ~k+1 tokens per verify."""
+        spec = SpeculativeDecoder(
+            target_engine, target_engine.params, target_engine.model_config, k=4
+        )
+        prompts = ["speculate on this", "another prompt"]
+        got = spec.generate(prompts, max_new_tokens=12)
+        ref = target_engine.generate(prompts, max_new_tokens=12, temperature=0.0)
+        assert [r.tokens for r in got] == [r.tokens for r in ref]
+        # perfect agreement: acceptance near the k+1 ceiling
+        assert spec.tokens_per_round > 3.0
+
+    def test_weak_draft_still_exact(self, target_engine):
+        """An unrelated random draft mostly disagrees — output must STILL be
+        bit-identical to target greedy; only speed differs."""
+        draft_cfg = LlamaConfig.tiny()
+        draft_params = init_llama(jax.random.PRNGKey(999), draft_cfg)
+        spec = SpeculativeDecoder(target_engine, draft_params, draft_cfg, k=3)
+        prompts = ["a different draft model", "with other weights", "third"]
+        got = spec.generate(prompts, max_new_tokens=14)
+        ref = target_engine.generate(prompts, max_new_tokens=14, temperature=0.0)
+        assert [r.tokens for r in got] == [r.tokens for r in ref]
+        # weak draft: most rounds emit just the correction token
+        assert 1.0 <= spec.tokens_per_round <= 4.0
+
+    def test_smaller_draft_geometry(self, target_engine):
+        """The realistic shape: a shallower/narrower draft of the same
+        vocab."""
+        draft_cfg = LlamaConfig(
+            vocab_size=512, dim=32, n_layers=1, n_heads=2, n_kv_heads=1,
+            mlp_dim=64, max_len=512, rope_theta=10_000.0,
+        )
+        draft_params = init_llama(jax.random.PRNGKey(7), draft_cfg)
+        spec = SpeculativeDecoder(target_engine, draft_params, draft_cfg, k=4)
+        prompts = ["tiny draft, tiny target"]
+        got = spec.generate(prompts, max_new_tokens=10)
+        ref = target_engine.generate(prompts, max_new_tokens=10, temperature=0.0)
+        assert [r.tokens for r in got] == [r.tokens for r in ref]
+
+
+class TestMoeTarget:
+    def test_moe_target_llama_draft_exact(self):
+        """Routed target + dense draft: routing sees the spec path's pad
+        mask, so with batch-size-independent (ample) capacity the output is
+        still greedy-exact."""
+        from dataclasses import replace
+
+        from sentio_tpu.models.moe import MoeConfig, init_moe
+
+        cfg = replace(MoeConfig.tiny(), capacity_factor=8.0)
+        engine = GeneratorEngine(
+            config=GeneratorConfig(model_preset="tiny", max_new_tokens=12),
+            model_config=cfg,
+            params=init_moe(jax.random.PRNGKey(0), cfg),
+        )
+        draft_cfg = LlamaConfig.tiny()
+        spec = SpeculativeDecoder(
+            engine, init_llama(jax.random.PRNGKey(3), draft_cfg), draft_cfg, k=3
+        )
+        prompts = ["routed target", "dense draft"]
+        got = spec.generate(prompts, max_new_tokens=10)
+        ref = engine.generate(prompts, max_new_tokens=10, temperature=0.0)
+        assert [r.tokens for r in got] == [r.tokens for r in ref]
+
+
+class TestContracts:
+    def test_vocab_mismatch_rejected(self, target_engine):
+        draft_cfg = LlamaConfig(
+            vocab_size=300, dim=32, n_layers=1, n_heads=2, n_kv_heads=1,
+            mlp_dim=64, max_len=512,
+        )
+        with pytest.raises(SpeculativeError, match="vocab"):
+            SpeculativeDecoder(
+                target_engine, init_llama(jax.random.PRNGKey(1), draft_cfg),
+                draft_cfg,
+            )
+
+    def test_bad_k_rejected(self, target_engine):
+        with pytest.raises(SpeculativeError, match="k must"):
+            SpeculativeDecoder(
+                target_engine, target_engine.params,
+                target_engine.model_config, k=0,
+            )
+
+    def test_finish_reasons_match_plain_engine(self, target_engine):
+        spec = SpeculativeDecoder(
+            target_engine, target_engine.params, target_engine.model_config, k=2
+        )
+        got = spec.generate(["finish reason check"], max_new_tokens=8)[0]
+        ref = target_engine.generate(
+            ["finish reason check"], max_new_tokens=8, temperature=0.0
+        )[0]
+        assert got.finish_reason == ref.finish_reason
+        assert got.prompt_tokens == ref.prompt_tokens
