@@ -218,6 +218,11 @@ class GeneratorConfig:
     model_preset: str = "llama3-8b"  # llama3-8b | tiny
     checkpoint_path: str = ""  # converted checkpoint (cli convert llama ...)
     tokenizer_path: str = ""  # local HF tokenizer dir
+    # speculative decoding: a small same-vocab draft checkpoint accelerates
+    # temperature-0 generation on the contiguous path (greedy-exact —
+    # runtime/speculative.py); empty = disabled
+    draft_checkpoint_path: str = ""
+    speculative_k: int = 4
     # remote OpenAI-compatible endpoint (provider="openai" — the reference's
     # primary path, kept here as the pluggable fallback seam)
     api_base: str = ""
@@ -265,6 +270,8 @@ class GeneratorConfig:
             model_preset=_env_str(["LLM_MODEL", "CHAT_LLM_MODEL"], "llama3-8b"),
             checkpoint_path=_env_str(["LLM_CHECKPOINT", "MODEL_PATH"], ""),
             tokenizer_path=_env_str(["LLM_TOKENIZER", "TOKENIZER_PATH"], ""),
+            draft_checkpoint_path=_env_str(["LLM_DRAFT_CHECKPOINT"], ""),
+            speculative_k=_env_int(["SPECULATIVE_K"], 4),
             api_base=_env_str(["OPENAI_BASE_URL", "CHAT_LLM_BASE_URL"], ""),
             api_key=_env_str(["OPENAI_API_KEY", "CHAT_LLM_API_KEY"], ""),
             api_model=_env_str(["OPENAI_MODEL", "CHAT_LLM_API_MODEL"], "default"),

@@ -61,6 +61,9 @@ class TpuProvider:
 
     engine: object = None  # GeneratorEngine
     service: object = None  # PagedGenerationService (continuous batching)
+    # SpeculativeDecoder: draft-accelerated greedy decode on the contiguous
+    # path (temperature-0 calls only — the acceptance rule is greedy-exact)
+    speculative: object = None
     name: str = "tpu"
 
     def chat(self, prompt: str, max_new_tokens: int, temperature: float) -> str:
@@ -76,6 +79,10 @@ class TpuProvider:
                     raise
             if self.engine is None:
                 raise RuntimeError("paged decode failed and no contiguous engine")
+        if self.speculative is not None and temperature == 0.0:
+            return self.speculative.generate(
+                [prompt], max_new_tokens=max_new_tokens
+            )[0].text
         result = self.engine.generate(
             [prompt], max_new_tokens=max_new_tokens, temperature=temperature
         )[0]
@@ -314,12 +321,13 @@ def create_generator(
     settings=None,
     engine=None,
     service=None,
+    speculative=None,
 ) -> LLMGenerator:
     """env→generator wiring (reference: llm/factory.py:14-69)."""
     settings = settings or get_settings()
     cfg = settings.generator
     if cfg.provider == "tpu" and engine is not None:
-        provider = TpuProvider(engine=engine, service=service)
+        provider = TpuProvider(engine=engine, service=service, speculative=speculative)
     elif cfg.provider == "tpu":
         # no engine supplied (tests, host-only dev) → deterministic echo
         provider = EchoProvider()

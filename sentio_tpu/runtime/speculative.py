@@ -79,7 +79,9 @@ def build_spec_generate(target_fwd, target_cfg, draft_fwd, draft_cfg, eos_id: in
         # position lens[b] and is already "emitted" conceptually at offset 0
         out0 = out0.at[:, 0].set(cur)
         emitted0 = jnp.ones((b,), jnp.int32)
-        done0 = cur == eos_id
+        # junk bucket rows start done — otherwise the loop keeps burning
+        # full draft+verify rounds on padding until it exhausts the budget
+        done0 = (cur == eos_id) | ~row_valid[:, 0]
 
         def round_body(state):
             cur, lens, emitted, done, tcache, dcache, out, rounds = state
@@ -196,6 +198,27 @@ class SpeculativeDecoder:
             # loudly instead of silently decoding off-mesh
             raise SpeculativeError("mesh-backed engines are not supported yet")
         from sentio_tpu.models.llama import llama_forward
+        from sentio_tpu.models.moe import MoeConfig, moe_serving_forward
+
+        if isinstance(engine.model_config, MoeConfig):
+            # exactness needs routing to be batch-size-independent: the
+            # verify forward routes B*(k+1) tokens where plain decode routes
+            # B, so ANY capacity drop can differ between the paths. cf >=
+            # E/k_experts guarantees no token ever drops (worst case all
+            # tokens pick one expert).
+            cfg = engine.model_config
+            no_drop_cf = cfg.n_experts / cfg.experts_per_token
+            if cfg.capacity_factor < no_drop_cf:
+                raise SpeculativeError(
+                    f"MoE target needs capacity_factor >= {no_drop_cf:.1f} "
+                    f"(n_experts/experts_per_token) for greedy-exact "
+                    f"speculation; got {cfg.capacity_factor}"
+                )
+        if draft_fwd is None:
+            draft_fwd = (
+                moe_serving_forward
+                if isinstance(draft_config, MoeConfig) else llama_forward
+            )
 
         self.engine = engine
         self.draft_params = draft_params
@@ -204,7 +227,7 @@ class SpeculativeDecoder:
         self.stats = {"rounds": 0, "tokens": 0}
         self._fn = build_spec_generate(
             engine.forward_fn, engine.model_config,
-            draft_fwd or llama_forward, draft_config,
+            draft_fwd, draft_config,
             engine.tokenizer.eos_id,
             attn_fn=engine._attn_fn,
         )
@@ -225,7 +248,15 @@ class SpeculativeDecoder:
         ids, positions, lens, tcache, n, window, pad_mask = eng._encode_batch(
             prompts, max_new + self.k + 1
         )
-        max_new = eng._stable_steps(max_new, window - int(lens.max()) - self.k - 1)
+        headroom = window - int(lens.max())
+        plain_steps = eng._stable_steps(max_new, headroom)
+        spec_steps = eng._stable_steps(max_new, max(headroom - self.k - 1, 1))
+        if spec_steps < plain_steps:
+            # near-window prompts: the verify block's k+1 spill would force
+            # a shorter budget than the plain path — fall back so the spec
+            # seam never returns fewer tokens than engine.generate would
+            return eng.generate(prompts, max_new_tokens=max_new, temperature=0.0)
+        max_new = spec_steps
         dcache = init_cache(self.draft_config, ids.shape[0], window)
 
         out, emitted, rounds = self._fn(

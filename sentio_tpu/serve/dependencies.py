@@ -195,6 +195,40 @@ class DependencyContainer:
         return self._get("engine", build)
 
     @property
+    def speculative(self):
+        """Draft-accelerated greedy decoder over the contiguous engine
+        (runtime/speculative.py) — built when a draft checkpoint is
+        configured. Greedy-exact, so it transparently serves temperature-0
+        requests on the non-paged path."""
+
+        def build():
+            cfg = self.settings.generator
+            if cfg.provider != "tpu" or not cfg.draft_checkpoint_path:
+                return None
+            if cfg.use_paged_decode:
+                # the paged service answers every successful /chat before the
+                # provider reaches the spec branch — loading the draft would
+                # spend HBM and startup time on dead code
+                logger.warning(
+                    "LLM_DRAFT_CHECKPOINT set but paged decode is enabled; "
+                    "speculative decoding serves the contiguous path only — "
+                    "set USE_PAGED_KV=0 to use the draft"
+                )
+                return None
+            engine = self.engine
+            if engine is None or self.mesh is not None:
+                return None  # mesh-backed engines: spec not wired yet
+            from sentio_tpu.runtime.speculative import SpeculativeDecoder
+            from sentio_tpu.runtime.weights import load_model
+
+            draft_params, draft_cfg, _ = load_model(cfg.draft_checkpoint_path)
+            return SpeculativeDecoder(
+                engine, draft_params, draft_cfg, k=cfg.speculative_k
+            )
+
+        return self._get("speculative", build)
+
+    @property
     def generation_service(self):
         """Continuous-batching pump over the paged KV pool — the default
         decode path for /chat. Shares weights/tokenizer with the contiguous
@@ -235,6 +269,7 @@ class DependencyContainer:
                 settings=self.settings,
                 engine=self.engine,
                 service=self.generation_service,
+                speculative=self.speculative,
             )
 
         return self._get("generator", build)

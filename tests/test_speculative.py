@@ -89,6 +89,51 @@ class TestMoeTarget:
         assert [r.tokens for r in got] == [r.tokens for r in ref]
 
 
+class TestServingIntegration:
+    def test_provider_routes_greedy_calls_through_spec(self, target_engine):
+        from sentio_tpu.ops.generator import TpuProvider
+
+        spec = SpeculativeDecoder(
+            target_engine, target_engine.params, target_engine.model_config, k=3
+        )
+        provider = TpuProvider(engine=target_engine, speculative=spec)
+        before = dict(spec.stats)
+        text = provider.chat("route me", max_new_tokens=6, temperature=0.0)
+        assert spec.stats["rounds"] > before["rounds"]  # spec path taken
+        # sampled calls bypass spec (greedy-exactness only holds at temp 0)
+        before = dict(spec.stats)
+        provider.chat("sampled", max_new_tokens=6, temperature=0.7)
+        assert spec.stats["rounds"] == before["rounds"]
+        assert isinstance(text, str)
+
+    def test_container_builds_spec_from_draft_checkpoint(self, tmp_path):
+        from sentio_tpu.config import Settings
+        from sentio_tpu.models.llama import LlamaConfig, init_llama
+        from sentio_tpu.runtime.checkpoint import save_pytree
+        from sentio_tpu.serve.dependencies import DependencyContainer
+
+        draft_cfg = LlamaConfig.tiny()
+        ck = str(tmp_path / "draft-ck")
+        save_pytree(
+            ck, init_llama(jax.random.PRNGKey(5), draft_cfg),
+            meta={"family": "llama", "config": draft_cfg.__dict__},
+        )
+        settings = Settings()
+        settings.generator.provider = "tpu"
+        settings.generator.model_preset = "tiny"
+        settings.generator.draft_checkpoint_path = ck
+        settings.generator.speculative_k = 2
+        settings.generator.use_paged_decode = False
+        container = DependencyContainer(settings=settings)
+        # the 8-device test conftest would give DI a CPU mesh; production
+        # single-chip serving (where spec applies) has mesh=None
+        container.override("mesh", None)
+        spec = container.speculative
+        assert spec is not None and spec.k == 2
+        gen = container.generator
+        assert gen.provider.speculative is spec
+
+
 class TestContracts:
     def test_vocab_mismatch_rejected(self, target_engine):
         draft_cfg = LlamaConfig(
