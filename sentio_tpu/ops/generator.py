@@ -61,8 +61,9 @@ class TpuProvider:
 
     engine: object = None  # GeneratorEngine
     service: object = None  # PagedGenerationService (continuous batching)
-    # SpeculativeDecoder: draft-accelerated greedy decode on the contiguous
-    # path (temperature-0 calls only — the acceptance rule is greedy-exact)
+    # SpeculativeDecoder: draft-accelerated decode on the contiguous path
+    # (greedy calls bit-exact, sampled calls distribution-exact via
+    # rejection-sampling acceptance)
     speculative: object = None
     name: str = "tpu"
 
@@ -79,9 +80,12 @@ class TpuProvider:
                     raise
             if self.engine is None:
                 raise RuntimeError("paged decode failed and no contiguous engine")
-        if self.speculative is not None and temperature == 0.0:
+        if self.speculative is not None:
+            # greedy calls are bit-exact, sampled calls distribution-exact
+            # (rejection-sampling acceptance) — both legitimately served by
+            # the draft-accelerated path
             return self.speculative.generate(
-                [prompt], max_new_tokens=max_new_tokens
+                [prompt], max_new_tokens=max_new_tokens, temperature=temperature
             )[0].text
         result = self.engine.generate(
             [prompt], max_new_tokens=max_new_tokens, temperature=temperature

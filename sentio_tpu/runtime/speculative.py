@@ -1,14 +1,20 @@
-"""Speculative decoding: draft-and-verify generation, exact under greedy.
+"""Speculative decoding: draft-and-verify generation, exact by construction.
 
 Decode is bandwidth-bound — every step streams the full target weights for
 one token per row. A small draft model proposes ``k`` tokens autoregressively
 (cheap: draft weights are a fraction of the target's), then the target
 scores all of them in ONE forward of T = k+1 (amortizing its weight stream
-over up to k+1 emitted tokens). Greedy acceptance keeps the longest prefix
-where the target's own argmax agrees with the draft, then emits the
-target's correction token — so the emitted sequence is bit-identical to
-target-only greedy decoding; the draft only changes HOW FAST tokens appear,
-never WHICH tokens (asserted by tests).
+over up to k+1 emitted tokens). Two acceptance rules:
+
+* **Greedy (temperature 0)** — keep the longest prefix where the target's
+  own argmax agrees with the draft, then emit the target's correction
+  token: bit-identical to target-only greedy decoding (asserted by tests).
+* **Sampled (temperature > 0)** — rejection-sampling acceptance
+  (:func:`accept_and_correct`): each emitted token's marginal equals
+  sampling the target alone at that temperature (checked empirically).
+
+Either way the draft only changes HOW FAST tokens appear, never the
+output's law.
 
 TPU-shaped implementation: the whole generate loop is one
 ``lax.while_loop`` on device — per round, an inner ``lax.scan`` drafts k
@@ -40,6 +46,47 @@ class SpeculativeError(Exception):
     pass
 
 
+def accept_and_correct(rng, drafts, qdists, tprobs):
+    """Rejection-sampling acceptance for sampled speculation.
+
+    drafts [B, k] proposed tokens; qdists [B, k, V] the draft's sampling
+    distributions; tprobs [B, k+1, V] the target's distributions at the
+    verified positions. Accept d_j with probability min(1, p_t(d_j)/q(d_j))
+    while the prefix holds; at the first rejection sample the correction
+    from the residual ``norm(relu(p_t - q))``, and after a full accept
+    sample the bonus token from the target's (k+1)-th distribution. The
+    emitted marginal equals sampling from the target alone — the standard
+    speculative-sampling guarantee (tested empirically in
+    tests/test_speculative.py).
+
+    Returns (n_accept [B], correction [B]).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    b, k = drafts.shape
+    rng_u, rng_c = jax.random.split(rng)
+    u = jax.random.uniform(rng_u, (b, k))
+    p_chosen = jnp.take_along_axis(tprobs[:, :k], drafts[..., None], axis=2)[..., 0]
+    q_chosen = jnp.take_along_axis(qdists, drafts[..., None], axis=2)[..., 0]
+    ratio = p_chosen / jnp.maximum(q_chosen, 1e-20)
+    acc = u < jnp.minimum(ratio, 1.0)
+    n_accept = jnp.cumprod(acc.astype(jnp.int32), axis=1).sum(axis=1)
+
+    # correction distribution at position j* = n_accept
+    resid = jnp.maximum(tprobs[:, :k] - qdists, 0.0)          # [B, k, V]
+    resid_full = jnp.concatenate([resid, tprobs[:, k:]], axis=1)
+    sel = jnp.take_along_axis(
+        resid_full, n_accept[:, None, None], axis=1
+    )[:, 0]                                                    # [B, V]
+    norm = sel.sum(-1, keepdims=True)
+    tsel = jnp.take_along_axis(tprobs, n_accept[:, None, None], axis=1)[:, 0]
+    # identical target/draft distributions → zero residual → target dist
+    dist = jnp.where(norm > 1e-9, sel / jnp.maximum(norm, 1e-9), tsel)
+    correction = jax.random.categorical(rng_c, jnp.log(dist + 1e-20), axis=-1)
+    return n_accept, correction.astype(jnp.int32)
+
+
 def build_spec_generate(target_fwd, target_cfg, draft_fwd, draft_cfg, eos_id: int,
                         attn_fn=None):
     """Compile the fused speculative generate: (params_t, params_d, ids,
@@ -52,11 +99,12 @@ def build_spec_generate(target_fwd, target_cfg, draft_fwd, draft_cfg, eos_id: in
     import jax
     import jax.numpy as jnp
 
-    @partial(jax.jit, static_argnames=("steps", "k"))
+    @partial(jax.jit, static_argnames=("steps", "k", "sampled"))
     def spec_generate(params_t, params_d, ids, positions, lens, tcache, dcache,
-                      steps, k, pad_mask):
+                      steps, k, pad_mask, rng, temperature, sampled=False):
         b, width = ids.shape
         row_valid = pad_mask.any(axis=1, keepdims=True)  # junk bucket rows
+        inv_t = 1.0 / jnp.maximum(temperature, 1e-6)
 
         # prefill both models over the prompt (one dispatch each, fused
         # here); pad_mask keeps padding out of routed-expert capacity and
@@ -71,7 +119,11 @@ def build_spec_generate(target_fwd, target_cfg, draft_fwd, draft_cfg, eos_id: in
             cache_index=0, pad_mask=pad_mask, attn_fn=attn_fn,
         )
         last = jnp.take_along_axis(t_logits, (lens - 1)[:, None, None], axis=1)[:, 0]
-        cur = jnp.argmax(last, axis=-1).astype(jnp.int32)  # first token, target greedy
+        if sampled:
+            rng, sub = jax.random.split(rng)
+            cur = jax.random.categorical(sub, last * inv_t, axis=-1).astype(jnp.int32)
+        else:
+            cur = jnp.argmax(last, axis=-1).astype(jnp.int32)  # target greedy
 
         out_w = steps + k + 1
         out0 = jnp.full((b, out_w), eos_id, jnp.int32)
@@ -84,7 +136,7 @@ def build_spec_generate(target_fwd, target_cfg, draft_fwd, draft_cfg, eos_id: in
         done0 = (cur == eos_id) | ~row_valid[:, 0]
 
         def round_body(state):
-            cur, lens, emitted, done, tcache, dcache, out, rounds = state
+            cur, lens, emitted, done, tcache, dcache, out, rounds, rng_in = state
             live = row_valid & ~done[:, None]
 
             # ---- draft autoregressively (T=1 scan over the draft). k+1
@@ -93,19 +145,31 @@ def build_spec_generate(target_fwd, target_cfg, draft_fwd, draft_cfg, eos_id: in
             # fully-accepted round advances lens past it — without it the
             # draft cache keeps a permanently-unwritten, attended slot and
             # acceptance decays exactly when the draft is good.
-            def draft_step(carry, _):
+            def draft_step(carry, key):
                 tok, dlens, dcache = carry
                 logits, dcache = draft_fwd(
                     params_d, draft_cfg, tok[:, None], positions=dlens[:, None],
                     cache=dcache, cache_index=dlens, pad_mask=live,
                 )
-                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-                return (nxt, dlens + 1, dcache), nxt
+                if sampled:
+                    qdist = jax.nn.softmax(
+                        logits[:, -1].astype(jnp.float32) * inv_t, axis=-1
+                    )
+                    nxt = jax.random.categorical(
+                        key, logits[:, -1] * inv_t, axis=-1
+                    ).astype(jnp.int32)
+                else:
+                    qdist = jnp.zeros((b, 1), jnp.float32)  # unused
+                    nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return (nxt, dlens + 1, dcache), (nxt, qdist)
 
-            (_, _, dcache), drafts = jax.lax.scan(
-                draft_step, (cur, lens, dcache), None, length=k + 1
+            rng, draft_rng = jax.random.split(rng_in)
+            (_, _, dcache), (drafts, qdists) = jax.lax.scan(
+                draft_step, (cur, lens, dcache),
+                jax.random.split(draft_rng, k + 1),
             )
-            drafts = jnp.moveaxis(drafts, 0, 1)[:, :k]  # [B, k]
+            drafts = jnp.moveaxis(drafts, 0, 1)[:, :k]   # [B, k]
+            qdists = jnp.moveaxis(qdists, 0, 1)[:, :k]   # [B, k, V]
 
             # ---- target verifies cur + drafts in one T=k+1 forward
             block = jnp.concatenate([cur[:, None], drafts], axis=1)  # [B, k+1]
@@ -115,19 +179,31 @@ def build_spec_generate(target_fwd, target_cfg, draft_fwd, draft_cfg, eos_id: in
                 cache_index=lens,
                 pad_mask=jnp.broadcast_to(live, (b, k + 1)),
             )
-            targets = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B, k+1]
 
-            # ---- longest agreeing prefix: accept drafts[j] while it equals
-            # targets[j] (the target's choice AFTER cur, d1..dj-1)
-            agree = drafts == targets[:, :k]                       # [B, k]
-            n_accept = jnp.cumprod(agree.astype(jnp.int32), axis=1).sum(axis=1)
+            j = jnp.arange(k + 1)[None, :]
+            if sampled:
+                # ---- rejection-sampling acceptance: emitted marginal equals
+                # sampling the target alone (accept_and_correct docstring)
+                tprobs = jax.nn.softmax(
+                    t_logits.astype(jnp.float32) * inv_t, axis=-1
+                )
+                rng, acc_rng = jax.random.split(rng)
+                n_accept, corr_tok = accept_and_correct(
+                    acc_rng, drafts, qdists, tprobs
+                )
+                correction = corr_tok[:, None]
+            else:
+                # ---- greedy: longest prefix where the draft equals the
+                # target's own argmax (the choice AFTER cur, d1..dj-1)
+                targets = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+                agree = drafts == targets[:, :k]                   # [B, k]
+                n_accept = jnp.cumprod(agree.astype(jnp.int32), axis=1).sum(axis=1)
+                correction = jnp.take_along_axis(targets, n_accept[:, None], axis=1)
             # tokens emitted this round per live row: accepted drafts plus
-            # the target's correction/bonus token
+            # the correction/bonus token
             emit_n = n_accept + 1                                   # [B] in 1..k+1
 
-            # round tokens [B, k+1]: d1..dm, t_{m+1}, padding after
-            j = jnp.arange(k + 1)[None, :]
-            correction = jnp.take_along_axis(targets, n_accept[:, None], axis=1)
+            # round tokens [B, k+1]: d1..dm, correction, padding after
             round_toks = jnp.where(
                 j < n_accept[:, None], jnp.pad(drafts, ((0, 0), (0, 1))),
                 jnp.where(j == n_accept[:, None], correction, eos_id),
@@ -159,14 +235,15 @@ def build_spec_generate(target_fwd, target_cfg, draft_fwd, draft_cfg, eos_id: in
             # otherwise fast rows would keep speculating garbage (and
             # growing lens) while slow rows finish
             row_done = row_done | (emitted >= steps)
-            return (cur, lens, emitted, row_done, tcache, dcache, out, rounds + 1)
+            return (cur, lens, emitted, row_done, tcache, dcache, out, rounds + 1, rng)
 
         def cond(state):
-            _, _, _, done, _, _, _, _ = state
+            done = state[3]
             return jnp.any(~done)
 
-        state = (cur, lens, emitted0, done0, tcache, dcache, out0, jnp.zeros((), jnp.int32))
-        _, _, emitted, _, _, _, out, rounds = jax.lax.while_loop(
+        state = (cur, lens, emitted0, done0, tcache, dcache, out0,
+                 jnp.zeros((), jnp.int32), rng)
+        _, _, emitted, _, _, _, out, rounds, _ = jax.lax.while_loop(
             cond, round_body, state
         )
         return out, emitted, rounds
@@ -177,10 +254,13 @@ def build_spec_generate(target_fwd, target_cfg, draft_fwd, draft_cfg, eos_id: in
 class SpeculativeDecoder:
     """Draft-model wrapper for a GeneratorEngine-style target.
 
-    Greedy-exact: ``generate`` emits the same tokens as the target engine's
-    plain greedy decode; the ``k`` drafted tokens per round only reduce the
-    number of target weight streams per token. Exposes acceptance stats so
-    operators can judge whether their draft earns its keep.
+    Temperature 0: greedy-exact — ``generate`` emits the same tokens as the
+    target engine's plain greedy decode. Temperature > 0: distribution-
+    exact — rejection-sampling acceptance makes each emitted token's
+    marginal equal to sampling the target alone. Either way the ``k``
+    drafted tokens per round only reduce the number of target weight
+    streams per token. Exposes acceptance stats so operators can judge
+    whether their draft earns its keep.
     """
 
     def __init__(self, engine, draft_params, draft_config, k: int = 4,
@@ -232,9 +312,15 @@ class SpeculativeDecoder:
             attn_fn=engine._attn_fn,
         )
 
-    def generate(self, prompts, max_new_tokens: Optional[int] = None):
-        """Batched greedy generation through the speculative loop. Returns
-        the same GenerationResult list as ``engine.generate(temperature=0)``."""
+    def generate(self, prompts, max_new_tokens: Optional[int] = None,
+                 temperature: float = 0.0):
+        """Batched generation through the speculative loop.
+
+        ``temperature == 0``: greedy acceptance — bit-identical to
+        ``engine.generate(temperature=0)``. ``temperature > 0``: rejection-
+        sampling acceptance — each emitted token is distributed exactly as
+        sampling the target alone at that temperature (the draft changes
+        speed, not the distribution)."""
         import time as _time
 
         import jax.numpy as jnp
@@ -255,13 +341,24 @@ class SpeculativeDecoder:
             # near-window prompts: the verify block's k+1 spill would force
             # a shorter budget than the plain path — fall back so the spec
             # seam never returns fewer tokens than engine.generate would
-            return eng.generate(prompts, max_new_tokens=max_new, temperature=0.0)
+            return eng.generate(
+                prompts, max_new_tokens=max_new, temperature=temperature
+            )
         max_new = spec_steps
         dcache = init_cache(self.draft_config, ids.shape[0], window)
 
+        import jax
+
+        if temperature > 0.0:
+            eng._rng, sub = jax.random.split(eng._rng)
+        else:
+            # greedy never samples — keep the engine's RNG stream untouched
+            sub = jax.random.PRNGKey(0)
         out, emitted, rounds = self._fn(
             eng.params, self.draft_params, ids, positions, jnp.asarray(lens),
             tcache, dcache, max_new, self.k, jnp.asarray(pad_mask),
+            sub, jnp.asarray(temperature, jnp.float32),
+            sampled=temperature > 0.0,
         )
         out = np.asarray(out)
         emitted = np.asarray(emitted)

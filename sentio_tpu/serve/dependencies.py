@@ -196,10 +196,11 @@ class DependencyContainer:
 
     @property
     def speculative(self):
-        """Draft-accelerated greedy decoder over the contiguous engine
+        """Draft-accelerated decoder over the contiguous engine
         (runtime/speculative.py) — built when a draft checkpoint is
-        configured. Greedy-exact, so it transparently serves temperature-0
-        requests on the non-paged path."""
+        configured. Greedy calls are bit-exact and sampled calls
+        distribution-exact, so it transparently serves all non-paged
+        requests."""
 
         def build():
             cfg = self.settings.generator

@@ -89,6 +89,62 @@ class TestMoeTarget:
         assert [r.tokens for r in got] == [r.tokens for r in ref]
 
 
+class TestSampledSpeculation:
+    def test_acceptance_kernel_preserves_target_distribution(self):
+        """The whole-point property of rejection-sampling speculation: the
+        marginal of the FIRST emitted token equals the target distribution,
+        for an arbitrary (mismatched) draft. Empirical check over 40k
+        independent single-round draws on a toy vocab."""
+        from sentio_tpu.runtime.speculative import accept_and_correct
+
+        v, k, n = 6, 1, 40_000
+        rng = np.random.default_rng(0)
+        p_t = rng.dirichlet(np.ones(v))          # target dist
+        q = rng.dirichlet(np.ones(v) * 0.3)      # very different draft dist
+
+        tprobs = jnp.asarray(
+            np.broadcast_to(p_t, (n, k + 1, v)).copy(), jnp.float32
+        )
+        qdists = jnp.asarray(np.broadcast_to(q, (n, k, v)).copy(), jnp.float32)
+        keys = jax.random.split(jax.random.PRNGKey(1), n + 1)
+        drafts = jax.random.categorical(
+            keys[0], jnp.log(qdists[:, 0] + 1e-20), axis=-1
+        )[:, None].astype(jnp.int32)
+
+        def one(key, d):
+            n_acc, corr = accept_and_correct(
+                key, d[None], qdists[:1], tprobs[:1]
+            )
+            # first emitted token: the draft if accepted, else the correction
+            return jnp.where(n_acc[0] > 0, d[0], corr[0])
+
+        emitted = np.asarray(jax.vmap(one)(keys[1:], drafts))
+        freq = np.bincount(emitted, minlength=v) / n
+        np.testing.assert_allclose(freq, p_t, atol=0.015)
+
+    def test_sampled_generate_runs_and_is_seed_deterministic(self, target_engine):
+        draft_cfg = LlamaConfig.tiny()
+        draft_params = init_llama(jax.random.PRNGKey(999), draft_cfg)
+        spec = SpeculativeDecoder(target_engine, draft_params, draft_cfg, k=3)
+
+        target_engine._rng = jax.random.PRNGKey(42)
+        a = spec.generate(["sampled round"], max_new_tokens=10, temperature=0.7)
+        target_engine._rng = jax.random.PRNGKey(42)
+        b = spec.generate(["sampled round"], max_new_tokens=10, temperature=0.7)
+        assert a[0].tokens == b[0].tokens  # same rng → same stream
+        assert 1 <= len(a[0].tokens) <= 10
+
+    def test_sampled_vs_greedy_paths_differ_only_by_sampling(self, target_engine):
+        """temperature→0 sampled acceptance degenerates to greedy: the
+        categorical at inv_t=1e6-scaled logits is argmax almost surely."""
+        spec = SpeculativeDecoder(
+            target_engine, target_engine.params, target_engine.model_config, k=3
+        )
+        greedy = spec.generate(["limit check"], max_new_tokens=8, temperature=0.0)
+        cold = spec.generate(["limit check"], max_new_tokens=8, temperature=1e-5)
+        assert greedy[0].tokens == cold[0].tokens
+
+
 class TestServingIntegration:
     def test_provider_routes_greedy_calls_through_spec(self, target_engine):
         from sentio_tpu.ops.generator import TpuProvider
@@ -99,11 +155,12 @@ class TestServingIntegration:
         provider = TpuProvider(engine=target_engine, speculative=spec)
         before = dict(spec.stats)
         text = provider.chat("route me", max_new_tokens=6, temperature=0.0)
-        assert spec.stats["rounds"] > before["rounds"]  # spec path taken
-        # sampled calls bypass spec (greedy-exactness only holds at temp 0)
+        assert spec.stats["rounds"] > before["rounds"]  # greedy spec path
+        # sampled calls also route through spec (rejection-sampling
+        # acceptance is distribution-exact)
         before = dict(spec.stats)
         provider.chat("sampled", max_new_tokens=6, temperature=0.7)
-        assert spec.stats["rounds"] == before["rounds"]
+        assert spec.stats["rounds"] > before["rounds"]
         assert isinstance(text, str)
 
     def test_container_builds_spec_from_draft_checkpoint(self, tmp_path):
