@@ -238,6 +238,9 @@ class GeneratorConfig:
     dtype: str = "bfloat16"
     kv_page_size: int = 128
     kv_max_pages_per_seq: int = 64
+    # "int8" stores KV pages quantized (per-vector absmax scales): ~half the
+    # pool HBM and decode-read bandwidth, at ~1 percent attention-score error
+    kv_quant: str = "none"
     max_batch_size: int = 8
     # paged KV + continuous batching as the live /chat decode path; the
     # contiguous engine remains for streaming and as an escape hatch
@@ -285,6 +288,7 @@ class GeneratorConfig:
             dtype=_env_str(["LLM_DTYPE"], "bfloat16"),
             kv_page_size=_env_int(["KV_PAGE_SIZE"], 128),
             kv_max_pages_per_seq=_env_int(["KV_MAX_PAGES_PER_SEQ"], 64),
+            kv_quant=_env_str(["KV_QUANT"], "none"),
             max_batch_size=_env_int(["LLM_MAX_BATCH"], 8),
             use_paged_decode=_env_bool(["USE_PAGED_KV", "USE_PAGED_DECODE"], True),
             decode_steps_per_tick=_env_int(["DECODE_STEPS_PER_TICK"], 16),

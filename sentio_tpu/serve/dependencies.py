@@ -255,6 +255,7 @@ class DependencyContainer:
                 steps_per_tick=cfg.decode_steps_per_tick,
                 max_tick_steps=cfg.decode_max_tick_steps,
                 pipeline_depth=cfg.decode_pipeline_depth,
+                kv_quant=cfg.kv_quant,
                 mesh=self.mesh,  # pool kv-heads shard over tp with the weights
             )
             return PagedGenerationService(paged)
