@@ -241,6 +241,9 @@ class GeneratorConfig:
     # "int8" stores KV pages quantized (per-vector absmax scales): ~half the
     # pool HBM and decode-read bandwidth, at ~1 percent attention-score error
     kv_quant: str = "none"
+    # prefill the rendered prompt-template head once and share its KV pages
+    # across all /chat requests (read-only; runtime/paged.py register_prefix)
+    prefix_cache: bool = True
     max_batch_size: int = 8
     # paged KV + continuous batching as the live /chat decode path; the
     # contiguous engine remains for streaming and as an escape hatch
@@ -289,6 +292,7 @@ class GeneratorConfig:
             kv_page_size=_env_int(["KV_PAGE_SIZE"], 128),
             kv_max_pages_per_seq=_env_int(["KV_MAX_PAGES_PER_SEQ"], 64),
             kv_quant=_env_str(["KV_QUANT"], "none"),
+            prefix_cache=_env_bool(["PREFIX_CACHE"], True),
             max_batch_size=_env_int(["LLM_MAX_BATCH"], 8),
             use_paged_decode=_env_bool(["USE_PAGED_KV", "USE_PAGED_DECODE"], True),
             decode_steps_per_tick=_env_int(["DECODE_STEPS_PER_TICK"], 16),

@@ -350,6 +350,9 @@ class _Slot:
     # decode sub-steps granted to dispatched-but-unharvested ticks — budget
     # math must count them or a pipelined tick would over-run the limits
     inflight_steps: int = 0
+    # tokens served from shared (read-only) prefix pages at the front of
+    # this slot's page table — counted in capacity, never freed by retire
+    shared_tokens: int = 0
 
 
 @dataclass
@@ -486,6 +489,10 @@ class ContinuousBatchingEngine:
         # HBM-utilization math must use this, not ticks x steps_per_tick
         self.total_sub_steps = 0
         self._queue: list[_Request] = []
+        # shared-prefix cache (register_prefix): {"tokens", "pages", "n"} —
+        # page-aligned KV of a common prompt prefix, referenced read-only by
+        # matching requests' page tables and never freed by retire
+        self._prefix = None
         self._finished_buffer: list[PagedResult] = []
         # (first_tokens_device_array, [slot_idx, ...]) per admission chunk,
         # consumed by the next decode tick
@@ -627,12 +634,99 @@ class ContinuousBatchingEngine:
 
         self._prefill_scatter = prefill_scatter
 
+        @partial(jax.jit, static_argnames=("n_shared",), donate_argnums=(7, 8))
+        def prefix_prefill_scatter(params, ids, positions, lens, rng, temps,
+                                   scat, k_pages, v_pages, prefix_table,
+                                   n_shared):
+            """Suffix admission against a shared prefix: prime a contiguous
+            cache with the prefix KV gathered from its (read-only) pool
+            pages, prefill ONLY the suffix tokens at offset positions, and
+            scatter only the suffix blocks. ``ids``/``lens`` are the suffix;
+            sampling happens at each row's last suffix logit."""
+            from sentio_tpu.models.llama import init_cache
+            from sentio_tpu.runtime.sampling import sample_tokens
+
+            b, width = ids.shape
+            cache = init_cache(cfg, b, n_shared + width)
+
+            def prime(cache_arr, pages):
+                # gather the prefix blocks for ALL layers in one indexed
+                # read; same prefix for every row (broadcast over B)
+                if isinstance(pages, dict):
+                    qv = pages["q"][:, prefix_table[0]]
+                    sc = pages["s"][:, prefix_table[0]]
+                    dense = dequantize_kv(qv, sc, cache_arr.dtype)
+                else:
+                    dense = pages[:, prefix_table[0]]
+                lcount, nb_, pg_, hk_, hd_ = dense.shape
+                prefix_kv = dense.reshape(lcount, nb_ * pg_, hk_, hd_)
+                return cache_arr.at[:, :, :n_shared].set(prefix_kv[:, None])
+
+            cache = dict(cache)
+            cache["k"] = prime(cache["k"], k_pages)
+            cache["v"] = prime(cache["v"], v_pages)
+
+            pad_mask = jnp.arange(width)[None, :] < lens[:, None]
+            logits, cache = forward_fn(
+                params, cfg, ids, positions=positions, cache=cache,
+                cache_index=n_shared, pad_mask=pad_mask,
+            )
+            # scatter ONLY the suffix blocks (prefix pages are shared)
+            k_pages, v_pages = scatter_prefill(
+                k_pages, v_pages,
+                cache["k"][:, :, n_shared:], cache["v"][:, :, n_shared:], scat,
+            )
+            last = jnp.take_along_axis(logits, (lens - 1)[:, None, None], axis=1)[:, 0]
+            rng, sub = jax.random.split(rng)
+            first = sample_tokens(last, sub, temps)
+            return first, k_pages, v_pages, rng
+
+        self._prefix_prefill_scatter = prefix_prefill_scatter
+
     # --------------------------------------------------------------- public
 
     def submit(self, prompt: str, max_new_tokens: int = 64, temperature: float = 0.0) -> int:
         rid = next(self._next_id)
         self._queue.append(_Request(rid, prompt, max_new_tokens, temperature))
         return rid
+
+    def register_prefix(self, text: str) -> int:
+        """Prefill a shared prompt prefix ONCE and let every matching
+        request's page table reference its pages read-only (the RAG
+        pipeline's instruction header is identical across requests — the
+        classic prefix-cache win). Only full pages are shared; the
+        remainder re-prefills per request. Returns the number of shared
+        tokens (0 = prefix shorter than one page, nothing cached).
+
+        One prefix at a time; registering again replaces it (the old pages
+        are freed once no live slot references them — here: immediately,
+        callers must register between requests, not mid-flight)."""
+        toks = self.tokenizer.encode(text, add_bos=True)
+        n_blocks = len(toks) // self.page_size
+        # cap: leave at least half the table for per-request suffix+decode
+        n_blocks = min(n_blocks, self.max_pages_per_seq // 2)
+        # drop the old prefix FIRST (also on the too-short path — its pages
+        # must not leak) and clear the pointer before freeing so a failed
+        # re-registration can never leave _prefix referencing freed pages
+        old_prefix, self._prefix = self._prefix, None
+        if old_prefix is not None:
+            self.allocator.free(old_prefix["pages"])
+        if n_blocks == 0:
+            return 0
+        n_shared = n_blocks * self.page_size
+        pages = self.allocator.alloc(n_blocks)
+
+        width = self._prefill_width(n_shared)
+        ids, lens, temps, scat, positions = self._assemble_prefill(
+            [(toks[:n_shared], 0.0, pages)], width
+        )
+        # the sampled token is discarded — this dispatch only fills pages
+        _first, self.pool.k, self.pool.v, self._rng = self._prefill_scatter(
+            self.params, ids, positions, lens, self._rng, temps, scat,
+            self.pool.k, self.pool.v,
+        )
+        self._prefix = {"tokens": toks[:n_shared], "pages": pages, "n": n_shared}
+        return n_shared
 
     def cancel(self, request_id: int) -> bool:
         """Abandon a request: queued → dropped; decoding → slot retired and
@@ -669,6 +763,7 @@ class ContinuousBatchingEngine:
         self._pending_first.clear()
         self._dev_state = None
         self._inflight = None
+        self._prefix = None
         self._page_table[:] = 0
         self._lens[:] = 0
         self._temps[:] = 0.0
@@ -736,7 +831,7 @@ class ContinuousBatchingEngine:
         if not free or not self._queue:
             return
 
-        batch: list[tuple[int, _Request, list[int]]] = []
+        batch: list[tuple[int, _Request, list[int], int]] = []
         while self._queue and free:
             req = self._queue[0]
             tok_ids = self.tokenizer.encode(req.prompt, add_bos=True)
@@ -748,16 +843,29 @@ class ContinuousBatchingEngine:
             window = self.max_pages_per_seq * self.page_size
             reserve = min(req.max_new + 2, window // 2)
             tok_ids = tok_ids[: window - reserve]
+            # shared-prefix hit: the prompt starts with the registered
+            # prefix AND extends past it → its table reuses the prefix
+            # pages read-only and only the suffix prefills
+            pfx = self._prefix
+            shared = 0
+            if (
+                pfx is not None
+                and len(tok_ids) > pfx["n"]
+                and tok_ids[: pfx["n"]] == pfx["tokens"]
+            ):
+                shared = pfx["n"]
+            shared_blocks = shared // self.page_size
             need_total = min(
-                (len(tok_ids) + req.max_new + self.page_size - 1) // self.page_size,
-                self.max_pages_per_seq,
+                (len(tok_ids) - shared + req.max_new + self.page_size - 1)
+                // self.page_size,
+                self.max_pages_per_seq - shared_blocks,
             )
             if need_total > self.allocator.free_pages:
                 break  # head-of-line blocks until pages free up (no starvation)
             pages = self.allocator.alloc(need_total)
             slot_idx = free.pop(0)
             self._queue.pop(0)
-            batch.append((slot_idx, req, tok_ids))
+            batch.append((slot_idx, req, tok_ids, shared))
             slot = self.slots[slot_idx]
             slot.request_id = req.request_id
             slot.pages = pages
@@ -767,9 +875,12 @@ class ContinuousBatchingEngine:
             slot.temperature = req.temperature
             slot.emitted = []
             slot.inflight_steps = 0
+            slot.shared_tokens = shared
             slot.active = True
             row = np.zeros(self.max_pages_per_seq, np.int32)
-            row[: len(pages)] = pages
+            if shared_blocks:
+                row[:shared_blocks] = pfx["pages"]
+            row[shared_blocks : shared_blocks + len(pages)] = pages
             self._page_table[slot_idx] = row
             self._lens[slot_idx] = len(tok_ids)
             self._temps[slot_idx] = req.temperature
@@ -783,44 +894,84 @@ class ContinuousBatchingEngine:
         # sampled first tokens STAY ON DEVICE (slot.pending_first): the next
         # tick merges them into its token input and its single packed fetch
         # carries them back — admission adds zero host round trips.
-        groups: dict[int, list[tuple[int, _Request, list[int]]]] = {}
+        groups: dict[tuple[int, int], list] = {}
         for item in batch:
-            groups.setdefault(self._prefill_width(len(item[2])), []).append(item)
+            shared = item[3]
+            width = self._prefill_width(len(item[2]) - shared)
+            groups.setdefault((width, shared), []).append(item)
         max_rows = max(self.ADMIT_BUCKETS)
-        for width, members in sorted(groups.items()):
+        for (width, shared), members in sorted(groups.items()):
             for start in range(0, len(members), max_rows):
-                self._prefill_chunk(width, members[start : start + max_rows])
+                chunk = members[start : start + max_rows]
+                if shared:
+                    self._prefill_chunk_prefixed(width, shared, chunk)
+                else:
+                    self._prefill_chunk(width, [m[:3] for m in chunk])
+
+    def _assemble_prefill(self, rows_data, width: int, pos_offset: int = 0):
+        """Build the padded admission arrays ONE way for every prefill
+        flavor. rows_data: [(token_ids, temperature, pages)]. Pad rows and
+        unused scatter blocks point at scratch page 0; args stay host numpy
+        (a jit call ships them asynchronously, while an explicit
+        jnp.asarray is a SYNCHRONOUS upload — ~RTT each on remote-attached
+        devices)."""
+        rows = bucket_size(len(rows_data), self.ADMIT_BUCKETS)
+        nb = width // self.page_size
+        ids = np.full((rows, width), self.tokenizer.pad_id, np.int32)
+        lens = np.ones(rows, np.int32)
+        temps = np.zeros(rows, np.float32)
+        scat = np.zeros((rows, nb), np.int32)
+        for r, (tok_ids, temp, pages) in enumerate(rows_data):
+            ids[r, : len(tok_ids)] = tok_ids
+            lens[r] = len(tok_ids)
+            temps[r] = temp
+            used = (len(tok_ids) + self.page_size - 1) // self.page_size
+            scat[r, :used] = pages[:used]
+        positions = (
+            pos_offset
+            + np.broadcast_to(
+                np.arange(width, dtype=np.int32)[None, :], (rows, width)
+            )
+        ).astype(np.int32)
+        return ids, lens, temps, scat, positions
 
     def _prefill_chunk(
         self, width: int, chunk: list[tuple[int, _Request, list[int]]]
     ) -> None:
         """One prefill+scatter+sample dispatch for up to max(ADMIT_BUCKETS)
         same-width-bucket rows (rows pad up to a batch bucket)."""
-        import jax.numpy as jnp
-
-        rows = bucket_size(len(chunk), self.ADMIT_BUCKETS)
-        nb = width // self.page_size
-        ids = np.full((rows, width), self.tokenizer.pad_id, np.int32)
-        lens = np.ones(rows, np.int32)
-        temps = np.zeros(rows, np.float32)
-        scat = np.zeros((rows, nb), np.int32)  # pad rows/blocks → scratch 0
-        for r, (slot_idx, req, tok_ids) in enumerate(chunk):
-            ids[r, : len(tok_ids)] = tok_ids
-            lens[r] = len(tok_ids)
-            temps[r] = req.temperature
-            used = (len(tok_ids) + self.page_size - 1) // self.page_size
-            scat[r, :used] = self.slots[slot_idx].pages[:used]
-        positions = np.broadcast_to(
-            np.arange(width, dtype=np.int32)[None, :], (rows, width)
-        ).copy()
-        # args stay host numpy: a jit call ships them asynchronously, while
-        # an explicit jnp.asarray is a SYNCHRONOUS upload (~RTT each on
-        # remote-attached devices)
+        ids, lens, temps, scat, positions = self._assemble_prefill(
+            [(tok_ids, req.temperature, self.slots[slot_idx].pages)
+             for slot_idx, req, tok_ids in chunk],
+            width,
+        )
         first, self.pool.k, self.pool.v, self._rng = self._prefill_scatter(
             self.params, ids, positions, lens, self._rng, temps, scat,
             self.pool.k, self.pool.v,
         )
         slot_idxs = [slot_idx for slot_idx, _req, _ids in chunk]
+        for slot_idx in slot_idxs:
+            self.slots[slot_idx].pending_first = True
+        self._pending_first.append((first, slot_idxs))
+
+    def _prefill_chunk_prefixed(
+        self, width: int, shared: int, chunk: list
+    ) -> None:
+        """Suffix-only admission for rows sharing the registered prefix:
+        ids/positions/scatter cover ONLY the post-prefix tokens; the
+        compiled fn primes the cache from the shared pages first."""
+        shared_blocks = shared // self.page_size
+        ids, lens, temps, scat, positions = self._assemble_prefill(
+            [(tok_ids[shared:], req.temperature, self.slots[slot_idx].pages)
+             for slot_idx, req, tok_ids, _sh in chunk],
+            width, pos_offset=shared,
+        )
+        prefix_table = np.asarray([self._prefix["pages"][:shared_blocks]], np.int32)
+        first, self.pool.k, self.pool.v, self._rng = self._prefix_prefill_scatter(
+            self.params, ids, positions, lens, self._rng, temps, scat,
+            self.pool.k, self.pool.v, prefix_table, n_shared=shared,
+        )
+        slot_idxs = [slot_idx for slot_idx, _req, _ids, _sh in chunk]
         for slot_idx in slot_idxs:
             self.slots[slot_idx].pending_first = True
         self._pending_first.append((first, slot_idxs))
@@ -835,7 +986,7 @@ class ContinuousBatchingEngine:
         for i, slot in enumerate(self.slots):
             if not slot.active:
                 continue
-            capacity = len(slot.pages) * self.page_size
+            capacity = slot.shared_tokens + len(slot.pages) * self.page_size
             # a pending (still-on-device) first token and any sub-steps
             # already granted to an unharvested tick count against the
             # budget exactly as if they had been folded
@@ -983,7 +1134,8 @@ class ContinuousBatchingEngine:
         if not hit_eos:
             slot.emitted.append(tok)
         hit_len = len(slot.emitted) >= slot.max_new
-        out_of_pages = slot.length + 1 >= len(slot.pages) * self.page_size
+        capacity = slot.shared_tokens + len(slot.pages) * self.page_size
+        out_of_pages = slot.length + 1 >= capacity
         if hit_eos or hit_len or out_of_pages:
             return self._retire(i, "stop" if hit_eos else "length")
         return None
@@ -1003,6 +1155,7 @@ class ContinuousBatchingEngine:
         slot.pending_first = False
         slot.inflight_steps = 0
         slot.pages = []
+        slot.shared_tokens = 0
         self._page_table[i] = 0
         self._lens[i] = 0
         self._temps[i] = 0.0

@@ -258,6 +258,22 @@ class DependencyContainer:
                 kv_quant=cfg.kv_quant,
                 mesh=self.mesh,  # pool kv-heads shard over tp with the weights
             )
+            if cfg.prefix_cache:
+                # every /chat prompt starts with the same rendered template
+                # head (instruction + section header) — prefill its KV once
+                # and let all matching requests reference it read-only
+                from sentio_tpu.ops.prompts import PromptBuilder
+
+                prompts = PromptBuilder()
+                head = prompts.static_head(
+                    "retrieve", instruction=prompts.load("profile")
+                )
+                shared = paged.register_prefix(head) if head else 0
+                if shared:
+                    logger.info(
+                        "prefix cache: %d shared tokens across /chat prompts",
+                        shared,
+                    )
             return PagedGenerationService(paged)
 
         return self._get("generation_service", build)

@@ -1,0 +1,127 @@
+"""Shared-prefix KV caching (runtime/paged.py register_prefix): matching
+requests reuse the prefix pages read-only and prefill only their suffix;
+generation must match the no-prefix engine."""
+
+import numpy as np
+import pytest
+
+from sentio_tpu.models.llama import LlamaConfig
+from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+
+
+HEADER = "You are a careful assistant. Cite sources. Answer concisely. "
+
+
+def make_engine(**kw):
+    return ContinuousBatchingEngine(
+        model_config=LlamaConfig.tiny(), max_slots=4, page_size=16,
+        max_pages_per_seq=8, steps_per_tick=4, ignore_eos=True, **kw,
+    )
+
+
+class TestRegistration:
+    def test_register_returns_page_aligned_count(self):
+        eng = make_engine()
+        n = eng.register_prefix(HEADER)
+        assert n > 0 and n % eng.page_size == 0
+        # ByteTokenizer ~1 token/char (+BOS)
+        assert n <= len(HEADER) + 1
+
+    def test_short_prefix_not_cached(self):
+        eng = make_engine()
+        assert eng.register_prefix("hi") == 0
+        assert eng._prefix is None
+
+    def test_reregister_frees_old_pages(self):
+        eng = make_engine()
+        base = eng.allocator.free_pages
+        eng.register_prefix(HEADER)
+        held = base - eng.allocator.free_pages
+        assert held > 0
+        eng.register_prefix(HEADER + "Extra instruction text here, longer. ")
+        held2 = base - eng.allocator.free_pages
+        assert held2 >= held  # old pages freed, new ones allocated
+
+    def test_short_reregistration_frees_old_pages(self):
+        # a too-short re-registration must still release the old prefix
+        eng = make_engine()
+        base = eng.allocator.free_pages
+        eng.register_prefix(HEADER)
+        assert eng.allocator.free_pages < base
+        assert eng.register_prefix("hi") == 0
+        assert eng.allocator.free_pages == base  # nothing leaked
+
+
+class TestPrefixServing:
+    def test_matches_no_prefix_engine(self):
+        prompts = [
+            HEADER + "What is a systolic array?",
+            HEADER + "Explain BM25 briefly.",
+        ]
+        plain = make_engine().run_all(prompts, max_new_tokens=8, temperature=0.0)
+
+        eng = make_engine()
+        n = eng.register_prefix(HEADER)
+        assert n > 0
+        cached = eng.run_all(prompts, max_new_tokens=8, temperature=0.0)
+
+        assert [r.tokens for r in cached] == [r.tokens for r in plain]
+        assert [r.prompt_tokens for r in cached] == [r.prompt_tokens for r in plain]
+
+    def test_prefix_pages_survive_retire_and_are_reused(self):
+        eng = make_engine()
+        eng.register_prefix(HEADER)
+        after_register = eng.allocator.free_pages
+        eng.run_all([HEADER + "first question"], max_new_tokens=6, temperature=0.0)
+        # per-request pages freed on retire, prefix pages still held
+        assert eng.allocator.free_pages == after_register
+        # second request reuses the same prefix pages
+        out = eng.run_all([HEADER + "second question"], max_new_tokens=6,
+                          temperature=0.0)
+        assert out[0].finish_reason in ("stop", "length")
+        assert eng.allocator.free_pages == after_register
+
+    def test_non_matching_prompts_unaffected(self):
+        prompts = ["totally different prompt with no header at all"]
+        plain = make_engine().run_all(prompts, max_new_tokens=8, temperature=0.0)
+        eng = make_engine()
+        eng.register_prefix(HEADER)
+        got = eng.run_all(prompts, max_new_tokens=8, temperature=0.0)
+        assert [r.tokens for r in got] == [r.tokens for r in plain]
+
+    def test_exact_prefix_only_prompt_takes_normal_path(self):
+        """A prompt whose tokens EQUAL the shared span (no suffix) must use
+        the normal prefill — the suffix path would prefill zero tokens."""
+        eng = make_engine()
+        n = eng.register_prefix(HEADER)
+        # reconstruct a prompt that tokenizes to exactly the shared tokens:
+        # ByteTokenizer is byte-level, so n shared tokens = BOS + n-1 bytes
+        prompt_exact = HEADER[: n - 1]
+        toks = eng.tokenizer.encode(prompt_exact, add_bos=True)
+        assert toks == eng._prefix["tokens"]  # the boundary case for real
+        out = eng.run_all([prompt_exact], max_new_tokens=4, temperature=0.0)
+        ref = make_engine().run_all([prompt_exact], max_new_tokens=4,
+                                    temperature=0.0)
+        assert out[0].tokens == ref[0].tokens
+
+    def test_mixed_batch_prefix_and_plain(self):
+        prompts = [
+            HEADER + "cached question",
+            "uncached question entirely",
+        ]
+        plain = make_engine().run_all(prompts, max_new_tokens=6, temperature=0.0)
+        eng = make_engine()
+        eng.register_prefix(HEADER)
+        got = eng.run_all(prompts, max_new_tokens=6, temperature=0.0)
+        assert [r.tokens for r in got] == [r.tokens for r in plain]
+
+    def test_int8_pool_prefix_cache(self):
+        prompts = [HEADER + "int8 plus prefix cache"]
+        eng = make_engine(kv_quant="int8")
+        eng.register_prefix(HEADER)
+        got = eng.run_all(prompts, max_new_tokens=6, temperature=0.0)
+        ref = make_engine(kv_quant="int8").run_all(
+            prompts, max_new_tokens=6, temperature=0.0
+        )
+        # int8 priming dequantizes the prefix once; first token must agree
+        assert got[0].tokens[0] == ref[0].tokens[0]
