@@ -78,6 +78,7 @@ class MetricsCollector:
         self._prom: dict[str, Any] = {}
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        self._serving_last: dict[str, float] = {}
         if PROMETHEUS_AVAILABLE and enabled:
             self.registry = CollectorRegistry()
             self._build_prom()
@@ -115,6 +116,15 @@ class MetricsCollector:
             "batch_occupancy": Histogram(
                 "sentio_tpu_batch_occupancy", "coalesced batch fill fraction", ["batcher"],
                 buckets=(0.125, 0.25, 0.5, 0.75, 1.0), registry=r,
+            ),
+            "serving_stat": Gauge(
+                "sentio_tpu_serving_stat",
+                "decode service point-in-time stats (occupancy, queue depth, pages)",
+                ["stat"], registry=r,
+            ),
+            "serving_total": Counter(
+                "sentio_tpu_serving_events_total",
+                "decode service lifetime totals", ["event"], registry=r,
             ),
             "tokens_per_s": Gauge(
                 "sentio_tpu_decode_tokens_per_second", "decode throughput", [], registry=r
@@ -219,6 +229,27 @@ class MetricsCollector:
             self.record_request(endpoint, status, time.perf_counter() - t0)
 
     # ---------------------------------------------------------------- export
+
+    def set_serving_stat(self, key: str, value: float) -> None:
+        """Publish one point-in-time decode-service stat under both exports:
+        the labeled ``sentio_tpu_serving_stat`` gauge and the JSON
+        snapshot."""
+        self.memory.set_gauge(f"serving_{key}", (), value)
+        gauge = self._prom.get("serving_stat")
+        if gauge is not None:
+            gauge.labels(stat=key).set(value)
+
+    def bump_serving_total(self, event: str, lifetime_total: float) -> None:
+        """Publish a MONOTONIC decode-service total as a Counter (rate()
+        stays correct across restarts — Gauge semantics would not). The
+        engine reports lifetime totals, so this tracks deltas."""
+        last = self._serving_last.get(event, 0.0)
+        delta = max(lifetime_total - last, 0.0)
+        self._serving_last[event] = lifetime_total
+        self.memory.set_gauge(f"serving_{event}", (), lifetime_total)
+        counter = self._prom.get("serving_total")
+        if counter is not None and delta:
+            counter.labels(event=event).inc(delta)
 
     def export_prometheus(self) -> bytes:
         if self.registry is not None:

@@ -397,7 +397,34 @@ async def info(request: web.Request) -> web.Response:
     )
 
 
+def _publish_serving_gauges(container: DependencyContainer):
+    """Refresh decode-engine metrics at scrape time (occupancy, queue depth,
+    free pages — the numbers an HPA or operator actually tunes against;
+    prior rounds collected them in the engine but published them nowhere).
+    Returns the stats dict (or None) so callers can embed it without a
+    second, skew-prone lookup."""
+    service = container.peek("generation_service")
+    if service is None:  # never built (non-tpu provider / paged off)
+        return None
+    try:
+        stats = service.stats()
+    except Exception:  # noqa: BLE001 — metrics must not break the scrape
+        return None
+    m = get_metrics()
+    for key in (
+        "active_slots", "queued", "queued_inbox", "free_pages",
+        "avg_active_slots", "max_active_slots",
+    ):
+        if key in stats:
+            m.set_serving_stat(key, float(stats[key]))
+    for event in ("ticks", "completed"):
+        if event in stats:
+            m.bump_serving_total(event, float(stats[event]))
+    return stats
+
+
 async def metrics_endpoint(request: web.Request) -> web.Response:
+    _publish_serving_gauges(request.app["container"])
     return web.Response(
         body=get_metrics().export_prometheus(),
         content_type="text/plain",
@@ -408,11 +435,13 @@ async def metrics_endpoint(request: web.Request) -> web.Response:
 async def metrics_performance(request: web.Request) -> web.Response:
     from sentio_tpu.infra.monitoring import performance_monitor, resource_monitor
 
+    serving = _publish_serving_gauges(request.app["container"])
     return web.json_response(
         {
             "metrics": get_metrics().export_json(),
             "system": performance_monitor.collect_system(),
             "verdict": resource_monitor.health_verdict(),
+            "serving": serving,
         }
     )
 

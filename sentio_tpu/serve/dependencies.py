@@ -47,6 +47,12 @@ class DependencyContainer:
         with self._lock:
             self._cache[name] = value
 
+    def peek(self, name: str) -> Any:
+        """Already-built component or None — never constructs (metrics
+        scrapes must not trigger model loads)."""
+        with self._lock:
+            return self._cache.get(name)
+
     # ------------------------------------------------------------ components
 
     @property

@@ -318,6 +318,16 @@ class TestPagedServing:
             held = len(service.engine._prefix["pages"]) if service.engine._prefix else 0
             assert stats["free_pages"] == stats["total_pages"] - 1 - held
 
+            # the decode-engine stats must be PUBLISHED, not just collected:
+            # prometheus gauges on /metrics, full dict on /metrics/performance
+            prom = await (await client.get("/metrics")).text()
+            assert 'sentio_tpu_serving_stat{stat="max_active_slots"}' in prom
+            assert 'sentio_tpu_serving_stat{stat="free_pages"}' in prom
+            assert 'sentio_tpu_serving_events_total{event="completed"}' in prom
+            perf = await (await client.get("/metrics/performance")).json()
+            assert perf["serving"]["completed"] >= len(questions)
+            assert "avg_active_slots" in perf["serving"]
+
         run(with_client(settings, body))
 
 
