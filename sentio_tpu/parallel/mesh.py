@@ -116,11 +116,20 @@ def build_mesh(
     spec = resolve_spec(config, len(devices))
 
     if spec.dcn > 1:
-        dev_array = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape=(1, spec.dp, spec.pp, spec.ep, spec.sp, spec.tp),
-            dcn_mesh_shape=(spec.dcn, 1, 1, 1, 1, 1),
-            devices=devices,
-        )
+        if not all(hasattr(d, "slice_index") for d in devices):
+            # host-platform devices carry no slice topology — plain reshape
+            # so multi-slice programs (dcn-axis shardings and the
+            # collectives they imply) still compile+run on the virtual
+            # mesh. Real pods take the hybrid path below, and its geometry
+            # errors (slice count mismatch etc.) must stay LOUD: a silent
+            # reshape there would route tp/sp collectives over DCN.
+            dev_array = np.asarray(list(devices)).reshape(spec.shape)
+        else:
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                mesh_shape=(1, spec.dp, spec.pp, spec.ep, spec.sp, spec.tp),
+                dcn_mesh_shape=(spec.dcn, 1, 1, 1, 1, 1),
+                devices=devices,
+            )
     else:
         try:
             dev_array = mesh_utils.create_device_mesh(spec.shape, devices=list(devices))

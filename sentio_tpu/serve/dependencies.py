@@ -48,10 +48,12 @@ class DependencyContainer:
             self._cache[name] = value
 
     def peek(self, name: str) -> Any:
-        """Already-built component or None — never constructs (metrics
-        scrapes must not trigger model loads)."""
-        with self._lock:
-            return self._cache.get(name)
+        """Already-built component or None — never constructs AND never
+        blocks: initialize_all holds the container lock for the whole eager
+        startup (weights onto HBM, potentially minutes), and a /metrics
+        scrape waiting on it would freeze the event loop — liveness probes
+        included. A plain dict read is GIL-atomic."""
+        return self._cache.get(name)
 
     # ------------------------------------------------------------ components
 
