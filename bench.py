@@ -115,7 +115,7 @@ def phase_0_rtt():
 
 
 def phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
-                new_tokens, concurrency):
+                new_tokens, concurrency, kv_quant="none"):
     """Full graph with paged continuous batching, N concurrent clients."""
     import threading
 
@@ -159,7 +159,7 @@ def phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
         model_config=llm_cfg, params=engine.params, tokenizer=engine.tokenizer,
         max_slots=max(concurrency, 4), page_size=16,
         max_pages_per_seq=llm_cfg.max_len // 16, steps_per_tick=16,
-        max_tick_steps=64, pipeline_depth=2,
+        max_tick_steps=64, pipeline_depth=2, kv_quant=kv_quant,
         # random-init weights greedy-sample EOS almost immediately — fixed-
         # length generation measures the cost real tuned models actually pay
         ignore_eos=True,
@@ -292,7 +292,8 @@ def serve_scale_config(kind: str):
     )
 
 
-def phase_c_scale(kind: str, new_tokens: int, concurrency: int):
+def phase_c_scale(kind: str, new_tokens: int, concurrency: int,
+                  kv_quant: str = "none"):
     """Continuous-batched decode throughput at HBM-filling model scale."""
     import threading
 
@@ -323,7 +324,7 @@ def phase_c_scale(kind: str, new_tokens: int, concurrency: int):
     window = 512 if kind == "8b" else 1024
     engine = ContinuousBatchingEngine(
         model_config=cfg, params=params, max_slots=concurrency, page_size=16,
-        max_pages_per_seq=window // 16, steps_per_tick=16,
+        max_pages_per_seq=window // 16, steps_per_tick=16, kv_quant=kv_quant,
         # one compiled tick size for the 8b smoke — its scan compile through
         # the remote-compile service runs minutes per variant
         max_tick_steps=16 if kind == "8b" else 64,
@@ -582,6 +583,8 @@ def main() -> None:
     )
     serve_scale = os.environ.get("BENCH_SERVE_SCALE", "1b")
     scale_tokens = int(os.environ.get("BENCH_SCALE_TOKENS", "64"))
+    # int8 KV pages in BOTH paged engines (phase A serving + phase C scale)
+    kv_quant = os.environ.get("BENCH_KV_QUANT") or os.environ.get("KV_QUANT", "none")
 
     import jax
 
@@ -625,13 +628,15 @@ def main() -> None:
     ]
 
     rag = phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
-                      new_tokens, concurrency)
+                      new_tokens, concurrency, kv_quant=kv_quant)
     baseline = phase_b_baseline(docs, queries, n_queries, dim=enc_cfg.dim)
     baseline_wan = None if fast else phase_b_baseline(
         docs, queries, n_queries, dim=enc_cfg.dim,
         rtt_ms=float(os.environ.get("BENCH_BASELINE_RTT_MS", "40")),
     )
-    scale = None if skip_scale else phase_c_scale(serve_scale, scale_tokens, 8)
+    scale = None if skip_scale else phase_c_scale(
+        serve_scale, scale_tokens, 8, kv_quant=kv_quant
+    )
     kernels = None if fast else phase_d_kernels()
     speculative = (
         phase_e_speculative(serve_scale, scale_tokens)
@@ -656,6 +661,7 @@ def main() -> None:
         "baseline": baseline,
         **({"baseline_wan": baseline_wan} if baseline_wan else {}),
         **({"serve_scale": scale} if scale else {}),
+        **({"kv_quant": kv_quant} if kv_quant != "none" else {}),
         **({"kernels": kernels} if kernels else {}),
         **({"speculative": speculative} if speculative else {}),
         "wall_s": round(total_s, 1),

@@ -1,0 +1,65 @@
+"""bench.py backend watchdog: the round-end artifact depends on this logic
+choosing correctly between the live chip, a wedged tunnel, and a silently
+degraded plugin."""
+
+import os
+import subprocess
+import sys
+from unittest import mock
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def _completed(stdout: str, rc: int = 0):
+    return subprocess.CompletedProcess(args=[], returncode=rc, stdout=stdout,
+                                       stderr="boom" if rc else "")
+
+
+class TestEnsureLiveBackend:
+    def test_cpu_pinned_runs_skip_probe(self):
+        with mock.patch.dict(os.environ, {"JAX_PLATFORMS": "cpu"}, clear=False):
+            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+            with mock.patch.object(subprocess, "run") as run:
+                assert bench.ensure_live_backend() == ""
+                run.assert_not_called()
+
+    def test_healthy_accelerator_probe_passes(self):
+        env = {"PALLAS_AXON_POOL_IPS": "10.0.0.1"}
+        with mock.patch.dict(os.environ, env, clear=False):
+            with mock.patch.object(subprocess, "run",
+                                   return_value=_completed("tpu\n")):
+                assert bench.ensure_live_backend() == ""
+
+    def test_silent_cpu_fallback_is_flagged(self):
+        """Plugin expected but the probe child initialized host CPU — must be
+        marked, or phase C would publish CPU numbers as device numbers."""
+        # sentinel platform: only the watchdog's OWN write can restore
+        # "cpu", so the assertion observes the function, not the conftest
+        env = {"PALLAS_AXON_POOL_IPS": "10.0.0.1", "JAX_PLATFORMS": "axon"}
+        with mock.patch.dict(os.environ, env, clear=False):
+            with mock.patch.object(subprocess, "run",
+                                   return_value=_completed("cpu\n")):
+                reason = bench.ensure_live_backend()
+            assert os.environ.get("JAX_PLATFORMS") == "cpu"
+            assert "PALLAS_AXON_POOL_IPS" not in os.environ
+        assert "cpu" in reason
+
+    def test_hung_probe_is_flagged(self):
+        env = {"PALLAS_AXON_POOL_IPS": "10.0.0.1"}
+        with mock.patch.dict(os.environ, env, clear=False):
+            with mock.patch.object(
+                subprocess, "run",
+                side_effect=subprocess.TimeoutExpired(cmd="probe", timeout=1),
+            ):
+                reason = bench.ensure_live_backend(probe_timeout_s=1)
+        assert "hung" in reason
+
+    def test_crashed_probe_is_flagged(self):
+        env = {"PALLAS_AXON_POOL_IPS": "10.0.0.1"}
+        with mock.patch.dict(os.environ, env, clear=False):
+            with mock.patch.object(subprocess, "run",
+                                   return_value=_completed("", rc=1)):
+                reason = bench.ensure_live_backend()
+        assert "rc=1" in reason
