@@ -571,16 +571,19 @@ def ensure_live_backend(probe_timeout_s: float = 180.0) -> str:
 def main() -> None:
     t_start = time.perf_counter()
     fallback_reason = ensure_live_backend()
-    fast = os.environ.get("BENCH_FAST") == "1"
+    # A wedged-device fallback means every phase runs on host CPU, where the
+    # full-scale corpus/warmup alone exceed the driver budget (round 4: 402 s
+    # embed + 742 s warmup → rc=124, no artifact). Downscale to the fast
+    # profile so the run still emits a parseable JSON line; explicit BENCH_*
+    # env overrides below still win.
+    fast = os.environ.get("BENCH_FAST") == "1" or bool(fallback_reason)
     n_queries = int(os.environ.get("BENCH_QUERIES", "24" if not fast else "4"))
     n_corpus = int(os.environ.get("BENCH_CORPUS", "2048" if not fast else "64"))
     new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "48" if not fast else "8"))
     concurrency = int(os.environ.get("BENCH_CONCURRENCY", "8" if not fast else "2"))
     # phase C inits >1B params — pointless (and driver-timeout-hostile) on
     # the CPU fallback path
-    skip_scale = (
-        os.environ.get("BENCH_SKIP_SCALE") == "1" or fast or bool(fallback_reason)
-    )
+    skip_scale = os.environ.get("BENCH_SKIP_SCALE") == "1" or fast
     serve_scale = os.environ.get("BENCH_SERVE_SCALE", "1b")
     scale_tokens = int(os.environ.get("BENCH_SCALE_TOKENS", "64"))
     # int8 KV pages in BOTH paged engines (phase A serving + phase C scale)

@@ -180,14 +180,27 @@ def _check_shapes_llama(params: dict, cfg: LlamaConfig) -> None:
 # ------------------------------------------------------------ Mixtral (MoE)
 
 
-def moe_config_from_hf(hf_cfg: Any, dtype: str = "bfloat16") -> "MoeConfig":
-    """transformers.MixtralConfig (or compatible) → MoeConfig."""
+def moe_config_from_hf(hf_cfg: Any, dtype: str = "bfloat16",
+                       capacity_factor: float | None = None) -> "MoeConfig":
+    """transformers.MixtralConfig (or compatible) → MoeConfig.
+
+    HF Mixtral routes top-k with NO capacity limit, so the default here is
+    the no-drop capacity ``n_experts / experts_per_token`` — any expert can
+    absorb every routed token even under fully imbalanced routing. A finite
+    ``capacity_factor`` (e.g. 1.25 for training efficiency) may be passed
+    explicitly, accepting dropped tokens and divergence from HF logits.
+    """
     from sentio_tpu.models.moe import MoeConfig
 
+    n_experts = getattr(hf_cfg, "num_local_experts", 8)
+    experts_per_token = getattr(hf_cfg, "num_experts_per_tok", 2)
+    if capacity_factor is None:
+        capacity_factor = n_experts / experts_per_token
     return MoeConfig(
         **_decoder_kwargs_from_hf(hf_cfg, dtype),
-        n_experts=getattr(hf_cfg, "num_local_experts", 8),
-        experts_per_token=getattr(hf_cfg, "num_experts_per_tok", 2),
+        n_experts=n_experts,
+        experts_per_token=experts_per_token,
+        capacity_factor=capacity_factor,
     )
 
 

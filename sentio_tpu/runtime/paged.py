@@ -48,6 +48,8 @@ so masked lanes in the fused decode step write garbage somewhere harmless.
 from __future__ import annotations
 
 import itertools
+import time
+from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Optional, Sequence
@@ -353,6 +355,9 @@ class _Slot:
     # tokens served from shared (read-only) prefix pages at the front of
     # this slot's page table — counted in capacity, never freed by retire
     shared_tokens: int = 0
+    # wall-clock at submit(); TTFT is measured when the first sampled token
+    # becomes host-visible (pending_first flips False)
+    submit_t: float = 0.0
 
 
 @dataclass
@@ -361,6 +366,10 @@ class _Request:
     prompt: str
     max_new: int
     temperature: float
+    submit_t: float = 0.0
+    # lazily cached tokenization — _admit may inspect a queued request many
+    # times (skip-ahead scans the queue every tick) without re-encoding
+    tok_ids: Optional[list] = None
 
 
 @dataclass
@@ -489,10 +498,24 @@ class ContinuousBatchingEngine:
         # HBM-utilization math must use this, not ticks x steps_per_tick
         self.total_sub_steps = 0
         self._queue: list[_Request] = []
+        # skip-ahead admission: a request too large for the current free
+        # pages may be jumped by later, smaller requests — but only
+        # head_skip_bound times, after which the head gets strict FIFO
+        # priority (starvation bound). Counts reset when the head admits.
+        self.head_skip_bound = 16
+        self._head_skips = 0
+        # TTFT telemetry: submit() → first token host-visible, seconds
+        self.ttft_samples: deque = deque(maxlen=1024)
+        self.ttft_count = 0
         # shared-prefix cache (register_prefix): {"tokens", "pages", "n"} —
         # page-aligned KV of a common prompt prefix, referenced read-only by
         # matching requests' page tables and never freed by retire
         self._prefix = None
+        # operator visibility for the BPE-boundary failure mode: a
+        # registered prefix that never token-matches is silent otherwise
+        # (correct output, zero benefit, pages permanently reserved)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
         self._finished_buffer: list[PagedResult] = []
         # (first_tokens_device_array, [slot_idx, ...]) per admission chunk,
         # consumed by the next decode tick
@@ -687,7 +710,10 @@ class ContinuousBatchingEngine:
 
     def submit(self, prompt: str, max_new_tokens: int = 64, temperature: float = 0.0) -> int:
         rid = next(self._next_id)
-        self._queue.append(_Request(rid, prompt, max_new_tokens, temperature))
+        self._queue.append(_Request(
+            rid, prompt, max_new_tokens, temperature,
+            submit_t=time.perf_counter(),
+        ))
         return rid
 
     def register_prefix(self, text: str) -> int:
@@ -698,9 +724,18 @@ class ContinuousBatchingEngine:
         remainder re-prefills per request. Returns the number of shared
         tokens (0 = prefix shorter than one page, nothing cached).
 
-        One prefix at a time; registering again replaces it (the old pages
-        are freed once no live slot references them — here: immediately,
-        callers must register between requests, not mid-flight)."""
+        One prefix at a time; registering again replaces it. The old pages
+        are freed immediately, so registration is only legal between
+        requests: a live slot's page table may reference the old prefix's
+        pages, and freeing them mid-flight would let a later admission
+        scribble over KV still being attended to. Enforced, not documented:
+        raises while any slot is active."""
+        if any(s.active for s in self.slots):
+            raise RuntimeError(
+                "register_prefix while slots are active: live page tables "
+                "reference the current prefix pages; drain in-flight "
+                "requests first"
+            )
         toks = self.tokenizer.encode(text, add_bos=True)
         n_blocks = len(toks) // self.page_size
         # cap: leave at least half the table for per-request suffix+decode
@@ -735,6 +770,11 @@ class ContinuousBatchingEngine:
         for idx, req in enumerate(self._queue):
             if req.request_id == request_id:
                 del self._queue[idx]
+                if idx == 0:
+                    # the skip budget belongs to the departed head; the new
+                    # head must not inherit an exhausted one (it would
+                    # disable skip-ahead on its first blocked scan)
+                    self._head_skips = 0
                 return True
         for i, slot in enumerate(self.slots):
             if slot.active and slot.request_id == request_id:
@@ -759,6 +799,7 @@ class ContinuousBatchingEngine:
         self.allocator = PageAllocator(self.allocator.num_pages)
         self.slots = [_Slot() for _ in range(self.max_slots)]
         self._queue.clear()
+        self._head_skips = 0
         self._finished_buffer.clear()
         self._pending_first.clear()
         self._dev_state = None
@@ -832,9 +873,12 @@ class ContinuousBatchingEngine:
             return
 
         batch: list[tuple[int, _Request, list[int], int]] = []
-        while self._queue and free:
-            req = self._queue[0]
-            tok_ids = self.tokenizer.encode(req.prompt, add_bos=True)
+        qi = 0
+        while qi < len(self._queue) and free:
+            req = self._queue[qi]
+            if req.tok_ids is None:
+                req.tok_ids = self.tokenizer.encode(req.prompt, add_bos=True)
+            tok_ids = req.tok_ids
             # budget split inside the per-sequence page window: generation
             # gets its requested tokens up to HALF the window (else decode
             # retires on out_of_pages after window - prompt tokens); the
@@ -861,10 +905,29 @@ class ContinuousBatchingEngine:
                 self.max_pages_per_seq - shared_blocks,
             )
             if need_total > self.allocator.free_pages:
-                break  # head-of-line blocks until pages free up (no starvation)
+                # skip-ahead: a too-large request must not idle free slots
+                # while smaller requests queue behind it (round-4 weak #3:
+                # avg occupancy 2.95/8 with head-of-line FIFO). Starvation
+                # bound: after head_skip_bound jumps the head reverts to
+                # strict FIFO — nothing admits past it until its pages free.
+                if qi == 0 and self._head_skips >= self.head_skip_bound:
+                    break
+                qi += 1
+                continue
             pages = self.allocator.alloc(need_total)
             slot_idx = free.pop(0)
-            self._queue.pop(0)
+            self._queue.pop(qi)
+            if qi == 0:
+                self._head_skips = 0
+            else:
+                self._head_skips += 1
+            # counted per ADMISSION (not per scan attempt — skip-ahead may
+            # examine a queued request many times before it admits)
+            if pfx is not None:
+                if shared:
+                    self.prefix_hits += 1
+                else:
+                    self.prefix_misses += 1
             batch.append((slot_idx, req, tok_ids, shared))
             slot = self.slots[slot_idx]
             slot.request_id = req.request_id
@@ -876,6 +939,7 @@ class ContinuousBatchingEngine:
             slot.emitted = []
             slot.inflight_steps = 0
             slot.shared_tokens = shared
+            slot.submit_t = req.submit_t
             slot.active = True
             row = np.zeros(self.max_pages_per_seq, np.int32)
             if shared_blocks:
@@ -1003,16 +1067,23 @@ class ContinuousBatchingEngine:
                 # defensive: a zero-budget row with nothing in flight can't
                 # progress
                 self._finished_buffer.append(self._retire(i, "length"))
-        # adaptive tick size, TWO compiled variants only: waiting requests
-        # (engine queue OR the serving layer's inbox, via pressure_hint) cap
-        # the tick so admission waits at most steps_per_tick sub-steps; an
-        # idle queue runs the big tick so long generations cost few fetches.
-        # Over-long ticks waste masked sub-steps, which cost far less than an
-        # extra host round trip.
-        pressured = bool(self._queue) or bool(
-            self.pressure_hint is not None and self.pressure_hint()
-        )
-        steps = self.steps_per_tick if pressured else self.max_tick_steps
+        # adaptive tick size, scaled by backlog depth: waiting requests
+        # (engine queue + the serving layer's inbox, via pressure_hint) cap
+        # the tick so admission waits fewer decode sub-steps the deeper the
+        # backlog grows — freed slots refill at tick boundaries, so shorter
+        # ticks under pressure directly cut queueing delay (round-4 weak #3:
+        # 9.6x p95/p50 tail with the old two-size switch). An idle queue
+        # runs the big tick so long generations cost few fetches. Each
+        # distinct step count is its own compiled variant; the pressured
+        # ladder is capped at 3 sizes (+1 idle) to bound compilations.
+        waiting = len(self._queue)
+        if self.pressure_hint is not None:
+            waiting += int(self.pressure_hint())
+        if waiting == 0:
+            steps = self.max_tick_steps
+        else:
+            shrink = 1 << min(waiting // max(self.max_slots, 1), 2)  # 1, 2, 4
+            steps = max(self.steps_per_tick // shrink, 2)
         budgets = np.minimum(remaining, steps).astype(np.int32)
         pending_slots = [i for _, idxs in pending for i in idxs
                          if self.slots[i].active]
@@ -1034,6 +1105,7 @@ class ContinuousBatchingEngine:
                     if not self.slots[i].active:
                         continue
                     self.slots[i].pending_first = False
+                    self._note_ttft(self.slots[i])
                     self._last_tok[i] = int(vals[r])
                     result = self._fold_and_maybe_retire(i)
                     if result is not None:
@@ -1108,6 +1180,7 @@ class ContinuousBatchingEngine:
                 continue
             if slot.pending_first and i in record["pending_slots"]:
                 slot.pending_first = False
+                self._note_ttft(slot)
                 self._last_tok[i] = int(packed[0, i])
                 result = self._fold_and_maybe_retire(i)
                 if result is not None:
@@ -1140,6 +1213,14 @@ class ContinuousBatchingEngine:
             return self._retire(i, "stop" if hit_eos else "length")
         return None
 
+    def _note_ttft(self, slot: _Slot) -> None:
+        """Called exactly where pending_first flips False — the moment the
+        first sampled token is host-visible (deferred-fetch admission means
+        prefill alone does NOT make it visible)."""
+        if slot.submit_t > 0.0:
+            self.ttft_samples.append(time.perf_counter() - slot.submit_t)
+            self.ttft_count += 1
+
     def _retire(self, i: int, reason: str) -> PagedResult:
         """Free a slot's pages and zero its device-mirror row."""
         slot = self.slots[i]
@@ -1166,11 +1247,21 @@ class ContinuousBatchingEngine:
 
     def stats(self) -> dict:
         active = sum(s.active for s in self.slots)
-        return {
+        out = {
             "active_slots": active,
             "max_slots": self.max_slots,
             "queued": len(self._queue),
             "free_pages": self.allocator.free_pages,
             "total_pages": self.allocator.num_pages,
             "page_size": self.page_size,
+            "head_skips": self._head_skips,
+            "ttft_count": self.ttft_count,
         }
+        if self._prefix is not None or self.prefix_hits or self.prefix_misses:
+            out["prefix_hits"] = self.prefix_hits
+            out["prefix_misses"] = self.prefix_misses
+        if self.ttft_samples:
+            s = sorted(self.ttft_samples)
+            out["ttft_p50_ms"] = round(s[len(s) // 2] * 1e3, 2)
+            out["ttft_p95_ms"] = round(s[int(len(s) * 0.95)] * 1e3, 2)
+        return out

@@ -208,7 +208,8 @@ class PagedGenerationService:
     def _run(self) -> None:
         # short ticks while callers wait in OUR inbox, not just the engine
         # queue (len() reads are GIL-atomic; this is a hint, not a lock)
-        self.engine.pressure_hint = lambda: bool(self._inbox)
+        # depth, not a bool: the engine scales its tick size by backlog
+        self.engine.pressure_hint = lambda: len(self._inbox)
         while True:
             with self._mutex:
                 for ticket in self._inbox:

@@ -391,6 +391,23 @@ async def info(request: web.Request) -> web.Response:
                 "provider": settings.generator.provider,
                 "preset": settings.generator.model_preset,
                 "verifier": settings.generator.use_verifier,
+                # a configured draft checkpoint is DEAD when paged decode is
+                # on (the default deployment) — make the mismatch visible to
+                # operators instead of a one-line startup warning
+                "speculative": {
+                    "draft_configured": bool(settings.generator.draft_checkpoint_path),
+                    "active": bool(
+                        settings.generator.draft_checkpoint_path
+                        and settings.generator.provider == "tpu"
+                        and not settings.generator.use_paged_decode
+                    ),
+                    **(
+                        {"ignored_reason": "paged decode enabled (USE_PAGED_KV=1)"}
+                        if settings.generator.draft_checkpoint_path
+                        and settings.generator.use_paged_decode
+                        else {}
+                    ),
+                },
             },
             "device": engine.device_stats() if engine is not None else None,
         }
@@ -414,10 +431,12 @@ def _publish_serving_gauges(container: DependencyContainer):
     for key in (
         "active_slots", "queued", "queued_inbox", "free_pages",
         "avg_active_slots", "max_active_slots",
+        "ttft_p50_ms", "ttft_p95_ms",
     ):
         if key in stats:
             m.set_serving_stat(key, float(stats[key]))
-    for event in ("ticks", "completed"):
+    for event in ("ticks", "completed", "ttft_count",
+                  "prefix_hits", "prefix_misses"):
         if event in stats:
             m.bump_serving_total(event, float(stats[event]))
     return stats

@@ -115,17 +115,14 @@ def tiny_hf_mixtral():
 
 class TestMoeConversion:
     def test_logits_match_torch(self, tiny_hf_mixtral):
-        """HF Mixtral routes top-k with NO capacity limit; ample capacity
-        makes our dispatch equivalent, so logits must agree."""
-        from dataclasses import replace
-
+        """HF Mixtral routes top-k with NO capacity limit; the converter's
+        default capacity is no-drop (E/k), so logits must agree as-is."""
         from sentio_tpu.models.convert import convert_moe, moe_config_from_hf
         from sentio_tpu.models.moe import moe_forward
 
         model, hf_cfg = tiny_hf_mixtral
-        cfg = replace(
-            moe_config_from_hf(hf_cfg, dtype="float32"), capacity_factor=8.0
-        )
+        cfg = moe_config_from_hf(hf_cfg, dtype="float32")
+        assert cfg.capacity_factor == cfg.n_experts / cfg.experts_per_token
         params = convert_moe(model.state_dict(), cfg)
 
         ids = np.array([[1, 5, 9, 2, 77, 33], [3, 8, 120, 4, 6, 11]], np.int32)

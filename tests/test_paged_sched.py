@@ -1,0 +1,135 @@
+"""Admission scheduling + serving telemetry (runtime/paged.py).
+
+Round-5 scheduler work: skip-ahead admission with a starvation bound,
+backlog-scaled tick sizes, TTFT measurement, and prefix hit/miss counters.
+The reference serves one request per HTTP call
+(/root/reference/src/api/handlers/chat.py:148) and has no scheduler at all;
+these tests pin the contract of ours.
+"""
+
+import pytest
+
+from sentio_tpu.models.llama import LlamaConfig
+from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+
+
+def make_engine(**kw):
+    kw.setdefault("model_config", LlamaConfig.tiny())
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("page_size", 16)
+    kw.setdefault("max_pages_per_seq", 8)
+    kw.setdefault("ignore_eos", True)  # deterministic request lifetimes
+    return ContinuousBatchingEngine(**kw)
+
+
+BIG = "x" * 100   # ~101 tokens -> 8 pages with max_new=24
+SMALL = "hi there"  # ~9 tokens -> 1 page
+
+
+class TestSkipAhead:
+    def test_small_request_jumps_blocked_head(self):
+        # 12 usable pages; A takes 8, leaving 4 — B (needs 8) blocks at the
+        # head while C (1 page) must still admit into the idle slot
+        eng = make_engine(num_pages=13)
+        eng.submit(BIG, max_new_tokens=24)
+        eng.step()
+        assert sum(s.active for s in eng.slots) == 1
+        rid_b = eng.submit(BIG, max_new_tokens=24)
+        # max_new > one tick's sub-steps so C is still live when we assert
+        eng.submit(SMALL, max_new_tokens=24)
+        eng.step()
+        assert sum(s.active for s in eng.slots) == 2
+        assert [r.request_id for r in eng._queue] == [rid_b]
+        assert eng.stats()["head_skips"] == 1
+
+    def test_starvation_bound_reverts_to_fifo(self):
+        eng = make_engine(num_pages=13)
+        eng.head_skip_bound = 2
+        eng.submit("y" * 60, max_new_tokens=200)  # hog: 8 pages, decodes long
+        eng.step()
+        rid_b = eng.submit(BIG, max_new_tokens=24)  # needs 8 > 4 free
+        smalls = [eng.submit(SMALL, max_new_tokens=2) for _ in range(4)]
+        eng.step()  # admits one small past the head (skip 1)
+        eng.step()  # retires it, admits the next (skip 2)
+        eng.step()
+        eng.step()
+        # bound reached: the remaining smalls may NOT jump the head anymore
+        assert eng._head_skips == 2
+        queued = [r.request_id for r in eng._queue]
+        assert queued[0] == rid_b
+        assert set(queued[1:]) == set(smalls[2:])
+        # and a slot idles by design — FIFO fairness beats utilization now
+        assert sum(s.active for s in eng.slots) == 1
+
+    def test_head_admission_resets_skip_count(self):
+        eng = make_engine(num_pages=13)
+        eng.submit(BIG, max_new_tokens=24)
+        eng.step()
+        eng.submit(BIG, max_new_tokens=24)
+        eng.submit(SMALL, max_new_tokens=2)
+        eng.step()
+        assert eng._head_skips == 1
+        # drain everything; the blocked head admits once pages free up
+        while eng.has_work:
+            eng.step()
+        assert eng._head_skips == 0
+
+
+class TestBacklogScaledTicks:
+    def test_deep_backlog_shrinks_tick(self):
+        eng = make_engine(num_pages=33, steps_per_tick=8, max_tick_steps=32)
+        for _ in range(10):
+            eng.submit(SMALL, max_new_tokens=16)
+        before = eng.total_sub_steps
+        eng.step()  # 2 admit, 8 wait -> waiting//slots=4, capped -> steps=2
+        assert eng.total_sub_steps - before == 2
+
+    def test_idle_queue_runs_max_tick(self):
+        eng = make_engine(num_pages=33, steps_per_tick=8, max_tick_steps=32)
+        eng.submit(SMALL, max_new_tokens=20)
+        before = eng.total_sub_steps
+        eng.step()  # queue empties at admission -> waiting=0 -> big tick
+        assert eng.total_sub_steps - before == 32
+
+    def test_moderate_backlog_uses_steps_per_tick(self):
+        eng = make_engine(num_pages=33, steps_per_tick=8, max_tick_steps=32)
+        for _ in range(3):
+            eng.submit(SMALL, max_new_tokens=16)
+        before = eng.total_sub_steps
+        eng.step()  # 2 admit, 1 waits -> shrink 1 -> steps=8
+        assert eng.total_sub_steps - before == 8
+
+
+class TestTtft:
+    def test_ttft_recorded_per_request(self):
+        eng = make_engine(num_pages=33)
+        results = eng.run_all([SMALL, "another prompt", "third"], max_new_tokens=4)
+        assert len(results) == 3
+        stats = eng.stats()
+        assert stats["ttft_count"] == 3
+        assert stats["ttft_p50_ms"] >= 0.0
+        assert stats["ttft_p95_ms"] >= stats["ttft_p50_ms"]
+
+
+class TestPrefixTelemetryAndGuard:
+    HEADER = "You are a concise assistant. Cite sources. "  # >1 page of tokens
+
+    def test_hit_and_miss_counters(self):
+        eng = make_engine(num_pages=33)
+        assert eng.register_prefix(self.HEADER) > 0
+        eng.run_all([self.HEADER + "question one?", "unrelated prompt"],
+                    max_new_tokens=2)
+        stats = eng.stats()
+        assert stats["prefix_hits"] == 1
+        assert stats["prefix_misses"] == 1
+
+    def test_register_while_active_raises(self):
+        eng = make_engine(num_pages=33)
+        eng.submit(SMALL, max_new_tokens=32)
+        eng.step()
+        assert any(s.active for s in eng.slots)
+        with pytest.raises(RuntimeError, match="slots are active"):
+            eng.register_prefix(self.HEADER)
+        while eng.has_work:  # drain; registration is legal again
+            eng.step()
+        assert eng.register_prefix(self.HEADER) > 0
