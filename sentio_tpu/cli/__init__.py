@@ -137,6 +137,7 @@ def _cmd_eval(args: argparse.Namespace) -> int:
         skip_baseline=args.skip_baseline,
         configs={c.strip() for c in args.configs.split(",") if c.strip()} or None
         if args.configs else None,
+        encoder_checkpoint=args.encoder_checkpoint,
     )
     text = json.dumps(payload, indent=2)
     if args.out:
@@ -144,6 +145,30 @@ def _cmd_eval(args: argparse.Namespace) -> int:
             fh.write(text + "\n")
         print(f"wrote {args.out}", file=sys.stderr)
     print(text)
+    return 0
+
+
+def _cmd_train_encoder(args: argparse.Namespace) -> int:
+    """Train the bi-encoder in-tree (eval/train_encoder.py) and save a
+    ``load_model``-compatible checkpoint for EMBEDDER_CHECKPOINT /
+    ``eval --encoder-checkpoint``."""
+    from sentio_tpu.eval.train_encoder import TrainConfig, eval_recall, train_encoder
+    from sentio_tpu.models.transformer import EncoderConfig
+
+    enc_cfg = EncoderConfig(
+        vocab_size=512, dim=args.dim, n_layers=args.layers,
+        n_heads=max(args.dim // 64, 2), mlp_dim=args.dim * 4, max_len=512,
+    )
+    params, enc_cfg, history = train_encoder(
+        enc_cfg=enc_cfg,
+        train_cfg=TrainConfig(steps=args.steps, batch=args.batch, lr=args.lr),
+        out_path=args.out,
+        seed=args.seed,
+    )
+    payload = {"checkpoint": args.out, "history": history}
+    if args.eval_recall:
+        payload["recall_at_10"] = round(eval_recall(params, enc_cfg), 3)
+    print(json.dumps(payload))
     return 0
 
 
@@ -221,7 +246,27 @@ def main(argv: list[str] | None = None) -> int:
     p_eval.add_argument("--configs", default="",
                         help="comma list: sparse_api,dense,hybrid_rerank,full_paged,batched")
     p_eval.add_argument("--out", default="", help="also write the JSON here")
+    p_eval.add_argument("--encoder-checkpoint", default="",
+                        help="trained bi-encoder checkpoint for the dense leg "
+                             "(see `train-encoder`)")
     p_eval.set_defaults(fn=_cmd_eval)
+
+    p_tr = sub.add_parser(
+        "train-encoder",
+        help="contrastively train the bi-encoder on the synthetic bundle "
+             "(dense retrieval with zero egress)",
+    )
+    p_tr.add_argument("out", help="checkpoint output directory")
+    p_tr.add_argument("--steps", type=int, default=600)
+    p_tr.add_argument("--batch", type=int, default=64)
+    p_tr.add_argument("--lr", type=float, default=3e-4)
+    p_tr.add_argument("--dim", type=int, default=256)
+    p_tr.add_argument("--layers", type=int, default=4)
+    p_tr.add_argument("--seed", type=int, default=0)
+    p_tr.add_argument("--eval-recall", action="store_true",
+                      help="measure recall@10 on the eval bundle (seed 0) "
+                           "after training")
+    p_tr.set_defaults(fn=_cmd_train_encoder)
 
     p_info = sub.add_parser("info", help="print version/device/config info")
     p_info.set_defaults(fn=_cmd_info)

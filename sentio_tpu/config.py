@@ -348,6 +348,8 @@ class ServeConfig:
     # request coalescing for the TPU batcher
     batch_deadline_ms: float = 8.0
     batch_max_size: int = 8
+    # /upload multipart body cap (binary documents: pdf/docx)
+    max_upload_mb: int = 32
 
     @classmethod
     def from_env(cls) -> "ServeConfig":
@@ -367,6 +369,7 @@ class ServeConfig:
             trust_proxy_headers=_env_bool(["TRUST_PROXY_HEADERS"], False),
             batch_deadline_ms=_env_float(["BATCH_DEADLINE_MS"], 8.0),
             batch_max_size=_env_int(["BATCH_MAX_SIZE"], 8),
+            max_upload_mb=_env_int(["MAX_UPLOAD_MB"], 32),
         )
 
 

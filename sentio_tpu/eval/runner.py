@@ -66,6 +66,7 @@ def run_eval(
     seed: int = 0,
     skip_baseline: bool = False,
     configs: Optional[set] = None,
+    encoder_checkpoint: str = "",
 ) -> dict:
     """Run the eval matrix; returns the EVAL.json payload (pure dict)."""
     import jax
@@ -127,16 +128,30 @@ def run_eval(
 
     embedder = dense_index = None
     if needs_dense:
+        # trained weights (eval/train_encoder.py): the dense leg stops being
+        # a random-init architecture statement and measures real retrieval
+        # quality. The checkpoint's config applies to the EMBEDDER ONLY —
+        # the reranker and mock-API server keep the scale's enc_cfg so the
+        # rest of the matrix stays comparable to a no-checkpoint run.
+        emb_params, emb_cfg = None, enc_cfg
+        if encoder_checkpoint:
+            from sentio_tpu.runtime.weights import load_model
+
+            emb_params, emb_cfg, _ = load_model(
+                encoder_checkpoint, expect_family="encoder"
+            )
+            extras["encoder_checkpoint"] = encoder_checkpoint
         _log("eval: embedding corpus on device ...")
         embedder = TpuEmbedder(
-            EmbedderConfig(provider="tpu", batch_size=128), model_config=enc_cfg
+            EmbedderConfig(provider="tpu", batch_size=128),
+            params=emb_params, model_config=emb_cfg,
         )
         t0 = time.perf_counter()
         vecs = embedder.embed_many([d.text for d in bundle.documents])
         ingest_s = time.perf_counter() - t0
         _log(f"eval: embedded {n_docs} docs in {ingest_s:.1f}s "
              f"({n_docs / max(ingest_s, 1e-9):.0f} docs/s)")
-        dense_index = TpuDenseIndex(dim=enc_cfg.dim)
+        dense_index = TpuDenseIndex(dim=emb_cfg.dim)
         dense_index.add(bundle.documents, vecs)
         extras["ingest_docs_per_s"] = round(n_docs / max(ingest_s, 1e-9), 1)
     bm25 = BM25Index().build(bundle.documents) if needs_sparse else None

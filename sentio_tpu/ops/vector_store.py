@@ -25,6 +25,7 @@ payload.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 import uuid
@@ -80,6 +81,10 @@ def _payload_to_document(payload: dict, point_id: str) -> Document:
     return Document(text=text, id=str(payload.get("doc_id") or point_id), metadata=dict(meta))
 
 
+class TransientStoreError(VectorStoreError):
+    """Connection failures and 5xx — retried; 4xx are not."""
+
+
 class QdrantVectorStore:
     """External Qdrant collection over its REST API (httpx, no client lib).
 
@@ -88,6 +93,21 @@ class QdrantVectorStore:
     behavior (qdrant_store.py:351-417 there). Collection is bootstrapped on
     first use with cosine distance — embeddings are L2-normalized by the
     embedder, so ranking matches the TPU index's inner product.
+
+    Concurrency parity with the reference's pooled async client
+    (async_qdrant_store.py:50-266 there — pool of 2-4 clients, 30 s health
+    loop, per-op breaker+retry):
+
+    * ``pool_size`` persistent httpx clients (each with its own keep-alive
+      connection pool) checked out round-robin, so concurrent retrieval
+      legs never serialize on one connection and a wedged socket degrades
+      1/N of traffic, not all of it;
+    * every operation runs breaker(retry(op)): transport errors and 5xx
+      retry with jittered backoff, then count against a named circuit
+      breaker (visible on /health/detailed with every other breaker);
+    * a daemon health loop probes ``/collections`` every
+      ``health_interval_s`` and caches the verdict — ``health()`` answers
+      from the cache instead of spending a round trip per health check.
     """
 
     def __init__(
@@ -98,33 +118,121 @@ class QdrantVectorStore:
         api_key: str = "",
         timeout_s: float = 10.0,
         transport: Any = None,  # tests inject httpx.MockTransport
+        pool_size: int = 3,
+        health_interval_s: float = 30.0,
+        retry: Optional["RetryPolicy"] = None,
     ) -> None:
         import httpx
+
+        from sentio_tpu.infra.resilience import CircuitBreaker, RetryPolicy
 
         self.dim = dim
         self.collection = collection
         headers = {"api-key": api_key} if api_key else {}
-        self._client = httpx.Client(
-            base_url=url.rstrip("/"), headers=headers, timeout=timeout_s,
-            transport=transport,
+        self._clients = [
+            httpx.Client(
+                base_url=url.rstrip("/"), headers=headers, timeout=timeout_s,
+                transport=transport,
+            )
+            for _ in range(max(int(pool_size), 1))
+        ]
+        self._rr = itertools.count()
+        self._breaker = CircuitBreaker(
+            name=f"qdrant:{collection}", failure_threshold=5,
+            recovery_timeout_s=max(health_interval_s, 5.0),
+        )
+        self._retry = retry or RetryPolicy(
+            max_attempts=3, base_delay_s=0.1, max_delay_s=2.0,
+            retry_on=(TransientStoreError,),
         )
         self._bootstrapped = False
         self._bootstrap_lock = threading.Lock()
+        self._health_interval = float(health_interval_s)
+        self._healthy: Optional[bool] = None  # None until the loop reports
+        self._stop = threading.Event()
+        self._health_lock = threading.Lock()
+        self._health_thread: Optional[threading.Thread] = None
+
+    def _next_client(self):
+        return self._clients[next(self._rr) % len(self._clients)]
 
     # ------------------------------------------------------------------ http
 
-    def _request(self, method: str, path: str, json_body: Optional[dict] = None) -> dict:
+    def _raw_request(self, method: str, path: str, json_body: Optional[dict]) -> dict:
         import httpx
 
         try:
-            resp = self._client.request(method, path, json=json_body)
+            resp = self._next_client().request(method, path, json=json_body)
         except httpx.HTTPError as exc:
-            raise VectorStoreError(f"qdrant {method} {path}: {exc}") from exc
+            raise TransientStoreError(f"qdrant {method} {path}: {exc}") from exc
+        if resp.status_code >= 500:
+            raise TransientStoreError(
+                f"qdrant {method} {path} -> {resp.status_code}: {resp.text[:300]}"
+            )
         if resp.status_code >= 400:
             raise VectorStoreError(
                 f"qdrant {method} {path} -> {resp.status_code}: {resp.text[:300]}"
             )
-        return resp.json()
+        try:
+            return resp.json()
+        except ValueError as exc:
+            # a 2xx non-JSON body (interposed proxy, captive portal) must
+            # stay inside the VectorStoreError contract — an escaping
+            # JSONDecodeError would kill the health loop thread
+            raise TransientStoreError(
+                f"qdrant {method} {path}: non-JSON 2xx body"
+            ) from exc
+
+    def _request(self, method: str, path: str, json_body: Optional[dict] = None) -> dict:
+        from sentio_tpu.infra.resilience import CircuitOpenError
+
+        self._ensure_health_loop()
+        try:
+            return self._breaker.call(
+                self._retry.run, self._raw_request, method, path, json_body
+            )
+        except CircuitOpenError as exc:
+            raise VectorStoreError(f"qdrant unavailable: {exc}") from exc
+
+    # ---------------------------------------------------------------- health
+
+    def _ensure_health_loop(self) -> None:
+        if (self._health_interval <= 0 or self._health_thread is not None
+                or self._stop.is_set()):
+            return
+        # check-then-set under a lock: the concurrent first requests this
+        # pool exists for must not each spawn a probe thread
+        with self._health_lock:
+            if self._health_thread is not None or self._stop.is_set():
+                return
+            self._health_thread = threading.Thread(
+                target=self._health_loop,
+                name=f"qdrant-health-{self.collection}", daemon=True,
+            )
+            self._health_thread.start()
+
+    def _health_loop(self) -> None:
+        # reference contract: a 30 s background probe so health answers are
+        # cached, not a round trip each (async_qdrant_store.py:118-166 there)
+        while not self._stop.wait(self._health_interval):
+            ok = self._probe()
+            if ok != self._healthy:
+                logger.info(
+                    "qdrant %s health: %s", self.collection,
+                    "recovered" if ok else "DOWN",
+                )
+            self._healthy = ok
+
+    def _probe(self) -> bool:
+        try:
+            # direct, un-breakered probe: the loop is how an OPEN breaker's
+            # backend recovery becomes visible without live traffic
+            self._raw_request("GET", "/collections", None)
+            return True
+        except Exception:  # noqa: BLE001 — a probe failure of ANY kind
+            # (incl. RuntimeError from a closed client) must not kill the
+            # health thread; it just means "not healthy right now"
+            return False
 
     def _ensure_collection(self) -> None:
         if self._bootstrapped:
@@ -139,7 +247,7 @@ class QdrantVectorStore:
             if self._bootstrapped:
                 return
             try:
-                resp = self._client.get(f"/collections/{self.collection}")
+                resp = self._next_client().get(f"/collections/{self.collection}")
             except httpx.HTTPError as exc:
                 raise VectorStoreError(f"qdrant unreachable: {exc}") from exc
             if resp.status_code == 404:
@@ -159,11 +267,14 @@ class QdrantVectorStore:
             self._bootstrapped = True
 
     def health(self) -> bool:
-        try:
-            self._request("GET", "/collections")
-            return True
-        except VectorStoreError:
-            return False
+        # cached verdict once the background loop has reported; a live probe
+        # only before its first tick (or with the loop disabled)
+        if self._stop.is_set():
+            return False  # closed stores are not healthy, cached or not
+        self._ensure_health_loop()
+        if self._healthy is not None:
+            return self._healthy
+        return self._probe()
 
     # ------------------------------------------------------------------ crud
 
@@ -292,7 +403,12 @@ class QdrantVectorStore:
         return out
 
     def close(self) -> None:
-        self._client.close()
+        self._stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=2.0)
+            self._health_thread = None
+        for client in self._clients:
+            client.close()
 
 
 def get_vector_store(

@@ -394,7 +394,7 @@ class ContinuousBatchingEngine:
     serve/ wraps it in an asyncio pump.
     """
 
-    PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096)
+    PREFILL_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 
     def __init__(
         self,

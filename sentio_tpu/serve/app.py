@@ -20,8 +20,10 @@ import asyncio
 import concurrent.futures
 import json
 import logging
+import os
 import threading
 import time
+from pathlib import Path
 from typing import Optional
 
 from aiohttp import web
@@ -48,7 +50,7 @@ textarea{width:100%;box-sizing:border-box}
 #upl{color:#666;font-size:.85em}
 </style></head><body>
 <h2>sentio-tpu <span id="health"><span id="dot"></span> <span id="hstat">checking…</span></span></h2>
-<p><input type="file" id="file" accept=".txt,.md,.rst,.json,.csv" multiple>
+<p><input type="file" id="file" accept=".txt,.md,.rst,.json,.csv,.pdf,.docx,.html,.htm" multiple>
 <button onclick="upload()">Ingest</button> <span id="upl"></span></p>
 <div id="log"></div>
 <p><textarea id="q" rows="3" placeholder="Ask a question..."></textarea>
@@ -69,11 +71,35 @@ function chunks(text,size=1500,overlap=200){
   const out=[]; for(let i=0;i<text.length;i+=size-overlap){out.push(text.slice(i,i+size));
     if(i+size>=text.length)break;} return out;
 }
+// binary formats go whole-file to /upload (server-side parse via the
+// docx/pdf readers); text formats keep the chunked /embed flow
+async function uploadBinary(f,st){
+  for(let tries=0;tries<20;tries++){
+    const fd=new FormData(); fd.append('file',f,f.name);
+    const r=await fetch('/upload',{method:'POST',body:fd});
+    if(r.status===429){
+      const wait=parseInt(r.headers.get('Retry-After')||'6',10);
+      st.textContent='rate limited; waiting '+wait+'s…';
+      await new Promise(res=>setTimeout(res,wait*1000));
+      continue;
+    }
+    let d=null; try{d=await r.json()}catch(e){}
+    if(!d) return 'error: HTTP '+r.status;
+    const info=(d.files&&d.files[0])||{};
+    return info.error?('error: '+info.error):((info.chunks_embedded||0)+' chunks');
+  }
+  return 'error: rate limited too long';
+}
 async function upload(){
   const files=document.getElementById('file').files, st=document.getElementById('upl');
   if(!files.length){st.textContent='pick a file first';return}
   let done=0,total=0;
   for(const f of files){
+    if(/\\.(pdf|docx|html|htm)$/i.test(f.name)){
+      st.textContent='uploading '+f.name+'…';
+      st.textContent=f.name+': '+await uploadBinary(f,st);
+      continue;
+    }
     const text=await f.text(); const parts=chunks(text); total+=parts.length;
     for(let i=0;i<parts.length;i++){
       // the server rate-limits /embed per IP: back off on 429 and retry
@@ -181,7 +207,8 @@ def _make_observability_middleware(container: DependencyContainer):
             metrics.adjust_inflight(+1)
         try:
             if work and path != "/":
-                endpoint = "/embed" if path == "/embed" else "*"
+                # uploads are ingest work — they share /embed's tight bucket
+                endpoint = "/embed" if path in ("/embed", "/upload") else "*"
                 ip = _client_ip(request, trust_proxy=container.settings.serve.trust_proxy_headers)
                 container.rate_limiter.check(ip, endpoint)
             response = await handler(request)
@@ -347,6 +374,93 @@ async def embed(request: web.Request) -> web.Response:
     return web.json_response({"status": "ok", "stats": stats.to_dict()})
 
 
+async def upload(request: web.Request) -> web.Response:
+    """Multipart binary-document ingest — the browser upload path.
+
+    Closes the reference UI's file flow (streamlit_app.py:27-318 there,
+    which ingests PDF/TXT client-side): files post as multipart/form-data,
+    each part spools to a temp file so the suffix-dispatched readers in
+    ops/ingest.py (docx via stdlib zipfile+XML, gated pdf, text formats)
+    parse it, then the server chunks + embeds + indexes. Per-file errors
+    are reported per file; one bad document never fails the batch."""
+    import tempfile
+
+    from sentio_tpu.ops.ingest import SUPPORTED_SUFFIXES
+
+    container: DependencyContainer = request.app["container"]
+    if not (request.content_type or "").startswith("multipart/"):
+        raise SchemaError([{"field": "body", "error": "multipart/form-data required"}])
+    reader = await request.multipart()
+    files: list[dict] = []
+    # one cap for the WHOLE request (all parts): aiohttp's client_max_size
+    # guards read()/post() but multipart() + read_chunk stream unbounded,
+    # and a per-part cap would still let one request carry unlimited parts
+    cap = container.settings.serve.max_upload_mb * 1024 * 1024
+    total = 0
+    while True:
+        part = await reader.next()
+        if part is None:
+            break
+        if part.filename is None:
+            continue  # non-file form fields are ignored
+        name = os.path.basename(part.filename)
+        suffix = Path(name).suffix.lower()
+        if suffix not in SUPPORTED_SUFFIXES:
+            files.append({"filename": name, "error": f"unsupported type {suffix!r}"})
+            continue
+        chunks: list[bytes] = []
+        over = False
+        while True:
+            chunk = await part.read_chunk(64 * 1024)
+            if not chunk:
+                break
+            total += len(chunk)
+            if total > cap:
+                over = True
+                break
+            chunks.append(chunk)
+        if over:
+            # stop reading ENTIRELY (don't stream the remainder to /dev/null)
+            # but keep the per-file record of everything already ingested so
+            # the client knows what not to re-send
+            files.append({
+                "filename": name,
+                "error": f"upload exceeds {container.settings.serve.max_upload_mb} MB request cap",
+            })
+            return web.json_response({"status": "error", "files": files}, status=413)
+        data = b"".join(chunks)
+        with tempfile.TemporaryDirectory(prefix="sentio-upload-") as tmp:
+            # keep the original (sanitized) name: source metadata and the
+            # suffix dispatch in load_file both come from the path
+            path = Path(tmp) / name
+            path.write_bytes(data)
+
+            def parse_and_index(ing, p=path, src=name):
+                docs = ing.load_file(p)
+                for doc in docs:
+                    # the browser's filename, not the ephemeral temp path
+                    doc.metadata["source"] = src
+                return ing.ingest_documents(docs)
+
+            try:
+                stats = await asyncio.to_thread(parse_and_index, container.ingestor)
+            except Exception as exc:  # noqa: BLE001 — per-file isolation
+                files.append({"filename": name, "error": str(exc)})
+                continue
+        entry = {"filename": name, **stats.to_dict()}
+        if stats.errors:
+            entry["error"] = "; ".join(str(e) for e in stats.errors[:3])
+        files.append(entry)
+        get_metrics().record_embeddings(
+            container.settings.embedder.provider, stats.chunks_embedded
+        )
+    if not files:
+        raise SchemaError([{"field": "file", "error": "no file parts in form data"}])
+    ok = any("error" not in f for f in files)
+    return web.json_response({"status": "ok" if ok else "error", "files": files},
+                             status=200 if ok else 422)
+
+
 async def clear(request: web.Request) -> web.Response:
     container: DependencyContainer = request.app["container"]
     n = await asyncio.to_thread(container.ingestor.clear)
@@ -500,13 +614,17 @@ def create_app(
             error_middleware,
             _make_observability_middleware(container),
             _make_auth_middleware(container),
-        ]
+        ],
+        # the 1 MiB default stays: /chat + /embed bodies are JSON and should
+        # never approach it, and /upload streams multipart with its OWN
+        # max_upload_mb cap (multipart() bypasses client_max_size anyway)
     )
     app["container"] = container
 
     app.router.add_get("/", ui_page)
     app.router.add_post("/chat", chat)
     app.router.add_post("/embed", embed)
+    app.router.add_post("/upload", upload)
     app.router.add_post("/clear", clear)
     app.router.add_get("/health", health)
     app.router.add_get("/health/detailed", health_detailed)

@@ -12,6 +12,8 @@ from sentio_tpu.kernels.ring_attention import ring_attention_sharded
 from sentio_tpu.models.layers import attention, causal_mask
 from sentio_tpu.parallel.mesh import build_mesh
 
+pytestmark = [pytest.mark.slow, pytest.mark.mesh]
+
 
 def make_qkv(b, t, h, d, seed=0):
     rng = np.random.default_rng(seed)

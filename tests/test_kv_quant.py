@@ -19,6 +19,8 @@ from sentio_tpu.runtime.paged import (
     quantize_kv,
 )
 
+pytestmark = pytest.mark.slow
+
 
 class TestQuantPair:
     def test_roundtrip_error_small(self):

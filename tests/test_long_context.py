@@ -1,0 +1,130 @@
+"""Long-context serving end to end — the reference's hardest limit, beaten
+visibly.
+
+The reference truncates every prompt to ~2000 tokens before generation
+(/root/reference/src/core/graph/nodes.py:296-338, factory.py:90 there) —
+its context window is a config constant, not a capability. Here a 4K+
+token prompt flows through the REAL serving path (paged KV pool, page
+tables, fused-tick decode) untruncated, and the sp>1 mesh runs the same
+prefill through ring attention (kernels/ring_attention.py) — the
+long-context compute path that shards sequence over the ICI ring.
+"""
+
+from dataclasses import replace
+from functools import partial
+
+import numpy as np
+import pytest
+
+from sentio_tpu.models.llama import LlamaConfig, llama_forward
+from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+
+pytestmark = [pytest.mark.slow, pytest.mark.mesh]
+
+
+def long_cfg(max_len: int = 8192) -> LlamaConfig:
+    return replace(LlamaConfig.tiny(), max_len=max_len)
+
+
+def make_prompt(n_chars: int) -> str:
+    # repetitive-but-not-periodic text; ByteTokenizer ~ 1 token/char
+    words = ["pallas", "mesh", "ring", "paged", "tick", "fuse", "shard",
+             "scan", "hbm", "mxu"]
+    out = []
+    i = 0
+    while sum(len(w) + 1 for w in out) < n_chars:
+        out.append(words[(i * i + i // 7) % len(words)])
+        i += 1
+    return " ".join(out)
+
+
+class TestLongPromptServing:
+    def test_4k_prompt_untruncated_through_paged_engine(self):
+        cfg = long_cfg()
+        eng = ContinuousBatchingEngine(
+            model_config=cfg, max_slots=2, page_size=32,
+            max_pages_per_seq=160,  # window 5120 tokens
+            num_pages=1 + 180, ignore_eos=True,
+        )
+        prompt = make_prompt(4300)
+        [res] = eng.run_all([prompt], max_new_tokens=8)
+        assert res.prompt_tokens > 4096, (
+            f"prompt truncated to {res.prompt_tokens} — the reference's 2K "
+            "ceiling is the thing this engine exists to beat"
+        )
+        assert len(res.tokens) == 8 and res.finish_reason == "length"
+
+    def test_page_size_invariance_at_4k(self):
+        """The same long prompt through different page layouts must emit
+        identical greedy tokens — paging is memory layout, not model
+        behavior, at any context length."""
+        cfg = long_cfg()
+        prompt = make_prompt(4300)
+        outs = []
+        for page_size, mpps in ((32, 160), (64, 80)):
+            eng = ContinuousBatchingEngine(
+                model_config=cfg, max_slots=2, page_size=page_size,
+                max_pages_per_seq=mpps, num_pages=1 + 2 * mpps,
+                ignore_eos=True, rng_seed=0,
+            )
+            [res] = eng.run_all([prompt], max_new_tokens=8)
+            outs.append(res.tokens)
+        assert outs[0] == outs[1]
+
+    def test_long_and_short_coexist_in_one_pool(self):
+        """A 4K-token sequence and a 40-token sequence share the pool and
+        decode in the same fused ticks — the fragmentation-free coexistence
+        the paged design buys (runtime/paged.py module docstring)."""
+        cfg = long_cfg()
+        eng = ContinuousBatchingEngine(
+            model_config=cfg, max_slots=2, page_size=32,
+            max_pages_per_seq=160, num_pages=1 + 180, ignore_eos=True,
+        )
+        long_p, short_p = make_prompt(4300), "short question about paging"
+        results = eng.run_all([long_p, short_p], max_new_tokens=8)
+        assert results[0].prompt_tokens > 4096
+        assert results[1].prompt_tokens < 64
+        assert all(len(r.tokens) == 8 for r in results)
+
+
+class TestRingPrefillOnMesh:
+    def test_sp_mesh_ring_prefill_matches_single_device(self):
+        """Prefill of a 2K+ prompt under an sp=2 (x tp=2, dp=2) mesh runs
+        ring attention inside the paged engine's prefill (via
+        make_mesh_attn_fn) and must emit the same greedy tokens as the
+        plain single-program engine."""
+        import jax
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        from sentio_tpu.config import MeshConfig
+        from sentio_tpu.kernels import make_mesh_attn_fn
+        from sentio_tpu.models.llama import init_llama
+        from sentio_tpu.parallel.mesh import build_mesh
+        from sentio_tpu.parallel.sharding import LLAMA_TP_RULES, shard_params
+
+        cfg = long_cfg(max_len=4096)
+        prompt = make_prompt(2100)
+        params = init_llama(jax.random.PRNGKey(0), cfg)
+
+        plain = ContinuousBatchingEngine(
+            model_config=cfg, params=params, max_slots=2, page_size=32,
+            max_pages_per_seq=80, num_pages=1 + 100, ignore_eos=True,
+        )
+        [want] = plain.run_all([prompt], max_new_tokens=8)
+        assert want.prompt_tokens > 2048
+
+        mesh = build_mesh(MeshConfig(dp_size=2, sp_size=2, tp_size=2))
+        sharded = shard_params(init_llama(jax.random.PRNGKey(0), cfg), mesh,
+                               LLAMA_TP_RULES)
+        ring = ContinuousBatchingEngine(
+            model_config=cfg, params=sharded, mesh=mesh,
+            forward_fn=partial(llama_forward,
+                               attn_fn=make_mesh_attn_fn(mesh, causal=True)),
+            max_slots=2, page_size=32, max_pages_per_seq=80,
+            num_pages=1 + 100, ignore_eos=True,
+        )
+        [got] = ring.run_all([prompt], max_new_tokens=8)
+        assert got.tokens == want.tokens, (
+            "sp-mesh ring prefill diverged from the single-program engine"
+        )

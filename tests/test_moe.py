@@ -22,6 +22,8 @@ from sentio_tpu.models.moe import (
 from sentio_tpu.parallel.mesh import build_mesh
 from sentio_tpu.parallel.sharding import MOE_EP_RULES, shard_params
 
+pytestmark = [pytest.mark.slow, pytest.mark.mesh]
+
 
 @pytest.fixture(scope="module")
 def cfg():

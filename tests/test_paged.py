@@ -17,6 +17,8 @@ from sentio_tpu.runtime.paged import (
     init_pool,
 )
 
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def cfg():

@@ -23,6 +23,8 @@ from sentio_tpu.parallel.sharding import (
 )
 from jax.sharding import PartitionSpec as P
 
+pytestmark = pytest.mark.mesh
+
 
 class TestMesh:
     def test_resolve_defaults_all_dp(self):

@@ -24,6 +24,8 @@ from sentio_tpu.parallel.pipeline import (
     shard_stacked_params,
 )
 
+pytestmark = [pytest.mark.slow, pytest.mark.mesh]
+
 
 @pytest.fixture(scope="module")
 def cfg():

@@ -8,6 +8,8 @@ import pytest
 from sentio_tpu.models.llama import LlamaConfig
 from sentio_tpu.runtime.paged import ContinuousBatchingEngine
 
+pytestmark = pytest.mark.slow
+
 
 HEADER = "You are a careful assistant. Cite sources. Answer concisely. "
 

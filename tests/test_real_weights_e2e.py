@@ -39,6 +39,8 @@ from sentio_tpu.config import (  # noqa: E402
 )
 from sentio_tpu.models.llama import LlamaConfig, init_llama, llama_forward  # noqa: E402
 
+pytestmark = pytest.mark.slow
+
 VERDICT_JSON = '{"verdict": "pass", "citations_ok": true, "notes": []}'
 TRAIN_SEQ = 208
 

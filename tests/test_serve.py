@@ -22,6 +22,8 @@ from sentio_tpu.config import (
 from sentio_tpu.serve.app import create_app
 from sentio_tpu.serve.dependencies import DependencyContainer
 
+pytestmark = pytest.mark.slow
+
 
 def fast_settings(**over) -> Settings:
     s = Settings(
@@ -420,3 +422,97 @@ class TestPagedStreamingService:
         finally:
             svc_a.close()
             svc_b.close()
+
+
+class TestUpload:
+    """Multipart binary-document ingest (/upload) — the browser file path
+    the reference serves via Streamlit (streamlit_app.py:27-318 there)."""
+
+    @staticmethod
+    def make_docx(tmp_path, text="uploaded docx speaks of pallas kernels"):
+        import zipfile
+
+        path = tmp_path / "doc.docx"
+        xml = (
+            '<?xml version="1.0"?><w:document><w:body>'
+            f"<w:p><w:r><w:t>{text}</w:t></w:r></w:p>"
+            "</w:body></w:document>"
+        )
+        with zipfile.ZipFile(path, "w") as zf:
+            zf.writestr("word/document.xml", xml)
+        return path
+
+    def test_docx_roundtrip(self, tmp_path):
+        import aiohttp
+
+        path = self.make_docx(tmp_path)
+
+        async def body(client, container):
+            form = aiohttp.FormData()
+            form.add_field("file", path.read_bytes(), filename="doc.docx",
+                           content_type="application/octet-stream")
+            resp = await client.post("/upload", data=form)
+            assert resp.status == 200, await resp.text()
+            data = await resp.json()
+            [entry] = data["files"]
+            assert entry["filename"] == "doc.docx"
+            assert entry["chunks_embedded"] >= 1 and "error" not in entry
+            # the uploaded content is immediately retrievable
+            resp = await client.post("/chat", json={"question": "what speaks of pallas?"})
+            chat = await resp.json()
+            assert any("doc.docx" in str(s.get("metadata", {}).get("source", ""))
+                       for s in chat["sources"])
+
+        run(with_client(fast_settings(), body))
+
+    def test_text_file_via_upload(self, tmp_path):
+        import aiohttp
+
+        async def body(client, container):
+            form = aiohttp.FormData()
+            form.add_field("file", b"plain text about ring attention",
+                           filename="notes.txt")
+            resp = await client.post("/upload", data=form)
+            assert resp.status == 200
+            [entry] = (await resp.json())["files"]
+            assert entry["chunks_embedded"] >= 1
+
+        run(with_client(fast_settings(), body))
+
+    def test_unsupported_suffix_and_bad_docx(self, tmp_path):
+        import aiohttp
+
+        async def body(client, container):
+            form = aiohttp.FormData()
+            form.add_field("file", b"\x7fELF", filename="a.exe")
+            form.add_field("file", b"not a zip", filename="broken.docx")
+            resp = await client.post("/upload", data=form)
+            assert resp.status == 422  # every file failed
+            data = await resp.json()
+            errors = {f["filename"]: f.get("error", "") for f in data["files"]}
+            assert "unsupported" in errors["a.exe"]
+            assert errors["broken.docx"]
+
+        run(with_client(fast_settings(), body))
+
+    def test_non_multipart_rejected(self):
+        async def body(client, container):
+            resp = await client.post("/upload", json={"file": "nope"})
+            assert resp.status == 422
+
+        run(with_client(fast_settings(), body))
+
+    def test_request_cap_returns_413(self):
+        import aiohttp
+
+        from sentio_tpu.config import ServeConfig
+
+        async def body(client, container):
+            form = aiohttp.FormData()
+            form.add_field("file", b"x" * 4096, filename="big.txt")
+            resp = await client.post("/upload", data=form)
+            assert resp.status == 413
+            data = await resp.json()
+            assert "cap" in data["files"][-1]["error"]
+
+        run(with_client(fast_settings(serve=ServeConfig(max_upload_mb=0)), body))

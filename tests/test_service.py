@@ -20,6 +20,8 @@ from sentio_tpu.runtime.engine import GeneratorEngine
 from sentio_tpu.runtime.paged import ContinuousBatchingEngine
 from sentio_tpu.runtime.service import PagedGenerationService
 
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def contiguous():

@@ -11,6 +11,8 @@ from sentio_tpu.models.llama import LlamaConfig, init_llama
 from sentio_tpu.runtime.engine import GeneratorEngine
 from sentio_tpu.runtime.speculative import SpeculativeDecoder, SpeculativeError
 
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def target_engine():

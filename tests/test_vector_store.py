@@ -258,3 +258,103 @@ class TestIngestorRoutesThroughRegistry:
         stats = ing.ingest_document("TPUs multiply matrices.", metadata={})
         assert stats.chunks_stored >= 1
         assert fake.collections["sentio"]["points"]
+
+
+class TestPooledResilience:
+    """Reference parity: pooled clients + per-op breaker/retry + health loop
+    (async_qdrant_store.py:50-266 there)."""
+
+    def test_pool_round_robins_clients(self, fake):
+        s = QdrantVectorStore(dim=8, collection="t",
+                              transport=httpx.MockTransport(fake.handler),
+                              pool_size=3)
+        seen = [s._next_client() for _ in range(6)]
+        assert len({id(c) for c in seen}) == 3
+        assert [id(c) for c in seen[:3]] == [id(c) for c in seen[3:]]
+
+    def test_transient_5xx_retries_then_succeeds(self, fake):
+        calls = {"n": 0}
+
+        def flaky(request):
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                return httpx.Response(503, text="overloaded")
+            return fake.handler(request)
+
+        s = QdrantVectorStore(dim=8, collection="t",
+                              transport=httpx.MockTransport(flaky))
+        out = s._request("GET", "/collections")
+        assert out["status"] == "ok"
+        assert calls["n"] == 3  # two 503s retried, third attempt succeeded
+
+    def test_4xx_does_not_retry(self, fake):
+        calls = {"n": 0}
+
+        def bad(request):
+            calls["n"] += 1
+            return httpx.Response(422, text="bad request")
+
+        s = QdrantVectorStore(dim=8, collection="t",
+                              transport=httpx.MockTransport(bad))
+        with pytest.raises(VectorStoreError):
+            s._request("GET", "/collections")
+        assert calls["n"] == 1
+
+    def test_breaker_opens_and_fails_fast(self):
+        calls = {"n": 0}
+
+        def down(request):
+            calls["n"] += 1
+            raise httpx.ConnectError("refused")
+
+        from sentio_tpu.infra.resilience import RetryPolicy
+        from sentio_tpu.ops.vector_store import TransientStoreError
+
+        s = QdrantVectorStore(
+            dim=8, collection="breaker-t",
+            transport=httpx.MockTransport(down),
+            retry=RetryPolicy(max_attempts=1, retry_on=(TransientStoreError,)),
+        )
+        for _ in range(5):  # failure_threshold consecutive failures
+            with pytest.raises(VectorStoreError):
+                s._request("GET", "/collections")
+        n_before = calls["n"]
+        with pytest.raises(VectorStoreError, match="unavailable"):
+            s._request("GET", "/collections")
+        assert calls["n"] == n_before  # rejected by the breaker, no wire call
+
+    def test_health_loop_caches_and_recovers(self, fake):
+        state = {"up": False}
+
+        def flapping(request):
+            if not state["up"]:
+                raise httpx.ConnectError("down")
+            return fake.handler(request)
+
+        import time as _t
+
+        s = QdrantVectorStore(dim=8, collection="t",
+                              transport=httpx.MockTransport(flapping),
+                              health_interval_s=0.05)
+        s.health()  # public surface starts the loop
+        _t.sleep(0.2)
+        assert s.health() is False
+        state["up"] = True
+        _t.sleep(0.2)
+        assert s.health() is True
+        s.close()
+        assert s._health_thread is None
+
+    def test_concurrent_searches_all_succeed(self, fake):
+        import concurrent.futures
+
+        s = QdrantVectorStore(dim=8, collection="t",
+                              transport=httpx.MockTransport(fake.handler),
+                              pool_size=4)
+        docs, vecs = mk_docs_vecs(n=12)
+        s.add(docs, vecs)
+        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+            futs = [ex.submit(s.search, vecs[i % 12], 3) for i in range(32)]
+            results = [f.result() for f in futs]
+        assert all(len(r) == 3 for r in results)
+        assert {r[0][0].id for r in results} <= {d.id for d in docs}
