@@ -184,15 +184,21 @@ class QdrantVectorStore:
             ) from exc
 
     def _request(self, method: str, path: str, json_body: Optional[dict] = None) -> dict:
-        from sentio_tpu.infra.resilience import CircuitOpenError
-
         self._ensure_health_loop()
+        if not self._breaker.allow():
+            raise VectorStoreError(f"qdrant unavailable: circuit {self._breaker.name} open")
         try:
-            return self._breaker.call(
-                self._retry.run, self._raw_request, method, path, json_body
-            )
-        except CircuitOpenError as exc:
-            raise VectorStoreError(f"qdrant unavailable: {exc}") from exc
+            out = self._retry.run(self._raw_request, method, path, json_body)
+        except TransientStoreError:
+            self._breaker.record_failure()
+            raise
+        except VectorStoreError:
+            # 4xx proves the backend is up and answering — a stream of
+            # client errors must not open the circuit on a healthy store
+            self._breaker.record_success()
+            raise
+        self._breaker.record_success()
+        return out
 
     # ---------------------------------------------------------------- health
 
@@ -237,20 +243,25 @@ class QdrantVectorStore:
     def _ensure_collection(self) -> None:
         if self._bootstrapped:
             return
-        import httpx
-
         # serialized: retrieval legs run in worker threads, and two
         # concurrent first queries would otherwise both see 404 and race the
         # create (Qdrant 409s the loser). A 409 from another PROCESS racing
-        # us is likewise success — the collection exists.
+        # us is likewise success — the collection exists. Both the check and
+        # the create ride the same breaker+retry as every other operation,
+        # so a transient blip during FIRST use is absorbed, not fatal.
         with self._bootstrap_lock:
             if self._bootstrapped:
                 return
+            exists = True
             try:
-                resp = self._next_client().get(f"/collections/{self.collection}")
-            except httpx.HTTPError as exc:
-                raise VectorStoreError(f"qdrant unreachable: {exc}") from exc
-            if resp.status_code == 404:
+                self._request("GET", f"/collections/{self.collection}")
+            except TransientStoreError:
+                raise
+            except VectorStoreError as exc:
+                if "-> 404" not in str(exc):
+                    raise
+                exists = False
+            if not exists:
                 try:
                     self._request(
                         "PUT",
@@ -260,10 +271,6 @@ class QdrantVectorStore:
                 except VectorStoreError as exc:
                     if "409" not in str(exc):
                         raise
-            elif resp.status_code >= 400:
-                raise VectorStoreError(
-                    f"qdrant collection check -> {resp.status_code}: {resp.text[:300]}"
-                )
             self._bootstrapped = True
 
     def health(self) -> bool:

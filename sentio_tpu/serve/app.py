@@ -401,13 +401,16 @@ async def upload(request: web.Request) -> web.Response:
         part = await reader.next()
         if part is None:
             break
-        if part.filename is None:
-            continue  # non-file form fields are ignored
-        name = os.path.basename(part.filename)
+        keep = part.filename is not None
+        name = os.path.basename(part.filename) if keep else ""
         suffix = Path(name).suffix.lower()
-        if suffix not in SUPPORTED_SUFFIXES:
+        if keep and suffix not in SUPPORTED_SUFFIXES:
             files.append({"filename": name, "error": f"unsupported type {suffix!r}"})
-            continue
+            keep = False
+        # EVERY part's bytes count against the cap, including skipped ones —
+        # advancing to the next part drains the current one through the
+        # server either way, so uncounted skips would let one request
+        # stream unlimited data under an 'unsupported type' label
         chunks: list[bytes] = []
         over = False
         while True:
@@ -418,7 +421,10 @@ async def upload(request: web.Request) -> web.Response:
             if total > cap:
                 over = True
                 break
-            chunks.append(chunk)
+            if keep:
+                chunks.append(chunk)
+        if not keep and not over:
+            continue
         if over:
             # stop reading ENTIRELY (don't stream the remainder to /dev/null)
             # but keep the per-file record of everything already ingested so

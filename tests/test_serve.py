@@ -516,3 +516,18 @@ class TestUpload:
             assert "cap" in data["files"][-1]["error"]
 
         run(with_client(fast_settings(serve=ServeConfig(max_upload_mb=0)), body))
+
+    def test_skipped_part_bytes_count_toward_cap(self):
+        import aiohttp
+
+        from sentio_tpu.config import ServeConfig
+
+        async def body(client, container):
+            form = aiohttp.FormData()
+            # unsupported type would be skipped — its bytes must still trip
+            # the request cap rather than streaming through uncounted
+            form.add_field("file", b"y" * 4096, filename="huge.exe")
+            resp = await client.post("/upload", data=form)
+            assert resp.status == 413
+
+        run(with_client(fast_settings(serve=ServeConfig(max_upload_mb=0)), body))

@@ -358,3 +358,20 @@ class TestPooledResilience:
             results = [f.result() for f in futs]
         assert all(len(r) == 3 for r in results)
         assert {r[0][0].id for r in results} <= {d.id for d in docs}
+
+    def test_4xx_storm_does_not_open_breaker(self, fake):
+        calls = {"n": 0}
+
+        def mixed(request):
+            calls["n"] += 1
+            if request.url.path == "/collections":
+                return fake.handler(request)
+            return httpx.Response(422, text="bad filter")
+
+        s = QdrantVectorStore(dim=8, collection="breaker-4xx",
+                              transport=httpx.MockTransport(mixed))
+        for _ in range(8):  # past failure_threshold — must NOT open
+            with pytest.raises(VectorStoreError):
+                s._request("POST", "/collections/breaker-4xx/points/search", {})
+        out = s._request("GET", "/collections")  # healthy op still flows
+        assert out["status"] == "ok"
