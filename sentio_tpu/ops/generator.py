@@ -128,6 +128,9 @@ class OpenAIProvider:
     timeout_s: float = 60.0
     max_retries: int = 2
     name: str = "openai"
+    # endpoint-reported (or locally counted) token usage of the last
+    # successful chat(); empty before the first call
+    last_usage: dict = field(default_factory=dict)
 
     def _client(self):
         """One pooled httpx.Client per provider — reused across calls and
@@ -160,6 +163,54 @@ class OpenAIProvider:
             "temperature": temperature,
         }
 
+    def _alt_base(self) -> Optional[str]:
+        """OpenRouter-style deployments vary between ``…/api/v1`` and
+        ``…/v1`` (reference openai.py:124-144 there). A 404 on a base URL
+        whose PATH contains ``/api`` gets ONE retry against the stripped
+        base; a hit permanently switches the client. Only the path is
+        rewritten — an ``api.`` hostname must survive untouched."""
+        from urllib.parse import urlsplit, urlunsplit
+
+        parts = urlsplit(self.base_url)
+        if "/api/" in parts.path or parts.path.endswith("/api"):
+            new_path = parts.path.replace("/api", "", 1)
+            return urlunsplit(parts._replace(path=new_path))
+        return None
+
+    def _switch_base(self, new_base: str) -> None:
+        self.close()
+        object.__setattr__(self, "base_url", new_base)
+
+    def count_tokens(self, text: str) -> int:
+        """Token estimate for budget math when the endpoint returns no
+        ``usage`` block (reference openai.py:251-269 there). tiktoken when
+        present; a words×4/3 estimate otherwise (not in the base image)."""
+        try:
+            import tiktoken  # noqa: PLC0415 — optional, absent in base image
+
+            return len(tiktoken.encoding_for_model(self.model).encode(text))
+        except Exception:  # noqa: BLE001 — any failure degrades to estimate
+            return max(int(len(text.split()) * 4 / 3), 1)
+
+    def _note_usage(self, body: dict, prompt: str, reply: str,
+                    latency_s: float) -> None:
+        """Publish token counts to /metrics — endpoint-reported ``usage``
+        when present (a reported 0 is honored), counted locally otherwise."""
+        from sentio_tpu.infra.metrics import get_metrics
+
+        usage = body.get("usage") or {}
+        completion = usage.get("completion_tokens")
+        if completion is None:
+            completion = self.count_tokens(reply)
+        prompt_toks = usage.get("prompt_tokens")
+        if prompt_toks is None:
+            prompt_toks = self.count_tokens(prompt)
+        object.__setattr__(self, "last_usage", {
+            "prompt_tokens": int(prompt_toks),
+            "completion_tokens": int(completion),
+        })
+        get_metrics().record_llm("remote_chat", latency_s, tokens=int(completion))
+
     def chat(self, prompt: str, max_new_tokens: int, temperature: float) -> str:
         import random
         import time
@@ -167,12 +218,34 @@ class OpenAIProvider:
         last_exc: Exception | None = None
         for attempt in range(self.max_retries + 1):
             try:
+                t0 = time.perf_counter()
                 resp = self._client().post(
                     "/chat/completions",
                     json=self._payload(prompt, max_new_tokens, temperature),
                 )
+                alt = self._alt_base() if resp.status_code == 404 else None
+                if alt:
+                    old = self.base_url
+                    self._switch_base(alt)
+                    try:
+                        resp = self._client().post(
+                            "/chat/completions",
+                            json=self._payload(prompt, max_new_tokens, temperature),
+                        )
+                    except Exception:
+                        # probe blew up before any status — the switch is
+                        # unverified, keep the configured base
+                        self._switch_base(old)
+                        raise
+                    if resp.status_code >= 400:
+                        # the alternate is no better — undo the switch so a
+                        # genuinely-404 deployment keeps its configured base
+                        self._switch_base(old)
                 resp.raise_for_status()
-                return resp.json()["choices"][0]["message"]["content"]
+                body = resp.json()
+                reply = body["choices"][0]["message"]["content"]
+                self._note_usage(body, prompt, reply, time.perf_counter() - t0)
+                return reply
             except Exception as exc:  # noqa: BLE001 — retry transport/5xx/429
                 status = getattr(getattr(exc, "response", None), "status_code", None)
                 if status is not None and 400 <= status < 500 and status != 429:

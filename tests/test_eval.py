@@ -60,6 +60,29 @@ class TestOpenAIProvider:
         with pytest.raises(RuntimeError, match="after 2 attempts"):
             provider.chat("x", max_new_tokens=4, temperature=0.0)
 
+    def test_api_v1_404_fallback_switches_base(self, mock_server):
+        """OpenRouter-style /api/v1 vs /v1 drift (reference openai.py:124-144
+        there): a 404 on the configured base retries once against the
+        stripped base and keeps it on success."""
+        from sentio_tpu.ops.generator import OpenAIProvider
+
+        provider = OpenAIProvider(base_url=mock_server.base_url + "/api/v1")
+        out = provider.chat("[1] Source: a.md\nhello", max_new_tokens=8,
+                            temperature=0.0)
+        assert isinstance(out, str) and out
+        assert provider.base_url == mock_server.base_url + "/v1"
+        # subsequent calls go straight to the working base
+        assert provider.chat("again?", max_new_tokens=8, temperature=0.0)
+
+    def test_usage_tracked_per_call(self, mock_server):
+        from sentio_tpu.ops.generator import OpenAIProvider
+
+        provider = OpenAIProvider(base_url=mock_server.base_url + "/v1")
+        provider.chat("count my tokens please", max_new_tokens=8, temperature=0.0)
+        usage = provider.last_usage
+        assert usage["prompt_tokens"] >= 1 and usage["completion_tokens"] >= 1
+
+
 
 class TestEvalDataset:
     def test_bundle_deterministic(self):
