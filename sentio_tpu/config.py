@@ -257,6 +257,10 @@ class GeneratorConfig:
     # 2 = dispatch tick N+1 before fetching tick N (host round trip overlaps
     # device compute; results lag one tick). 1 = synchronous ticks.
     decode_pipeline_depth: int = 2
+    # chunked prefill: prompts longer than this admit one page-aligned
+    # segment per tick so a long (4-8K) prefill never stalls other slots'
+    # decode for its full length. 0 = off (whole-prompt admission).
+    prefill_chunk: int = 0
     prefill_buckets: tuple[int, ...] = (256, 512, 1024, 2048, 4096)
     temperature_by_mode: tuple[tuple[str, float], ...] = (
         ("fast", 0.0),
@@ -298,6 +302,7 @@ class GeneratorConfig:
             decode_steps_per_tick=_env_int(["DECODE_STEPS_PER_TICK"], 16),
             decode_max_tick_steps=_env_int(["DECODE_MAX_TICK_STEPS"], 64),
             decode_pipeline_depth=_env_int(["DECODE_PIPELINE_DEPTH"], 2),
+            prefill_chunk=_env_int(["PREFILL_CHUNK"], 0),
         )
 
 

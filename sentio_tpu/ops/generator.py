@@ -154,6 +154,9 @@ class OpenAIProvider:
         if client is not None:
             client.close()
             object.__setattr__(self, "_client_cached", None)
+        for old in getattr(self, "_retired_clients", []):
+            old.close()
+        object.__setattr__(self, "_retired_clients", [])
 
     def _payload(self, prompt: str, max_new_tokens: int, temperature: float) -> dict:
         return {
@@ -178,7 +181,17 @@ class OpenAIProvider:
         return None
 
     def _switch_base(self, new_base: str) -> None:
-        self.close()
+        """Rebind the base URL WITHOUT closing the old client: concurrent
+        serving threads may have requests in flight on it (closing would
+        fail them mid-call). Superseded clients park until close()."""
+        old = getattr(self, "_client_cached", None)
+        if old is not None:
+            retired = getattr(self, "_retired_clients", None)
+            if retired is None:
+                retired = []
+                object.__setattr__(self, "_retired_clients", retired)
+            retired.append(old)
+            object.__setattr__(self, "_client_cached", None)
         object.__setattr__(self, "base_url", new_base)
 
     def count_tokens(self, text: str) -> int:

@@ -358,6 +358,12 @@ class _Slot:
     # wall-clock at submit(); TTFT is measured when the first sampled token
     # becomes host-visible (pending_first flips False)
     submit_t: float = 0.0
+    # chunked prefill (prefill_chunk engine option): suffix tokens not yet
+    # written to this slot's pages, and how many own tokens already are.
+    # While prefill_todo is set the slot holds pages but takes no decode
+    # budget — decode ticks for OTHER slots interleave with its segments.
+    prefill_todo: Optional[list] = None
+    prefill_done: int = 0
 
 
 @dataclass
@@ -414,6 +420,7 @@ class ContinuousBatchingEngine:
         mesh=None,
         forward_fn=None,
         kv_quant: str = "none",
+        prefill_chunk: Optional[int] = None,
     ) -> None:
         """``forward_fn`` swaps the prefill model family (llama_forward
         contract); the fused decode tick detects the family per layer (a
@@ -478,6 +485,19 @@ class ContinuousBatchingEngine:
         # a single in-flight record means deeper values are not supported.
         self.pipeline_depth = min(max(int(pipeline_depth), 1), 2)
         self.mesh = mesh
+        # chunked prefill (vLLM-style): prompts longer than this admit as
+        # page-aligned segments, ONE segment dispatch per tick, so a 4-8K
+        # prefill never stalls other slots' decode for its whole length —
+        # each tick pays at most one segment of prefill latency. None = off
+        # (whole-prompt admission, the default).
+        if prefill_chunk is not None:
+            prefill_chunk = int(prefill_chunk)
+            if prefill_chunk <= 0 or prefill_chunk % page_size:
+                raise ValueError(
+                    f"prefill_chunk must be a positive multiple of page_size "
+                    f"({page_size}), got {prefill_chunk}"
+                )
+        self.prefill_chunk = prefill_chunk
         if kv_quant not in ("none", "int8"):
             raise ValueError(f"kv_quant must be 'none' or 'int8', got {kv_quant!r}")
         # int8 pages: ~half the pool HBM and decode-read bandwidth; scales
@@ -706,6 +726,58 @@ class ContinuousBatchingEngine:
 
         self._prefix_prefill_scatter = prefix_prefill_scatter
 
+        @partial(jax.jit, static_argnames=("n_prior", "do_sample"),
+                 donate_argnums=(7, 8))
+        def segment_prefill_scatter(params, ids, positions, lens, rng, temps,
+                                    scat, k_pages, v_pages, prior_table,
+                                    n_prior, do_sample):
+            """One chunked-prefill segment: prime a contiguous cache with the
+            row's OWN already-written KV (per-row page gather — unlike the
+            shared-prefix variant's broadcast table), run the segment's
+            tokens at offset positions, scatter only the new blocks. The
+            first token samples ONLY on the final segment (``do_sample``),
+            so the rng stream matches whole-prompt admission exactly."""
+            from sentio_tpu.models.llama import init_cache
+            from sentio_tpu.runtime.sampling import sample_tokens
+
+            b, width = ids.shape
+            cache = init_cache(cfg, b, n_prior + width)
+            if n_prior:
+                def prime(cache_arr, pages):
+                    if isinstance(pages, dict):
+                        qv = pages["q"][:, prior_table]
+                        sc = pages["s"][:, prior_table]
+                        dense = dequantize_kv(qv, sc, cache_arr.dtype)
+                    else:
+                        dense = pages[:, prior_table]  # [L, B, nb, pg, Hk, Hd]
+                    lcount, bb, nb_, pg_, hk_, hd_ = dense.shape
+                    prior_kv = dense.reshape(lcount, bb, nb_ * pg_, hk_, hd_)
+                    return cache_arr.at[:, :, :n_prior].set(prior_kv)
+
+                cache = dict(cache)
+                cache["k"] = prime(cache["k"], k_pages)
+                cache["v"] = prime(cache["v"], v_pages)
+
+            pad_mask = jnp.arange(width)[None, :] < lens[:, None]
+            logits, cache = forward_fn(
+                params, cfg, ids, positions=positions, cache=cache,
+                cache_index=n_prior, pad_mask=pad_mask,
+            )
+            k_pages, v_pages = scatter_prefill(
+                k_pages, v_pages,
+                cache["k"][:, :, n_prior:], cache["v"][:, :, n_prior:], scat,
+            )
+            if do_sample:
+                last = jnp.take_along_axis(
+                    logits, (lens - 1)[:, None, None], axis=1)[:, 0]
+                rng, sub = jax.random.split(rng)
+                first = sample_tokens(last, sub, temps)
+            else:
+                first = jnp.zeros((b,), jnp.int32)
+            return first, k_pages, v_pages, rng
+
+        self._segment_prefill_scatter = segment_prefill_scatter
+
     # --------------------------------------------------------------- public
 
     def submit(self, prompt: str, max_new_tokens: int = 64, temperature: float = 0.0) -> int:
@@ -839,6 +911,8 @@ class ContinuousBatchingEngine:
         completed this tick."""
         self.last_tick_active = 0
         self._admit()
+        if self.prefill_chunk is not None:
+            self._advance_prefill()
         record = self._dispatch_tick() if any(s.active for s in self.slots) else None
         # buffer swap AFTER dispatch: defensive retires made while budgeting
         # must ride THIS step's results (there may not be a next step)
@@ -928,7 +1002,12 @@ class ContinuousBatchingEngine:
                     self.prefix_hits += 1
                 else:
                     self.prefix_misses += 1
-            batch.append((slot_idx, req, tok_ids, shared))
+            chunked = (
+                self.prefill_chunk is not None
+                and len(tok_ids) - shared > self.prefill_chunk
+            )
+            if not chunked:
+                batch.append((slot_idx, req, tok_ids, shared))
             slot = self.slots[slot_idx]
             slot.request_id = req.request_id
             slot.pages = pages
@@ -940,6 +1019,8 @@ class ContinuousBatchingEngine:
             slot.inflight_steps = 0
             slot.shared_tokens = shared
             slot.submit_t = req.submit_t
+            slot.prefill_todo = list(tok_ids[shared:]) if chunked else None
+            slot.prefill_done = 0
             slot.active = True
             row = np.zeros(self.max_pages_per_seq, np.int32)
             if shared_blocks:
@@ -1040,6 +1121,48 @@ class ContinuousBatchingEngine:
             self.slots[slot_idx].pending_first = True
         self._pending_first.append((first, slot_idxs))
 
+    def _advance_prefill(self) -> None:
+        """Dispatch ONE chunked-prefill segment per tick (bounding how much
+        prefill latency any single tick adds to live decodes). The slot with
+        the OLDEST submit time goes first — index order would let a steady
+        stream of long prompts landing in lower slots starve a higher one
+        indefinitely while it pins its pages."""
+        waiting = [
+            (slot.submit_t, i) for i, slot in enumerate(self.slots)
+            if slot.active and slot.prefill_todo is not None
+        ]
+        for _, i in sorted(waiting):
+            slot = self.slots[i]
+            chunk = self.prefill_chunk
+            seg = slot.prefill_todo[:chunk]
+            is_last = len(slot.prefill_todo) <= chunk
+            prior = slot.shared_tokens + slot.prefill_done
+            width = self._prefill_width(len(seg))
+            # the segment's own pages start right after the prior blocks in
+            # this slot's table (prior is page-aligned: shared and every
+            # non-final segment are page multiples)
+            pb = prior // self.page_size
+            nb = (len(seg) + self.page_size - 1) // self.page_size
+            seg_pages = self._page_table[i, pb : pb + nb].tolist()
+            ids, lens, temps, scat, positions = self._assemble_prefill(
+                [(seg, slot.temperature, seg_pages)], width, pos_offset=prior,
+            )
+            prior_table = self._page_table[i : i + 1, :pb].copy()
+            first, self.pool.k, self.pool.v, self._rng = \
+                self._segment_prefill_scatter(
+                    self.params, ids, positions, lens, self._rng, temps,
+                    scat, self.pool.k, self.pool.v, prior_table,
+                    n_prior=prior, do_sample=is_last,
+                )
+            if is_last:
+                slot.prefill_todo = None
+                slot.pending_first = True
+                self._pending_first.append((first, [i]))
+            else:
+                slot.prefill_todo = slot.prefill_todo[chunk:]
+                slot.prefill_done += len(seg)
+            return
+
     def _dispatch_tick(self) -> Optional[dict]:
         """Compute per-row budgets, merge freshly admitted rows into the
         device-carried decode state, and dispatch ONE fused multi-step scan.
@@ -1050,6 +1173,8 @@ class ContinuousBatchingEngine:
         for i, slot in enumerate(self.slots):
             if not slot.active:
                 continue
+            if slot.prefill_todo is not None:
+                continue  # mid-chunked-prefill: no decode budget, no retire
             capacity = slot.shared_tokens + len(slot.pages) * self.page_size
             # a pending (still-on-device) first token and any sub-steps
             # already granted to an unharvested tick count against the
@@ -1237,6 +1362,8 @@ class ContinuousBatchingEngine:
         slot.inflight_steps = 0
         slot.pages = []
         slot.shared_tokens = 0
+        slot.prefill_todo = None
+        slot.prefill_done = 0
         self._page_table[i] = 0
         self._lens[i] = 0
         self._temps[i] = 0.0

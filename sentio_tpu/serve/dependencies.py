@@ -264,6 +264,7 @@ class DependencyContainer:
                 max_tick_steps=cfg.decode_max_tick_steps,
                 pipeline_depth=cfg.decode_pipeline_depth,
                 kv_quant=cfg.kv_quant,
+                prefill_chunk=cfg.prefill_chunk or None,
                 mesh=self.mesh,  # pool kv-heads shard over tp with the weights
             )
             if cfg.prefix_cache:
