@@ -421,6 +421,9 @@ class ContinuousBatchingEngine:
         forward_fn=None,
         kv_quant: str = "none",
         prefill_chunk: Optional[int] = None,
+        draft_params=None,
+        draft_config=None,
+        spec_k: int = 4,
     ) -> None:
         """``forward_fn`` swaps the prefill model family (llama_forward
         contract); the fused decode tick detects the family per layer (a
@@ -498,6 +501,31 @@ class ContinuousBatchingEngine:
                     f"({page_size}), got {prefill_chunk}"
                 )
         self.prefill_chunk = prefill_chunk
+        # paged speculative decoding (runtime/paged_spec.py): a draft model
+        # turns each decode tick into draft/verify/accept rounds — exact by
+        # construction (greedy rows bit-exact, sampled rows marginally
+        # exact) while continuous batching keeps working
+        self.draft_params = None
+        self.draft_cfg = draft_config
+        self.spec_k = max(int(spec_k), 1)
+        self._spec_tick = None
+        self._spec_dk = self._spec_dv = None
+        if draft_params is not None:
+            if draft_config is None:
+                raise ValueError("draft_params requires draft_config")
+            if mesh is not None:
+                raise ValueError("paged speculation does not support a mesh yet")
+            if prefill_chunk is not None:
+                raise ValueError(
+                    "paged speculation and chunked prefill are mutually "
+                    "exclusive (the draft prefills whole prompts)"
+                )
+            if draft_config.vocab_size != self.cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_config.vocab_size} != target "
+                    f"vocab {self.cfg.vocab_size}"
+                )
+            self.draft_params = draft_params
         if kv_quant not in ("none", "int8"):
             raise ValueError(f"kv_quant must be 'none' or 'int8', got {kv_quant!r}")
         # int8 pages: ~half the pool HBM and decode-read bandwidth; scales
@@ -778,6 +806,53 @@ class ContinuousBatchingEngine:
 
         self._segment_prefill_scatter = segment_prefill_scatter
 
+        if self.draft_params is not None:
+            from sentio_tpu.models.llama import llama_forward as _draft_fwd
+            from sentio_tpu.runtime.paged_spec import build_spec_tick
+
+            dcfg = self.draft_cfg
+            self._spec_tick = build_spec_tick(
+                self.forward_fn, cfg, _draft_fwd, dcfg,
+                eos_id=self.tokenizer.eos_id, ignore_eos=self.ignore_eos,
+                page_size=self.page_size,
+            )
+
+            @partial(jax.jit, donate_argnums=(2, 3))
+            def draft_prefill(params_d, ids, d_k, d_v, rows_idx, lens):
+                """Fill the persistent draft cache rows for freshly admitted
+                slots (the draft's analogue of prefill_scatter; prefix pages
+                are target-only, so the draft always prefills the FULL
+                prompt). Pad rows index max_slots and drop."""
+                from sentio_tpu.models.llama import init_cache
+
+                b, width = ids.shape
+                cache = init_cache(dcfg, b, width)
+                positions = jnp.broadcast_to(
+                    jnp.arange(width, dtype=jnp.int32)[None, :], (b, width)
+                )
+                pad_mask = jnp.arange(width)[None, :] < lens[:, None]
+                _, cache = _draft_fwd(
+                    params_d, dcfg, ids, positions=positions, cache=cache,
+                    cache_index=0, pad_mask=pad_mask,
+                )
+                d_k = d_k.at[:, rows_idx, :width].set(cache["k"], mode="drop")
+                d_v = d_v.at[:, rows_idx, :width].set(cache["v"], mode="drop")
+                return d_k, d_v
+
+            self._draft_prefill = draft_prefill
+
+    def _ensure_draft_cache(self) -> None:
+        import jax.numpy as jnp
+
+        if self._spec_dk is not None:
+            return
+        dcfg = self.draft_cfg
+        window = self.max_pages_per_seq * self.page_size
+        shape = (dcfg.n_layers, self.max_slots, window,
+                 dcfg.n_kv_heads, dcfg.head_dim)
+        self._spec_dk = jnp.zeros(shape, dcfg.jdtype)
+        self._spec_dv = jnp.zeros(shape, dcfg.jdtype)
+
     # --------------------------------------------------------------- public
 
     def submit(self, prompt: str, max_new_tokens: int = 64, temperature: float = 0.0) -> int:
@@ -877,6 +952,7 @@ class ContinuousBatchingEngine:
         self._dev_state = None
         self._inflight = None
         self._prefix = None
+        self._spec_dk = self._spec_dv = None  # rebuilt lazily (zeros)
         self._page_table[:] = 0
         self._lens[:] = 0
         self._temps[:] = 0.0
@@ -973,9 +1049,13 @@ class ContinuousBatchingEngine:
             ):
                 shared = pfx["n"]
             shared_blocks = shared // self.page_size
+            # speculation headroom: a verify block writes KV for up to
+            # spec_k+1 positions past the accepted length before acceptance
+            # is known — those writes need real pages behind them
+            spec_head = (self.spec_k + 1) if self._spec_tick is not None else 0
             need_total = min(
-                (len(tok_ids) - shared + req.max_new + self.page_size - 1)
-                // self.page_size,
+                (len(tok_ids) - shared + req.max_new + spec_head
+                 + self.page_size - 1) // self.page_size,
                 self.max_pages_per_seq - shared_blocks,
             )
             if need_total > self.allocator.free_pages:
@@ -1052,6 +1132,35 @@ class ContinuousBatchingEngine:
                     self._prefill_chunk_prefixed(width, shared, chunk)
                 else:
                     self._prefill_chunk(width, [m[:3] for m in chunk])
+        if self._spec_tick is not None:
+            self._draft_prefill_admitted(batch)
+
+    def _draft_prefill_admitted(self, batch: list) -> None:
+        """Fill the draft cache for freshly admitted slots — always over the
+        FULL prompt (prefix-shared pages are target-side only), grouped by
+        full-length width bucket like target admission."""
+        self._ensure_draft_cache()
+        groups: dict[int, list] = {}
+        for slot_idx, _req, tok_ids, _shared in batch:
+            groups.setdefault(self._prefill_width(len(tok_ids)), []).append(
+                (slot_idx, tok_ids)
+            )
+        max_rows = max(self.ADMIT_BUCKETS)
+        for width, members in sorted(groups.items()):
+            for start in range(0, len(members), max_rows):
+                chunk = members[start : start + max_rows]
+                rows = bucket_size(len(chunk), self.ADMIT_BUCKETS)
+                ids = np.full((rows, width), self.tokenizer.pad_id, np.int32)
+                lens = np.ones(rows, np.int32)
+                rows_idx = np.full(rows, self.max_slots, np.int32)  # pad→drop
+                for r, (slot_idx, tok_ids) in enumerate(chunk):
+                    ids[r, : len(tok_ids)] = tok_ids
+                    lens[r] = len(tok_ids)
+                    rows_idx[r] = slot_idx
+                self._spec_dk, self._spec_dv = self._draft_prefill(
+                    self.draft_params, ids, self._spec_dk, self._spec_dv,
+                    rows_idx, lens,
+                )
 
     def _assemble_prefill(self, rows_data, width: int, pos_offset: int = 0):
         """Build the padded admission arrays ONE way for every prefill
@@ -1184,8 +1293,16 @@ class ContinuousBatchingEngine:
                 + (1 if slot.pending_first else 0)
             )
             written = slot.length + slot.inflight_steps
+            # spec mode reserves verify-block headroom inside capacity.
+            # Admission over-allocates by the same amount, EXCEPT when the
+            # request already hits the max_pages_per_seq window — there the
+            # headroom comes out of the emission budget, so window-limited
+            # requests finish up to spec_k+1 tokens earlier than the plain
+            # engine would (documented in runtime/paged_spec.py)
+            spec_head = (self.spec_k + 1) if self._spec_tick is not None else 0
             remaining[i] = max(
-                min(slot.max_new - base_emit, capacity - 1 - written), 0
+                min(slot.max_new - base_emit,
+                    capacity - 1 - spec_head - written), 0
             )
             if (remaining[i] == 0 and not slot.pending_first
                     and slot.inflight_steps == 0):
@@ -1259,26 +1376,42 @@ class ContinuousBatchingEngine:
                 tok_in, lens_in, halted_in, first_dev, new_lens, idxs
             )
 
-        packed, tok_out, lens_out, halted_out, self.pool.k, self.pool.v, \
-            self._rng = self._step_n(
-                self.params,
-                tok_in,
-                lens_in,
-                halted_in,
-                self._page_table.copy(),
-                self.pool.k,
-                self.pool.v,
-                self._rng,
-                self._temps.copy(),
-                budgets,
-                steps=steps,
-            )
+        if self._spec_tick is not None:
+            self._ensure_draft_cache()
+            packed, tok_out, lens_out, halted_out, self.pool.k, self.pool.v, \
+                self._spec_dk, self._spec_dv, self._rng = self._spec_tick(
+                    self.params, self.draft_params, tok_in, lens_in,
+                    halted_in, self._page_table.copy(), self.pool.k,
+                    self.pool.v, self._spec_dk, self._spec_dv, self._rng,
+                    self._temps.copy(), budgets,
+                    # + k + 1 slack: dynamic_update_slice CLAMPS a start
+                    # index whose k+1-wide update would overhang, silently
+                    # corrupting the tail rounds' token offsets otherwise
+                    k=self.spec_k, out_w=int(steps) + self.spec_k + 1,
+                )
+            spec = True
+        else:
+            packed, tok_out, lens_out, halted_out, self.pool.k, self.pool.v, \
+                self._rng = self._step_n(
+                    self.params,
+                    tok_in,
+                    lens_in,
+                    halted_in,
+                    self._page_table.copy(),
+                    self.pool.k,
+                    self.pool.v,
+                    self._rng,
+                    self._temps.copy(),
+                    budgets,
+                    steps=steps,
+                )
+            self.total_sub_steps += steps
+            spec = False
         self._dev_state = (tok_out, lens_out, halted_out)
-        self.total_sub_steps += steps
         for i, slot in enumerate(self.slots):
             if slot.active:
                 slot.inflight_steps += int(budgets[i])
-        return {"packed": packed, "budgets": budgets,
+        return {"packed": packed, "budgets": budgets, "spec": spec,
                 "pending_slots": set(pending_slots),
                 # request ids pin each lane: a slot retired at harvest time
                 # and re-admitted before THIS record is harvested must not
@@ -1294,6 +1427,7 @@ class ContinuousBatchingEngine:
         EOS (visible in packed) — identical to the device's halting rule."""
         budgets = record["budgets"]
         packed = np.asarray(record["packed"])
+        spec = record.get("spec", False)
         finished: list[PagedResult] = []
         for i, slot in enumerate(self.slots):
             if not slot.active or slot.request_id != record["rids"][i]:
@@ -1306,15 +1440,27 @@ class ContinuousBatchingEngine:
             if slot.pending_first and i in record["pending_slots"]:
                 slot.pending_first = False
                 self._note_ttft(slot)
-                self._last_tok[i] = int(packed[0, i])
+                echo = packed[i, 0] if spec else packed[0, i]
+                self._last_tok[i] = int(echo)
                 result = self._fold_and_maybe_retire(i)
                 if result is not None:
                     finished.append(result)
                     continue
-            for s in range(consumed):
+            if spec:
+                # spec packed row: [echo, emitted_n, tokens...] — the device
+                # already applied budgets and EOS truncation; fold exactly
+                # what it emitted. total_sub_steps counts emitted tokens
+                # (the spec analogue of executed decode sub-steps)
+                n = int(packed[i, 1])
+                toks = packed[i, 2 : 2 + n]
+                self.total_sub_steps += n
+            else:
+                n = consumed
+                toks = packed[1 : 1 + n, i]
+            for s in range(n):
                 slot.length += 1
                 self._lens[i] = slot.length
-                self._last_tok[i] = int(packed[1 + s, i])
+                self._last_tok[i] = int(toks[s])
                 result = self._fold_and_maybe_retire(i)
                 if result is not None:
                     finished.append(result)

@@ -492,6 +492,27 @@ async def health_live(request: web.Request) -> web.Response:
     return web.json_response(request.app["container"].health_handler.live())
 
 
+def _speculative_info(container: DependencyContainer) -> dict:
+    """Honest operator view of the draft-checkpoint knob: active only when
+    some serving path actually speculates; otherwise names the exclusion."""
+    gen = container.settings.generator
+    out: dict = {"draft_configured": bool(gen.draft_checkpoint_path)}
+    if not gen.draft_checkpoint_path or gen.provider != "tpu":
+        out["active"] = False
+        return out
+    reason = ""
+    if gen.use_paged_decode:
+        if container.mesh is not None:
+            reason = "device mesh configured (paged speculation is single-chip)"
+        elif gen.prefill_chunk:
+            reason = ("PREFILL_CHUNK set (chunked prefill excludes paged "
+                      "speculation)")
+    out["active"] = not reason
+    if reason:
+        out["ignored_reason"] = reason
+    return out
+
+
 async def info(request: web.Request) -> web.Response:
     container: DependencyContainer = request.app["container"]
     settings = container.settings
@@ -511,23 +532,11 @@ async def info(request: web.Request) -> web.Response:
                 "provider": settings.generator.provider,
                 "preset": settings.generator.model_preset,
                 "verifier": settings.generator.use_verifier,
-                # a configured draft checkpoint is DEAD when paged decode is
-                # on (the default deployment) — make the mismatch visible to
-                # operators instead of a one-line startup warning
-                "speculative": {
-                    "draft_configured": bool(settings.generator.draft_checkpoint_path),
-                    "active": bool(
-                        settings.generator.draft_checkpoint_path
-                        and settings.generator.provider == "tpu"
-                        and not settings.generator.use_paged_decode
-                    ),
-                    **(
-                        {"ignored_reason": "paged decode enabled (USE_PAGED_KV=1)"}
-                        if settings.generator.draft_checkpoint_path
-                        and settings.generator.use_paged_decode
-                        else {}
-                    ),
-                },
+                # a configured draft accelerates BOTH serving paths now —
+                # paged (runtime/paged_spec.py, the default) and contiguous
+                # (runtime/speculative.py); the genuine exclusions (chunked
+                # prefill, device mesh) are surfaced here for operators
+                "speculative": _speculative_info(container),
             },
             "device": engine.device_stats() if engine is not None else None,
         }

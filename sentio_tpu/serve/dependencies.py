@@ -215,14 +215,9 @@ class DependencyContainer:
             if cfg.provider != "tpu" or not cfg.draft_checkpoint_path:
                 return None
             if cfg.use_paged_decode:
-                # the paged service answers every successful /chat before the
-                # provider reaches the spec branch — loading the draft would
-                # spend HBM and startup time on dead code
-                logger.warning(
-                    "LLM_DRAFT_CHECKPOINT set but paged decode is enabled; "
-                    "speculative decoding serves the contiguous path only — "
-                    "set USE_PAGED_KV=0 to use the draft"
-                )
+                # the PAGED engine itself speculates now (generation_service
+                # loads the draft into the continuous-batching tick) — the
+                # contiguous SpeculativeDecoder would be dead weight here
                 return None
             engine = self.engine
             if engine is None or self.mesh is not None:
@@ -253,6 +248,35 @@ class DependencyContainer:
             from sentio_tpu.runtime.paged import ContinuousBatchingEngine
             from sentio_tpu.runtime.service import PagedGenerationService
 
+            # paged speculative decoding: a configured draft checkpoint now
+            # accelerates the DEFAULT serving path (runtime/paged_spec.py)
+            # instead of being dead under USE_PAGED_KV=1 (round-4 advisor)
+            draft_params = draft_cfg = None
+            if cfg.draft_checkpoint_path and self.mesh is not None:
+                logger.warning(
+                    "LLM_DRAFT_CHECKPOINT ignored: paged speculation does "
+                    "not support a device mesh yet (MESH_* > 1 configured); "
+                    "/info reports this under generator.speculative"
+                )
+            if cfg.draft_checkpoint_path and self.mesh is None:
+                if cfg.prefill_chunk:
+                    logger.warning(
+                        "LLM_DRAFT_CHECKPOINT ignored: PREFILL_CHUNK is set "
+                        "and paged speculation requires whole-prompt "
+                        "admission (the draft prefills full prompts)"
+                    )
+                else:
+                    from sentio_tpu.runtime.weights import load_model
+
+                    draft_params, draft_cfg, _ = load_model(
+                        cfg.draft_checkpoint_path, expect_family="llama"
+                    )
+                    logger.info(
+                        "paged speculation: draft %s (dim=%d L=%d, k=%d)",
+                        cfg.draft_checkpoint_path, draft_cfg.dim,
+                        draft_cfg.n_layers, cfg.speculative_k,
+                    )
+
             paged = ContinuousBatchingEngine(
                 model_config=engine.model_config,
                 params=engine.params,
@@ -265,6 +289,9 @@ class DependencyContainer:
                 pipeline_depth=cfg.decode_pipeline_depth,
                 kv_quant=cfg.kv_quant,
                 prefill_chunk=cfg.prefill_chunk or None,
+                draft_params=draft_params,
+                draft_config=draft_cfg,
+                spec_k=cfg.speculative_k,
                 mesh=self.mesh,  # pool kv-heads shard over tp with the weights
             )
             if cfg.prefix_cache:
