@@ -564,6 +564,11 @@ class ContinuousBatchingEngine:
         # (correct output, zero benefit, pages permanently reserved)
         self.prefix_hits = 0
         self.prefix_misses = 0
+        # paged-speculation efficiency: emitted/verifies = tokens-per-verify
+        # (how well the draft predicts the target — the number that decides
+        # whether the draft pays for itself)
+        self.spec_emitted_total = 0
+        self.spec_verifies_total = 0
         self._finished_buffer: list[PagedResult] = []
         # (first_tokens_device_array, [slot_idx, ...]) per admission chunk,
         # consumed by the next decode tick
@@ -1447,13 +1452,15 @@ class ContinuousBatchingEngine:
                     finished.append(result)
                     continue
             if spec:
-                # spec packed row: [echo, emitted_n, tokens...] — the device
-                # already applied budgets and EOS truncation; fold exactly
-                # what it emitted. total_sub_steps counts emitted tokens
-                # (the spec analogue of executed decode sub-steps)
+                # spec packed row: [echo, emitted_n, verifies, tokens...] —
+                # the device already applied budgets and EOS truncation;
+                # fold exactly what it emitted. total_sub_steps counts
+                # emitted tokens (the spec analogue of decode sub-steps)
                 n = int(packed[i, 1])
-                toks = packed[i, 2 : 2 + n]
+                toks = packed[i, 3 : 3 + n]
                 self.total_sub_steps += n
+                self.spec_emitted_total += n
+                self.spec_verifies_total += int(packed[i, 2])
             else:
                 n = consumed
                 toks = packed[1 : 1 + n, i]
@@ -1537,4 +1544,10 @@ class ContinuousBatchingEngine:
             s = sorted(self.ttft_samples)
             out["ttft_p50_ms"] = round(s[len(s) // 2] * 1e3, 2)
             out["ttft_p95_ms"] = round(s[int(len(s) * 0.95)] * 1e3, 2)
+        if self.spec_verifies_total:
+            out["spec_tokens_per_verify"] = round(
+                self.spec_emitted_total / self.spec_verifies_total, 2
+            )
+            out["spec_verifies"] = self.spec_verifies_total
+            out["spec_emitted"] = self.spec_emitted_total
         return out

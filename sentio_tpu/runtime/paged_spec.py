@@ -44,8 +44,9 @@ through int8. Spec output under ``kv_quant="int8"`` therefore differs from
 the plain int8 engine within quantization noise — and is at least as close
 to the unquantized model. Greedy bit-parity holds for the unquantized pool.
 
-The host fetches ONE packed buffer per tick — (echo, tokens [S, out_w],
-emitted [S]) — preserving the engine's one-fetch-per-tick cost model.
+The host fetches ONE packed buffer per tick — ``[S, out_w + 3]`` rows of
+``[echo, emitted_count, verify_count, tokens...]`` — preserving the
+engine's one-fetch-per-tick cost model.
 
 Cache discipline is inherited from speculative.py: both models write k/v at
 absolute positions; entries beyond a row's accepted length are stale but
@@ -66,8 +67,9 @@ def build_spec_tick(target_fwd, cfg, draft_fwd, dcfg, eos_id: int,
     page_table, k_pages, v_pages, d_k, d_v, rng, temps, budgets, k=…,
     out_w=…)``; returns the 9-tuple ``(packed, tok', lens', halted',
     k_pages', v_pages', d_k', d_v', rng')`` where ``packed`` is
-    ``[S, out_w + 2]``: column 0 echoes the input token, column 1 the
-    emitted count, columns 2.. the emitted tokens."""
+    ``[S, out_w + 3]``: column 0 echoes the input token, column 1 the
+    emitted count, column 2 the verify (round) count, columns 3.. the
+    emitted tokens."""
     import jax
     import jax.numpy as jnp
 
@@ -102,7 +104,9 @@ def build_spec_tick(target_fwd, cfg, draft_fwd, dcfg, eos_id: int,
         done0 = halted | (budgets <= 0)
 
         def round_body(state):
-            cur, lens, emitted, done, halted, tcache, dcache, out, rng_in = state
+            (cur, lens, emitted, done, halted, tcache, dcache, out, rounds,
+             rng_in) = state
+            entry_done = done
             live = ~done[:, None]
 
             # ---- draft k+1 autoregressive steps (the last one only for its
@@ -189,13 +193,20 @@ def build_spec_tick(target_fwd, cfg, draft_fwd, dcfg, eos_id: int,
             lens = lens + emit_n
             emitted = emitted + emit_n
             done = done | halted | (emitted >= budgets)
-            return (cur, lens, emitted, done, halted, tcache, dcache, out, rng_in)
+            # per-row verify count (rows live at round entry ran a verify) —
+            # emitted/verifies is the tokens-per-verify ratio operators
+            # tune the draft against
+            rounds = rounds + (~entry_done).astype(jnp.int32)
+            return (cur, lens, emitted, done, halted, tcache, dcache, out,
+                    rounds, rng_in)
 
         def cond(state):
             return jnp.any(~state[3])
 
-        state = (tok, lens, emitted0, done0, halted, tcache, dcache, out0, rng)
-        cur, lens, emitted, _, halted, tcache, dcache, out, rng = \
+        rounds0 = jnp.zeros((s_rows,), jnp.int32)
+        state = (tok, lens, emitted0, done0, halted, tcache, dcache, out0,
+                 rounds0, rng)
+        cur, lens, emitted, _, halted, tcache, dcache, out, rounds, rng = \
             jax.lax.while_loop(cond, round_body, state)
 
         k_pages, v_pages = scatter_prefill(
@@ -204,9 +215,9 @@ def build_spec_tick(target_fwd, cfg, draft_fwd, dcfg, eos_id: int,
         # ONE host-fetchable buffer per tick: col 0 echoes the input token
         # (freshly admitted rows' deferred first tokens reach the host in
         # the same fetch, like the plain tick's packed row 0), col 1 is the
-        # emitted count, cols 2.. are the emitted tokens
+        # emitted count, col 2 the verify count, cols 3.. the emitted tokens
         packed = jnp.concatenate(
-            [tok[:, None], emitted[:, None], out], axis=1
+            [tok[:, None], emitted[:, None], rounds[:, None], out], axis=1
         )
         return (packed, cur, lens, halted,
                 k_pages, v_pages, dcache["k"], dcache["v"], rng)

@@ -560,12 +560,16 @@ def _publish_serving_gauges(container: DependencyContainer):
     for key in (
         "active_slots", "queued", "queued_inbox", "free_pages",
         "avg_active_slots", "max_active_slots",
-        "ttft_p50_ms", "ttft_p95_ms",
+        "ttft_p50_ms", "ttft_p95_ms", "spec_tokens_per_verify",
     ):
         if key in stats:
             m.set_serving_stat(key, float(stats[key]))
     for event in ("ticks", "completed", "ttft_count",
-                  "prefix_hits", "prefix_misses"):
+                  "prefix_hits", "prefix_misses",
+                  # raw counters so Prometheus can compute a WINDOWED
+                  # tokens-per-verify (the lifetime-average gauge above
+                  # flattens draft-quality regressions on long uptimes)
+                  "spec_verifies", "spec_emitted"):
         if event in stats:
             m.bump_serving_total(event, float(stats[event]))
     return stats
