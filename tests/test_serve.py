@@ -239,6 +239,28 @@ class TestHealthAndInfo:
         run(with_client(fast_settings(), body))
 
 
+    def test_info_speculative_resolution(self):
+        """/info names the exact reason a configured draft is inactive
+        (the round-4 dead-knob gap — operators must never see a dead knob
+        reported as active)."""
+
+        async def body(client, container):
+            data = await (await client.get("/info")).json()
+            spec = data["generator"]["speculative"]
+            assert spec["draft_configured"] is True
+            assert spec["active"] is False
+            assert "PREFILL_CHUNK" in spec["ignored_reason"]
+
+        settings = fast_settings(generator=GeneratorConfig(
+            provider="tpu", model_preset="tiny", use_verifier=False,
+            draft_checkpoint_path="/nonexistent-draft", prefill_chunk=512,
+            use_paged_decode=True,
+        ))
+        run(with_client(settings, body,
+                        container=DependencyContainer(settings=settings,
+                                                      mesh=None)))
+
+
 class TestAuth:
     def test_auth_flow(self):
         settings = fast_settings(auth=AuthConfig(enabled=True, jwt_secret="s" * 32))
