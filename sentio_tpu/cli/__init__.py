@@ -64,9 +64,15 @@ def _cmd_bench(args: argparse.Namespace) -> int:
 def _cmd_trace(args: argparse.Namespace) -> int:
     """Run one query through the full graph and dump the execution trace —
     the offline equivalent of the reference's LangGraph Studio inspection
-    (cli/studio.py + langgraph.json there)."""
+    (cli/studio.py + langgraph.json there) — joined with the request's
+    FLIGHT RECORD: with the paged decode path active, the dump includes the
+    engine's tick timeline for this request (batch occupancy, queue depth,
+    prefill/decode token split, page-pool levels) plus TTFT/TPOT."""
+    import uuid
+
     from sentio_tpu.config import get_settings
     from sentio_tpu.graph.state import create_initial_state
+    from sentio_tpu.infra.flight import get_flight_recorder
     from sentio_tpu.serve.dependencies import DependencyContainer
 
     settings = get_settings()
@@ -75,11 +81,15 @@ def _cmd_trace(args: argparse.Namespace) -> int:
     container = DependencyContainer(settings=settings)
     if args.ingest:
         container.ingestor.ingest_path(args.ingest)
+    query_id = f"trace-{uuid.uuid4().hex[:8]}"
     state = container.graph.invoke(
-        create_initial_state(args.query, metadata={"mode": args.mode})
+        create_initial_state(
+            args.query, metadata={"mode": args.mode, "query_id": query_id}
+        )
     )
     trace = {
         "query": args.query,
+        "request_id": query_id,
         "graph_path": state["metadata"].get("graph_path"),
         "node_timings_ms": state["metadata"].get("node_timings_ms"),
         "num_retrieved": len(state.get("retrieved_documents") or []),
@@ -91,6 +101,14 @@ def _cmd_trace(args: argparse.Namespace) -> int:
             if k not in ("graph_path", "node_timings_ms")
         },
     }
+    flight = get_flight_recorder().get(query_id)
+    if flight is not None:
+        # the graph-state copies above stay authoritative; the flight view
+        # adds what only the engine pump saw (ticks, TTFT/TPOT)
+        trace["flight"] = {
+            k: v for k, v in flight.items()
+            if k not in ("node_timings_ms", "graph_path", "request_id")
+        }
     if args.documents:
         trace["selected_documents"] = [
             {"id": d.id, "text": d.text[:200], "metadata": d.metadata}

@@ -8,6 +8,11 @@ boundaries that double as host/TPU dispatch points (a node is free to await a
 batched device call). Nodes return *partial* state updates; the executor
 merges them, records per-node wall time, and never lets a node exception kill
 the pipeline unless the node opts out of soft-fail.
+
+Trace context: when ``metadata["query_id"]`` is set (the serving layer's
+request id), the executor publishes the finished run's per-node timings and
+path into the flight recorder (infra/flight.py), joining the graph stage
+timeline with the decode engine's tick events under one id.
 """
 
 from __future__ import annotations
@@ -85,6 +90,18 @@ class CompiledGraph:
             edge = self.edges.get(current, END)
             current = edge(state) if callable(edge) else edge
         state["metadata"]["graph_path"] = path
+        request_id = state["metadata"].get("query_id")
+        if request_id:
+            try:
+                from sentio_tpu.infra.flight import get_flight_recorder
+
+                get_flight_recorder().add_node_timings(
+                    str(request_id),
+                    state["metadata"].get("node_timings_ms", {}),
+                    graph_path=path,
+                )
+            except Exception:  # noqa: BLE001 — telemetry must not fail runs
+                logger.debug("flight recording failed", exc_info=True)
         return state
 
     def invoke(self, state: dict, config: Optional[dict] = None) -> dict:

@@ -138,6 +138,9 @@ def create_generator_node(generator, settings: Optional[Settings] = None):
         meta = state.get("metadata", {})
         mode = meta.get("mode") or settings.generator.mode
         temperature = meta.get("temperature")
+        # flight-recorder trace context: ties this generation's engine
+        # tickets/ticks to the serving layer's request id
+        request_id = meta.get("query_id")
         t0 = time.perf_counter()
         try:
             # device generation is the longest stage — keep it off the event
@@ -147,6 +150,7 @@ def create_generator_node(generator, settings: Optional[Settings] = None):
                 lambda: generator.generate(
                     state["query"], docs, mode=mode,
                     temperature=temperature if temperature is None else float(temperature),
+                    request_id=str(request_id) if request_id else None,
                 ),
             )
         except Exception as exc:  # noqa: BLE001

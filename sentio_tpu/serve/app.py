@@ -300,12 +300,26 @@ async def _chat_stream(request: web.Request, container: DependencyContainer, req
     """SSE token streaming (reference generator.py:298-333 / openai SSE).
     Retrieval + selection run first (blocking stage on a thread), then the
     generator's token iterator is pumped from a worker thread into the
-    response via a queue."""
+    response via a queue. The flight-record id travels in ``X-Request-Id``
+    (client-pinnable via ``thread_id``) so a streamed request's trace is
+    retrievable from /debug/flight afterwards."""
+    import re
+    import uuid
+
+    # the id is reflected into a response header — a client-supplied
+    # thread_id only pins it when header-safe (no CR/LF/control/unicode),
+    # otherwise the client reads the generated id back from X-Request-Id
+    request_id = (
+        req.thread_id
+        if req.thread_id and re.fullmatch(r"[A-Za-z0-9._:-]{1,128}", req.thread_id)
+        else uuid.uuid4().hex[:12]
+    )
     response = web.StreamResponse(
         headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
             "Connection": "keep-alive",
+            "X-Request-Id": request_id,
         }
     )
     await response.prepare(request)
@@ -342,6 +356,7 @@ async def _chat_stream(request: web.Request, container: DependencyContainer, req
             top_k=req.top_k,
             temperature=req.temperature,
             mode=req.mode,
+            request_id=request_id,
         ):
             if not put((kind, payload)):
                 return
@@ -598,6 +613,24 @@ async def metrics_performance(request: web.Request) -> web.Response:
     )
 
 
+async def debug_flight(request: web.Request) -> web.Response:
+    """One completed (or in-flight) request's flight record: graph node
+    timings joined with the engine-tick window its decode rode (occupancy,
+    queue depth, prefill/decode splits, page-pool levels) plus TTFT/TPOT.
+    Auth-gated when auth is enabled — /debug is NOT in the open-paths list,
+    unlike /metrics — because records quote request shape and timing."""
+    from sentio_tpu.infra.flight import get_flight_recorder
+
+    request_id = request.match_info["request_id"]
+    record = get_flight_recorder().get(request_id)
+    if record is None:
+        raise web.HTTPNotFound(
+            text=json.dumps({"error": f"no flight record for {request_id!r}"}),
+            content_type="application/json",
+        )
+    return web.json_response(record)
+
+
 async def auth_token(request: web.Request) -> web.Response:
     """Password → JWT pair (reference auth flow, utils/auth.py there)."""
     container: DependencyContainer = request.app["container"]
@@ -652,6 +685,7 @@ def create_app(
     app.router.add_get("/info", info)
     app.router.add_get("/metrics", metrics_endpoint)
     app.router.add_get("/metrics/performance", metrics_performance)
+    app.router.add_get("/debug/flight/{request_id}", debug_flight)
     app.router.add_post("/auth/token", auth_token)
 
     async def on_startup(app: web.Application) -> None:

@@ -58,6 +58,15 @@ class ChatHandler:
             metadata["user_top_k"] = top_k
         if temperature is not None:
             metadata["temperature"] = temperature
+        # flight record opens HERE — the query_id in metadata is the trace
+        # context every downstream layer (graph executor, generator provider,
+        # decode-engine pump) attaches its telemetry to
+        from sentio_tpu.infra.flight import get_flight_recorder
+
+        recorder = get_flight_recorder()
+        recorder.start_request(
+            query_id, endpoint="/chat", mode=mode, question_chars=len(question)
+        )
 
         cache = self.container.cache_manager
         try:
@@ -83,9 +92,17 @@ class ChatHandler:
             cache.set_query_response(question, result)
             disk_cache, _ = self.fallback
             disk_cache.put(question, answer)
+            recorder.finish_request(
+                query_id, status="done",
+                latency_ms=result["metadata"]["latency_ms"],
+            )
             return result
         except Exception as exc:  # noqa: BLE001 — ladder, never a 500
             logger.warning("chat pipeline failed (%s); degrading", exc)
+            recorder.finish_request(
+                query_id, status="degraded", error=str(exc),
+                latency_ms=round((time.perf_counter() - t0) * 1000.0, 1),
+            )
             return self._degraded_response(question, query_id, str(exc), t0)
 
     def _degraded_response(
@@ -136,22 +153,40 @@ class ChatHandler:
         top_k: Optional[int] = None,
         temperature: Optional[float] = None,
         mode: str = "balanced",
+        request_id: Optional[str] = None,
     ):
         """Typed-event generator for SSE, with FULL graph-stage parity
         (reference factory.py:191-208 — streaming traverses the same graph):
         retrieve → rerank → select (dedup + token budget) → stream decode →
         verify. Yields ("sources", [...]) once, ("token", str) per increment,
         and ("verdict", {...}) after the stream when the verifier is on.
-        Failures degrade to the ladder text instead of raw errors."""
+        Failures degrade to the ladder text instead of raw errors. The
+        ``request_id`` opens a flight record whose stage timings mirror the
+        stream's stages (streams bypass the graph executor, so the stages
+        are timed here)."""
+        from sentio_tpu.infra.flight import get_flight_recorder
+
+        recorder = get_flight_recorder()
+        t0 = time.perf_counter()
+        if request_id:
+            recorder.start_request(
+                request_id, endpoint="/chat?stream", mode=mode,
+                question_chars=len(question),
+            )
+        timings: dict[str, float] = {}
         try:
+            t = time.perf_counter()
             docs = self.container.retriever.retrieve(
                 question, top_k=top_k or self.settings.retrieval.top_k
             )
+            timings["retrieve"] = round((time.perf_counter() - t) * 1e3, 3)
             reranker = self.container.reranker
             if reranker is not None and docs:
+                t = time.perf_counter()
                 docs = reranker.rerank(
                     question, docs, top_k=self.settings.rerank.top_k
                 ).documents
+                timings["rerank"] = round((time.perf_counter() - t) * 1e3, 3)
             from sentio_tpu.graph.nodes import select_documents
 
             selected, _used = select_documents(
@@ -162,18 +197,47 @@ class ChatHandler:
                  "score": d.score()} for d in selected
             ])
             chunks: list[str] = []
+            t = time.perf_counter()
             for piece in self.container.generator.stream(
-                question, selected, mode=mode, temperature=temperature
+                question, selected, mode=mode, temperature=temperature,
+                request_id=request_id,
             ):
                 chunks.append(piece)
                 yield ("token", piece)
+            timings["generate"] = round((time.perf_counter() - t) * 1e3, 3)
             verifier = self.container.verifier
             answer = "".join(chunks)
             if verifier is not None and answer:
+                t = time.perf_counter()
                 result = verifier.verify(question, answer, selected)
+                timings["verify"] = round((time.perf_counter() - t) * 1e3, 3)
                 yield ("verdict", result.to_dict())
+            if request_id:
+                recorder.add_node_timings(request_id, timings)
+                recorder.finish_request(
+                    request_id, status="done",
+                    latency_ms=round((time.perf_counter() - t0) * 1e3, 1),
+                )
+        except GeneratorExit:
+            # client disconnected mid-stream and the SSE pump closed this
+            # generator — close the flight record (it would otherwise sit
+            # status='active' until LRU eviction, making disconnect-heavy
+            # traffic look like a pile of stuck requests in /debug/flight)
+            if request_id:
+                recorder.add_node_timings(request_id, timings)
+                recorder.finish_request(
+                    request_id, status="disconnected",
+                    latency_ms=round((time.perf_counter() - t0) * 1e3, 1),
+                )
+            raise
         except Exception as exc:  # noqa: BLE001 — ladder, never a raw error
             logger.warning("stream pipeline failed (%s); degrading", exc)
+            if request_id:
+                recorder.add_node_timings(request_id, timings)
+                recorder.finish_request(
+                    request_id, status="degraded", error=str(exc),
+                    latency_ms=round((time.perf_counter() - t0) * 1e3, 1),
+                )
             result = self._degraded_response(question, "stream", str(exc), time.perf_counter())
             yield ("token", result["answer"])
 
