@@ -309,10 +309,19 @@ def phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
         "max_active_slots": stats["max_active_slots"],
         "ingest_docs_per_s": round(docs_per_s, 1),
     }
+    # radix prefix cache: fraction of admitted prompt tokens served
+    # read-only from cached KV over the TIMED window (the before/after
+    # deltas exclude the warmup burst, which both seeds the cache and
+    # hits it at 100% on its repeats)
+    hit = stats.get("prefix_hit_tokens", 0) - stats_before.get("prefix_hit_tokens", 0)
+    miss = stats.get("prefix_miss_tokens", 0) - stats_before.get("prefix_miss_tokens", 0)
+    if hit + miss:
+        result["prefix_hit_token_ratio"] = round(hit / (hit + miss), 4)
     log(f"phase A: p50={result['p50_ms']}ms p95={result['p95_ms']}ms "
         f"qps={result['qps']} occupancy={result['avg_active_slots']} "
         f"nodes={result['node_p50_ms']} "
-        f"ttft={result.get('ttft_ms')} tpot={result.get('tpot_ms')}")
+        f"ttft={result.get('ttft_ms')} tpot={result.get('tpot_ms')} "
+        f"prefix_hit={result.get('prefix_hit_token_ratio')}")
     return result
 
 

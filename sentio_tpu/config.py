@@ -241,8 +241,10 @@ class GeneratorConfig:
     # "int8" stores KV pages quantized (per-vector absmax scales): ~half the
     # pool HBM and decode-read bandwidth, at ~1 percent attention-score error
     kv_quant: str = "none"
-    # prefill the rendered prompt-template head once and share its KV pages
-    # across all /chat requests (read-only; runtime/paged.py register_prefix)
+    # automatic radix prefix cache (runtime/radix.py): every admission
+    # longest-prefix-matches against cached KV page runs and prefills only
+    # its unmatched suffix; PREFIX_CACHE=0 restores plain whole-prompt
+    # admission byte-for-byte
     prefix_cache: bool = True
     max_batch_size: int = 8
     # paged KV + continuous batching as the live /chat decode path; the

@@ -176,9 +176,17 @@ def create_verifier_node(verifier, settings: Optional[Settings] = None):
         if not answer:
             return {"evaluation": {"verdict": "warn", "notes": ["empty answer"]}}
         docs = best_documents(state)
+        # same trace id as the generate node: the verify admission lands on
+        # the same flight record, where its prefix_hit_tokens show the
+        # generate prompt head being reused from the radix cache
+        request_id = state.get("metadata", {}).get("query_id")
         t0 = time.perf_counter()
         result = await asyncio.get_running_loop().run_in_executor(
-            None, verifier.verify, state["query"], answer, docs
+            None,
+            lambda: verifier.verify(
+                state["query"], answer, docs,
+                request_id=str(request_id) if request_id else None,
+            ),
         )
         update: dict[str, Any] = {
             "evaluation": result.to_dict(),

@@ -113,14 +113,22 @@ class FlightRecorder:
             engine.setdefault("tick_first", self._tick_seq)
 
     def finish_engine(self, request_id: str, **fields: Any) -> None:
-        """Close a request's engine section (TTFT/TPOT/tokens/reason) and
-        pin the end of its tick window."""
+        """Close one engine admission for this request and pin the end of
+        its tick window. A request may admit MORE than once under one trace
+        id (the verify node reuses the generate node's id so both land on
+        the same record): every admission appends to ``engine.admissions``
+        verbatim, while the headline scalars (ttft_ms, tokens, …) keep the
+        FIRST admission's values — the user-facing generation."""
         if not request_id:
             return
         with self._lock:
             record = self._ensure_locked(request_id)
             engine = record.setdefault("engine", {})
-            engine.update(fields)
+            engine.setdefault("admissions", []).append(
+                dict(fields, tick_last=self._tick_seq)
+            )
+            for key, value in fields.items():
+                engine.setdefault(key, value)
             engine["tick_last"] = self._tick_seq
             self._records.move_to_end(request_id)
 

@@ -11,6 +11,7 @@ deterministic offline fake (the reference's mock-mode test pattern).
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Iterator, Optional, Protocol, Sequence
 
@@ -148,32 +149,46 @@ class OpenAIProvider:
     # endpoint-reported (or locally counted) token usage of the last
     # successful chat(); empty before the first call
     last_usage: dict = field(default_factory=dict)
+    # guards base_url switches + client/retired-client bookkeeping: chat()
+    # runs on concurrent worker threads, and unguarded 404 fallbacks could
+    # flap base_url back and forth or drop a pooled client unclosed
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, init=False, repr=False, compare=False
+    )
 
     def _client(self):
         """One pooled httpx.Client per provider — reused across calls and
-        retries (a client per request would pay a TCP/TLS handshake each)."""
+        retries (a client per request would pay a TCP/TLS handshake each).
+        Double-checked under the lock so two racing first calls cannot each
+        build a client and strand one unclosed."""
         client = getattr(self, "_client_cached", None)
         if client is None:
-            import httpx
+            with self._lock:
+                client = getattr(self, "_client_cached", None)
+                if client is None:
+                    import httpx
 
-            headers = {"Content-Type": "application/json"}
-            if self.api_key:
-                headers["Authorization"] = f"Bearer {self.api_key}"
-            client = httpx.Client(
-                base_url=self.base_url.rstrip("/"), timeout=self.timeout_s,
-                headers=headers,
-            )
-            object.__setattr__(self, "_client_cached", client)
+                    headers = {"Content-Type": "application/json"}
+                    if self.api_key:
+                        headers["Authorization"] = f"Bearer {self.api_key}"
+                    client = httpx.Client(
+                        base_url=self.base_url.rstrip("/"),
+                        timeout=self.timeout_s, headers=headers,
+                    )
+                    object.__setattr__(self, "_client_cached", client)
         return client
 
     def close(self) -> None:
-        client = getattr(self, "_client_cached", None)
-        if client is not None:
-            client.close()
-            object.__setattr__(self, "_client_cached", None)
-        for old in getattr(self, "_retired_clients", []):
+        with self._lock:
+            doomed = []
+            client = getattr(self, "_client_cached", None)
+            if client is not None:
+                doomed.append(client)
+                object.__setattr__(self, "_client_cached", None)
+            doomed.extend(getattr(self, "_retired_clients", []))
+            object.__setattr__(self, "_retired_clients", [])
+        for old in doomed:
             old.close()
-        object.__setattr__(self, "_retired_clients", [])
 
     def _payload(self, prompt: str, max_new_tokens: int, temperature: float) -> dict:
         return {
@@ -197,19 +212,32 @@ class OpenAIProvider:
             return urlunsplit(parts._replace(path=new_path))
         return None
 
-    def _switch_base(self, new_base: str) -> None:
+    def _switch_base(self, new_base: str,
+                     only_from: Optional[str] = None) -> bool:
         """Rebind the base URL WITHOUT closing the old client: concurrent
         serving threads may have requests in flight on it (closing would
-        fail them mid-call). Superseded clients park until close()."""
-        old = getattr(self, "_client_cached", None)
-        if old is not None:
-            retired = getattr(self, "_retired_clients", None)
-            if retired is None:
-                retired = []
-                object.__setattr__(self, "_retired_clients", retired)
-            retired.append(old)
-            object.__setattr__(self, "_client_cached", None)
-        object.__setattr__(self, "base_url", new_base)
+        fail them mid-call). Superseded clients park until close().
+
+        Compare-and-swap under the lock: with ``only_from`` set, the switch
+        happens only while ``base_url`` still holds that value — a thread
+        whose 404 raced another thread's already-completed fallback becomes
+        a no-op instead of re-switching (or re-reverting) the URL out from
+        under everyone. Returns whether THIS call performed the switch."""
+        with self._lock:
+            if only_from is not None and self.base_url != only_from:
+                return False
+            if self.base_url == new_base:
+                return False
+            old = getattr(self, "_client_cached", None)
+            if old is not None:
+                retired = getattr(self, "_retired_clients", None)
+                if retired is None:
+                    retired = []
+                    object.__setattr__(self, "_retired_clients", retired)
+                retired.append(old)
+                object.__setattr__(self, "_client_cached", None)
+            object.__setattr__(self, "base_url", new_base)
+            return True
 
     def count_tokens(self, text: str) -> int:
         """Token estimate for budget math when the endpoint returns no
@@ -254,10 +282,20 @@ class OpenAIProvider:
                     "/chat/completions",
                     json=self._payload(prompt, max_new_tokens, temperature),
                 )
+                if resp.status_code == 404 and not str(
+                    resp.request.url
+                ).startswith(self.base_url.rstrip("/")):
+                    # raced a concurrent thread's fallback switch: this 404
+                    # came from the RETIRED base — re-issue on the current
+                    # client instead of failing the call hard
+                    resp = self._client().post(
+                        "/chat/completions",
+                        json=self._payload(prompt, max_new_tokens, temperature),
+                    )
                 alt = self._alt_base() if resp.status_code == 404 else None
                 if alt:
                     old = self.base_url
-                    self._switch_base(alt)
+                    switched = self._switch_base(alt, only_from=old)
                     try:
                         resp = self._client().post(
                             "/chat/completions",
@@ -265,13 +303,16 @@ class OpenAIProvider:
                         )
                     except Exception:
                         # probe blew up before any status — the switch is
-                        # unverified, keep the configured base
-                        self._switch_base(old)
+                        # unverified, keep the configured base (but only if
+                        # WE switched: a concurrent thread's verified switch
+                        # must not be reverted by our failed probe)
+                        if switched:
+                            self._switch_base(old, only_from=alt)
                         raise
-                    if resp.status_code >= 400:
+                    if resp.status_code >= 400 and switched:
                         # the alternate is no better — undo the switch so a
                         # genuinely-404 deployment keeps its configured base
-                        self._switch_base(old)
+                        self._switch_base(old, only_from=alt)
                 resp.raise_for_status()
                 body = resp.json()
                 reply = body["choices"][0]["message"]["content"]
@@ -450,9 +491,16 @@ class LLMGenerator:
             **self._trace_kwargs("stream", request_id),
         )
 
-    def chat_raw(self, prompt: str, max_new_tokens: int, temperature: float) -> str:
-        """Direct provider access (verifier path — shares the weights)."""
-        return self.provider.chat(prompt, max_new_tokens=max_new_tokens, temperature=temperature)
+    def chat_raw(self, prompt: str, max_new_tokens: int, temperature: float,
+                 request_id: Optional[str] = None) -> str:
+        """Direct provider access (verifier path — shares the weights). A
+        ``request_id`` ties the call into the flight recorder, so the
+        verify node's engine admission shows up on the same trace as the
+        generate node's."""
+        return self.provider.chat(
+            prompt, max_new_tokens=max_new_tokens, temperature=temperature,
+            **self._trace_kwargs("chat", request_id),
+        )
 
 
 def create_generator(

@@ -27,9 +27,19 @@ _FALLBACK_TEMPLATES = {
         "Answer using only the context above. Cite sources inline as [n]. "
         "If the context does not contain the answer, say so plainly."
     ),
+    # the verify prompt EMBEDS the retrieve prompt verbatim as its head —
+    # byte-identical through the generate instruction — so the paged
+    # engine's radix prefix cache serves the whole generate-prompt span
+    # (instruction + context + question) read-only on the verify admission
+    # and prefills only the audit tail
     "verify": (
-        "You are auditing an answer for faithfulness to its sources.\n"
-        "Question: {query}\n\nSources:\n{context}\n\nAnswer:\n{instruction}\n\n"
+        "{instruction}\n\n"
+        "Context documents:\n{context}\n\n"
+        "Question: {query}\n\n"
+        "Answer using only the context above. Cite sources inline as [n]. "
+        "If the context does not contain the answer, say so plainly.\n\n"
+        "You are now auditing the answer below for faithfulness to the "
+        "context documents above.\n\nAnswer under audit:\n{answer}\n\n"
         'Reply with ONLY a JSON object: {"verdict": "pass"|"warn"|"fail", '
         '"citations_ok": true|false, "notes": ["..."], '
         '"revised_answer": "... (only when verdict is fail)"}'
@@ -58,13 +68,14 @@ class PromptBuilder:
 
     def static_head(self, name: str, **values) -> str:
         """The template's constant leading text — everything before the
-        first request-varying placeholder ({context}/{query}) — with the
-        provided static values substituted. This is what the serving layer
-        registers as the paged engine's shared KV prefix: every /chat
-        prompt built from this template starts with these exact bytes."""
+        first request-varying placeholder ({context}/{query}/{answer}) —
+        with the provided static values substituted. The serving layer
+        warms the paged engine's radix prefix cache with this span: every
+        /chat prompt built from this template starts with these exact
+        bytes, so even the first request after boot admits suffix-only."""
         text = self.load(name)
         cut = len(text)
-        for dynamic in ("{context}", "{query}"):
+        for dynamic in ("{context}", "{query}", "{answer}"):
             idx = text.find(dynamic)
             if idx != -1:
                 cut = min(cut, idx)
@@ -92,14 +103,19 @@ class PromptBuilder:
         instruction: str = "",
         context: str = "",
         query: str = "",
+        answer: str = "",
     ) -> str:
         template = self.load(name)
-        values = {"instruction": instruction, "context": context, "query": query}
+        values = {
+            "instruction": instruction, "context": context,
+            "query": query, "answer": answer,
+        }
         # single-pass substitution: placeholder strings occurring INSIDE a
         # substituted value (an answer quoting "{context}", say) must not be
         # re-expanded, and other braces in retrieved text stay literal
         return re.sub(
-            r"\{(instruction|context|query)\}", lambda m: values[m.group(1)], template
+            r"\{(instruction|context|query|answer)\}",
+            lambda m: values[m.group(1)], template,
         )
 
     @classmethod

@@ -51,16 +51,27 @@ class AnswerVerifier:
         query: str,
         answer: str,
         documents: Sequence[Document],
+        request_id: Optional[str] = None,
     ) -> VerifyResult:
         try:
+            # the audit prompt EMBEDS the generate prompt verbatim as its
+            # head (same instruction profile + context + question, in the
+            # same bytes) — on the paged engine the radix prefix cache then
+            # serves that whole span from the generate admission's KV pages
+            # and this call prefills only the audit tail
             context = self.generator.prepare_context(documents)
             prompt = self.prompts.build(
-                "verify", instruction=answer, context=context, query=query
+                "verify",
+                instruction=self.prompts.load("profile"),
+                context=context,
+                query=query,
+                answer=answer,
             )
             reply = self.generator.chat_raw(
                 prompt,
                 max_new_tokens=self.config.verifier_max_tokens,
                 temperature=0.0,
+                request_id=request_id,
             )
             return self._normalize(reply)
         except Exception as exc:  # noqa: BLE001 — the audit must never 500

@@ -352,9 +352,16 @@ class _Slot:
     # decode sub-steps granted to dispatched-but-unharvested ticks — budget
     # math must count them or a pipelined tick would over-run the limits
     inflight_steps: int = 0
-    # tokens served from shared (read-only) prefix pages at the front of
-    # this slot's page table — counted in capacity, never freed by retire
+    # tokens served from shared (read-only) prefix-cache pages at the front
+    # of this slot's page table — counted in capacity, never freed by retire
     shared_tokens: int = 0
+    # radix-cache bookkeeping: the node chain this slot pins (its page table
+    # references those pages), the truncated prompt token ids (the insert key
+    # once the prompt KV is fully written), and pages whose ownership moved
+    # to the cache at insert time (retire must NOT free them)
+    prefix_node: object = None
+    prompt_ids: Optional[list] = None
+    donated: list = field(default_factory=list)
     # wall-clock at submit(); TTFT is measured when the first sampled token
     # becomes host-visible (pending_first flips False)
     submit_t: float = 0.0
@@ -385,6 +392,11 @@ class PagedResult:
     tokens: list[int]
     prompt_tokens: int
     finish_reason: str  # "stop" | "length"
+    # prompt tokens actually forwarded at admission vs served read-only from
+    # the radix prefix cache (prefill_tokens + prefix_hit_tokens ==
+    # prompt_tokens) — the per-request evidence of prefill work skipped
+    prefill_tokens: int = 0
+    prefix_hit_tokens: int = 0
 
 
 class ContinuousBatchingEngine:
@@ -424,6 +436,7 @@ class ContinuousBatchingEngine:
         draft_params=None,
         draft_config=None,
         spec_k: int = 4,
+        prefix_cache: bool = True,
     ) -> None:
         """``forward_fn`` swaps the prefill model family (llama_forward
         contract); the fused decode tick detects the family per layer (a
@@ -561,15 +574,29 @@ class ContinuousBatchingEngine:
         # TTFT telemetry: submit() → first token host-visible, seconds
         self.ttft_samples: deque = deque(maxlen=1024)
         self.ttft_count = 0
-        # shared-prefix cache (register_prefix): {"tokens", "pages", "n"} —
-        # page-aligned KV of a common prompt prefix, referenced read-only by
-        # matching requests' page tables and never freed by retire
-        self._prefix = None
-        # operator visibility for the BPE-boundary failure mode: a
-        # registered prefix that never token-matches is silent otherwise
-        # (correct output, zero benefit, pages permanently reserved)
+        # automatic radix prefix cache (runtime/radix.py): every admitted
+        # prompt's full-page KV is inserted into a token-id radix tree and
+        # later requests — including the verify node reusing the generate
+        # node's prompt head — longest-prefix-match against it, prefilling
+        # only their unmatched suffix. prefix_cache=False (PREFIX_CACHE=0)
+        # disables it entirely: every admission takes the cold prefill path,
+        # byte-for-byte the pre-cache behavior.
+        self._prefix_cache_enabled = bool(prefix_cache)
+        if self._prefix_cache_enabled:
+            from sentio_tpu.runtime.radix import RadixPrefixCache
+
+            self._radix = RadixPrefixCache(page_size, self.allocator)
+        else:
+            self._radix = None
+        # operator visibility for the BPE-boundary failure mode: a cached
+        # head that never token-matches is silent otherwise (correct output,
+        # zero benefit). Hits/misses count admissions against a non-empty
+        # cache; the *_tokens totals count matched vs forwarded prompt
+        # tokens — the number the prefill-skip claim is audited by.
         self.prefix_hits = 0
         self.prefix_misses = 0
+        self.prefix_hit_tokens_total = 0
+        self.prefix_miss_tokens_total = 0
         # paged-speculation efficiency: emitted/verifies = tokens-per-verify
         # (how well the draft predicts the target — the number that decides
         # whether the draft pays for itself)
@@ -716,82 +743,48 @@ class ContinuousBatchingEngine:
 
         self._prefill_scatter = prefill_scatter
 
-        @partial(jax.jit, static_argnames=("n_shared",), donate_argnums=(7, 8))
-        def prefix_prefill_scatter(params, ids, positions, lens, rng, temps,
-                                   scat, k_pages, v_pages, prefix_table,
-                                   n_shared):
-            """Suffix admission against a shared prefix: prime a contiguous
-            cache with the prefix KV gathered from its (read-only) pool
-            pages, prefill ONLY the suffix tokens at offset positions, and
-            scatter only the suffix blocks. ``ids``/``lens`` are the suffix;
-            sampling happens at each row's last suffix logit."""
+        page_size = self.page_size
+
+        @partial(jax.jit, static_argnames=("do_sample",), donate_argnums=(7, 8))
+        def prior_prefill_scatter(params, ids, positions, lens, rng, temps,
+                                  scat, k_pages, v_pages, prior_table,
+                                  n_prior, do_sample):
+            """Prefill a batch of suffixes against per-row prior KV already
+            in the pool — ONE compiled family for both radix-cache admission
+            (prior = the matched shared-prefix pages) and chunked-prefill
+            segments (prior = the row's own earlier segments + any matched
+            prefix). Primes a contiguous cache from each row's prior pages,
+            runs the suffix tokens at per-row offset positions, scatters
+            only the new blocks.
+
+            ``prior_table`` [B, PNB] is padded to a power-of-two page-count
+            bucket with scratch page 0 and ``n_prior`` [B] carries the TRUE
+            per-row prior lengths (traced, not static): pad pages' garbage
+            stays masked because every key index past a row's real tokens
+            exceeds all of its query positions, and the bucketing bounds
+            compile variants to O(log window) instead of one fresh XLA
+            program per (prior, width) pair. The first token samples only
+            when ``do_sample`` (chunked prefill's non-final segments pass
+            False), keeping the rng stream identical to whole-prompt
+            admission."""
             from sentio_tpu.models.llama import init_cache
             from sentio_tpu.runtime.sampling import sample_tokens
 
             b, width = ids.shape
-            cache = init_cache(cfg, b, n_shared + width)
-
-            def prime(cache_arr, pages):
-                # gather the prefix blocks for ALL layers in one indexed
-                # read; same prefix for every row (broadcast over B)
-                if isinstance(pages, dict):
-                    qv = pages["q"][:, prefix_table[0]]
-                    sc = pages["s"][:, prefix_table[0]]
-                    dense = dequantize_kv(qv, sc, cache_arr.dtype)
-                else:
-                    dense = pages[:, prefix_table[0]]
-                lcount, nb_, pg_, hk_, hd_ = dense.shape
-                prefix_kv = dense.reshape(lcount, nb_ * pg_, hk_, hd_)
-                return cache_arr.at[:, :, :n_shared].set(prefix_kv[:, None])
-
-            cache = dict(cache)
-            cache["k"] = prime(cache["k"], k_pages)
-            cache["v"] = prime(cache["v"], v_pages)
-
-            pad_mask = jnp.arange(width)[None, :] < lens[:, None]
-            logits, cache = forward_fn(
-                params, cfg, ids, positions=positions, cache=cache,
-                cache_index=n_shared, pad_mask=pad_mask,
-            )
-            # scatter ONLY the suffix blocks (prefix pages are shared)
-            k_pages, v_pages = scatter_prefill(
-                k_pages, v_pages,
-                cache["k"][:, :, n_shared:], cache["v"][:, :, n_shared:], scat,
-            )
-            last = jnp.take_along_axis(logits, (lens - 1)[:, None, None], axis=1)[:, 0]
-            rng, sub = jax.random.split(rng)
-            first = sample_tokens(last, sub, temps)
-            return first, k_pages, v_pages, rng
-
-        self._prefix_prefill_scatter = prefix_prefill_scatter
-
-        @partial(jax.jit, static_argnames=("n_prior", "do_sample"),
-                 donate_argnums=(7, 8))
-        def segment_prefill_scatter(params, ids, positions, lens, rng, temps,
-                                    scat, k_pages, v_pages, prior_table,
-                                    n_prior, do_sample):
-            """One chunked-prefill segment: prime a contiguous cache with the
-            row's OWN already-written KV (per-row page gather — unlike the
-            shared-prefix variant's broadcast table), run the segment's
-            tokens at offset positions, scatter only the new blocks. The
-            first token samples ONLY on the final segment (``do_sample``),
-            so the rng stream matches whole-prompt admission exactly."""
-            from sentio_tpu.models.llama import init_cache
-            from sentio_tpu.runtime.sampling import sample_tokens
-
-            b, width = ids.shape
-            cache = init_cache(cfg, b, n_prior + width)
-            if n_prior:
+            pnb = prior_table.shape[1]
+            prior_w = pnb * page_size
+            cache = init_cache(cfg, b, prior_w + width)
+            if pnb:
                 def prime(cache_arr, pages):
                     if isinstance(pages, dict):
                         qv = pages["q"][:, prior_table]
                         sc = pages["s"][:, prior_table]
                         dense = dequantize_kv(qv, sc, cache_arr.dtype)
                     else:
-                        dense = pages[:, prior_table]  # [L, B, nb, pg, Hk, Hd]
+                        dense = pages[:, prior_table]  # [L, B, PNB, pg, Hk, Hd]
                     lcount, bb, nb_, pg_, hk_, hd_ = dense.shape
-                    prior_kv = dense.reshape(lcount, bb, nb_ * pg_, hk_, hd_)
-                    return cache_arr.at[:, :, :n_prior].set(prior_kv)
+                    return cache_arr.at[:, :, :prior_w].set(
+                        dense.reshape(lcount, bb, nb_ * pg_, hk_, hd_))
 
                 cache = dict(cache)
                 cache["k"] = prime(cache["k"], k_pages)
@@ -802,10 +795,18 @@ class ContinuousBatchingEngine:
                 params, cfg, ids, positions=positions, cache=cache,
                 cache_index=n_prior, pad_mask=pad_mask,
             )
-            k_pages, v_pages = scatter_prefill(
-                k_pages, v_pages,
-                cache["k"][:, :, n_prior:], cache["v"][:, :, n_prior:], scat,
-            )
+            # each row's new KV sits at its own dynamic offset in the primed
+            # cache — slice the [n_prior, n_prior + width) window per row
+            def row_window(arr, start):  # [L, S, Hk, Hd] → [L, width, Hk, Hd]
+                return jax.lax.dynamic_slice(
+                    arr, (0, start, 0, 0),
+                    (arr.shape[0], width, arr.shape[2], arr.shape[3]))
+
+            k_new = jax.vmap(row_window, in_axes=(1, 0), out_axes=1)(
+                cache["k"], n_prior)
+            v_new = jax.vmap(row_window, in_axes=(1, 0), out_axes=1)(
+                cache["v"], n_prior)
+            k_pages, v_pages = scatter_prefill(k_pages, v_pages, k_new, v_new, scat)
             if do_sample:
                 last = jnp.take_along_axis(
                     logits, (lens - 1)[:, None, None], axis=1)[:, 0]
@@ -815,7 +816,7 @@ class ContinuousBatchingEngine:
                 first = jnp.zeros((b,), jnp.int32)
             return first, k_pages, v_pages, rng
 
-        self._segment_prefill_scatter = segment_prefill_scatter
+        self._prior_prefill_scatter = prior_prefill_scatter
 
         if self.draft_params is not None:
             from sentio_tpu.models.llama import llama_forward as _draft_fwd
@@ -874,52 +875,52 @@ class ContinuousBatchingEngine:
         ))
         return rid
 
-    def register_prefix(self, text: str) -> int:
-        """Prefill a shared prompt prefix ONCE and let every matching
-        request's page table reference its pages read-only (the RAG
-        pipeline's instruction header is identical across requests — the
-        classic prefix-cache win). Only full pages are shared; the
-        remainder re-prefills per request. Returns the number of shared
-        tokens (0 = prefix shorter than one page, nothing cached).
-
-        One prefix at a time; registering again replaces it. The old pages
-        are freed immediately, so registration is only legal between
-        requests: a live slot's page table may reference the old prefix's
-        pages, and freeing them mid-flight would let a later admission
-        scribble over KV still being attended to. Enforced, not documented:
-        raises while any slot is active."""
-        if any(s.active for s in self.slots):
-            raise RuntimeError(
-                "register_prefix while slots are active: live page tables "
-                "reference the current prefix pages; drain in-flight "
-                "requests first"
-            )
-        toks = self.tokenizer.encode(text, add_bos=True)
-        n_blocks = len(toks) // self.page_size
-        # cap: leave at least half the table for per-request suffix+decode
-        n_blocks = min(n_blocks, self.max_pages_per_seq // 2)
-        # drop the old prefix FIRST (also on the too-short path — its pages
-        # must not leak) and clear the pointer before freeing so a failed
-        # re-registration can never leave _prefix referencing freed pages
-        old_prefix, self._prefix = self._prefix, None
-        if old_prefix is not None:
-            self.allocator.free(old_prefix["pages"])
-        if n_blocks == 0:
+    def warm_prefix(self, text: str) -> int:
+        """Pre-populate the radix prefix cache with ``text``'s full-page KV
+        so even the FIRST matching request admits suffix-only (without
+        warming, request one prefills cold and seeds the cache itself).
+        Returns the number of tokens now cached (0 = cache disabled or text
+        shorter than one page). Idempotent; safe while slots are active —
+        the cache is append-only from the engine's single driver thread and
+        warming never frees pages a live table references. Warmed nodes are
+        unpinned: LRU eviction reclaims them under page-pool pressure like
+        any other cached prefix."""
+        if self._radix is None:
             return 0
-        n_shared = n_blocks * self.page_size
-        pages = self.allocator.alloc(n_blocks)
-
-        width = self._prefill_width(n_shared)
-        ids, lens, temps, scat, positions = self._assemble_prefill(
-            [(toks[:n_shared], 0.0, pages)], width
-        )
+        toks = self.tokenizer.encode(text, add_bos=True)
+        # leave at least one page of table room for suffix + decode
+        n_blocks = min(len(toks) // self.page_size, self.max_pages_per_seq - 1)
+        if n_blocks <= 0:
+            return 0
+        full = n_blocks * self.page_size
+        matched, _pages, _node = self._radix.match(toks[:full])
+        if matched >= full:
+            return full  # already warm
+        need = (full - matched) // self.page_size
+        if need > self.allocator.free_pages:
+            self._radix.evict(need - self.allocator.free_pages)
+            matched, _pages, _node = self._radix.match(toks[:full])
+            need = (full - matched) // self.page_size
+            if need > self.allocator.free_pages:
+                return 0  # pool pinned by live slots; requests warm it later
+        pages = self.allocator.alloc(need)
+        # cold-prefill the whole span, scatter only the uncovered blocks
+        # (already-cached blocks scatter to scratch page 0 and are dropped);
         # the sampled token is discarded — this dispatch only fills pages
+        width = self._prefill_width(full)
+        ids, lens, temps, scat, positions = self._assemble_prefill(
+            [(toks[:full], 0.0, [0] * (matched // self.page_size) + pages)],
+            width,
+        )
         _first, self.pool.k, self.pool.v, self._rng = self._prefill_scatter(
             self.params, ids, positions, lens, self._rng, temps, scat,
             self.pool.k, self.pool.v,
         )
-        self._prefix = {"tokens": toks[:n_shared], "pages": pages, "n": n_shared}
-        return n_shared
+        _node, donated = self._radix.insert(toks[:full], matched, pages)
+        leftover = set(pages) - set(donated)
+        if leftover:  # span raced into the tree between match and insert
+            self.allocator.free(list(leftover))
+        return full
 
     def cancel(self, request_id: int) -> bool:
         """Abandon a request: queued → dropped; decoding → slot retired and
@@ -962,7 +963,10 @@ class ContinuousBatchingEngine:
         self._pending_first.clear()
         self._dev_state = None
         self._inflight = None
-        self._prefix = None
+        if self._prefix_cache_enabled:
+            from sentio_tpu.runtime.radix import RadixPrefixCache
+
+            self._radix = RadixPrefixCache(self.page_size, self.allocator)
         self._spec_dk = self._spec_dv = None  # rebuilt lazily (zeros)
         self._page_table[:] = 0
         self._lens[:] = 0
@@ -1028,6 +1032,50 @@ class ContinuousBatchingEngine:
         )
         return ((width + self.page_size - 1) // self.page_size) * self.page_size
 
+    def _prior_bucket(self, n_blocks: int) -> int:
+        """Static prior-table width for ``n_blocks`` prior pages: the next
+        power of two (capped at the per-sequence window) so prior-primed
+        prefill compiles O(log window) variants. 0 stays 0 (no prior)."""
+        if n_blocks <= 0:
+            return 0
+        return min(1 << (n_blocks - 1).bit_length(), self.max_pages_per_seq)
+
+    def _match_radix(self, tok_ids: Sequence[int]):
+        """Longest-prefix match against the radix cache, clamped so at
+        least one suffix token remains to prefill (the first sampled token
+        comes from the last prompt logit). → (shared, pages, node)."""
+        if self._radix is None or self._radix.empty:
+            return 0, [], None
+        matched, pages, node = self._radix.match(tok_ids)
+        max_shared = ((len(tok_ids) - 1) // self.page_size) * self.page_size
+        if matched > max_shared:
+            matched = max_shared
+            pages = pages[: matched // self.page_size]
+        if matched <= 0:
+            return 0, [], None
+        return matched, pages, node
+
+    def _radix_insert(self, slot_idx: int, tok_ids, shared: int) -> None:
+        """Move slot ``slot_idx``'s freshly prefilled full-page prompt span
+        ``[shared, full)`` into the radix cache. Donated pages change owner
+        (retire no longer frees them); the slot re-pins the deepest node so
+        eviction can't touch pages its table references. Must run AFTER the
+        dispatch that writes those pages — matches by later admissions are
+        then ordered behind the write on device."""
+        if self._radix is None:
+            return
+        slot = self.slots[slot_idx]
+        full = (len(tok_ids) // self.page_size) * self.page_size
+        if full <= shared:
+            return
+        own = slot.pages[: (full - shared) // self.page_size]
+        node, donated = self._radix.insert(list(tok_ids[:full]), shared, own)
+        slot.donated.extend(donated)
+        if node is not None and node is not slot.prefix_node:
+            self._radix.lock(node)
+            self._radix.unlock(slot.prefix_node)
+            slot.prefix_node = node
+
     def _admit(self) -> None:
         free = self._free_slot_indices()
         if not free or not self._queue:
@@ -1048,27 +1096,30 @@ class ContinuousBatchingEngine:
             window = self.max_pages_per_seq * self.page_size
             reserve = min(req.max_new + 2, window // 2)
             tok_ids = tok_ids[: window - reserve]
-            # shared-prefix hit: the prompt starts with the registered
-            # prefix AND extends past it → its table reuses the prefix
-            # pages read-only and only the suffix prefills
-            pfx = self._prefix
-            shared = 0
-            if (
-                pfx is not None
-                and len(tok_ids) > pfx["n"]
-                and tok_ids[: pfx["n"]] == pfx["tokens"]
-            ):
-                shared = pfx["n"]
-            shared_blocks = shared // self.page_size
+            # radix-cache hit: longest page-aligned prefix of this prompt
+            # already in the pool → the table reuses those pages read-only
+            # and only the unmatched suffix prefills
+            cache_live = self._radix is not None and not self._radix.empty
+            shared, match_pages, match_node = self._match_radix(tok_ids)
             # speculation headroom: a verify block writes KV for up to
             # spec_k+1 positions past the accepted length before acceptance
             # is known — those writes need real pages behind them
             spec_head = (self.spec_k + 1) if self._spec_tick is not None else 0
-            need_total = min(
-                (len(tok_ids) - shared + req.max_new + spec_head
-                 + self.page_size - 1) // self.page_size,
-                self.max_pages_per_seq - shared_blocks,
-            )
+
+            def pages_needed(sh: int) -> int:
+                return min(
+                    (len(tok_ids) - sh + req.max_new + spec_head
+                     + self.page_size - 1) // self.page_size,
+                    self.max_pages_per_seq - sh // self.page_size,
+                )
+
+            need_total = pages_needed(shared)
+            if need_total > self.allocator.free_pages and self._radix is not None:
+                # reclaim LRU unpinned cached prefixes; the match may have
+                # walked nodes the eviction just freed, so rematch after
+                if self._radix.evict(need_total - self.allocator.free_pages):
+                    shared, match_pages, match_node = self._match_radix(tok_ids)
+                    need_total = pages_needed(shared)
             if need_total > self.allocator.free_pages:
                 # skip-ahead: a too-large request must not idle free slots
                 # while smaller requests queue behind it (round-4 weak #3:
@@ -1087,12 +1138,19 @@ class ContinuousBatchingEngine:
             else:
                 self._head_skips += 1
             # counted per ADMISSION (not per scan attempt — skip-ahead may
-            # examine a queued request many times before it admits)
-            if pfx is not None:
+            # examine a queued request many times before it admits). Hits/
+            # misses count only against a non-empty cache (the very first
+            # admission has nothing to hit); token totals always accrue so
+            # the hit ratio reflects the cold start honestly.
+            if cache_live:
                 if shared:
                     self.prefix_hits += 1
                 else:
                     self.prefix_misses += 1
+            if self._radix is not None:
+                self.prefix_hit_tokens_total += shared
+                self.prefix_miss_tokens_total += len(tok_ids) - shared
+                self._radix.lock(match_node)
             chunked = (
                 self.prefill_chunk is not None
                 and len(tok_ids) - shared > self.prefill_chunk
@@ -1109,13 +1167,17 @@ class ContinuousBatchingEngine:
             slot.emitted = []
             slot.inflight_steps = 0
             slot.shared_tokens = shared
+            slot.prefix_node = match_node
+            slot.prompt_ids = list(tok_ids) if self._radix is not None else None
+            slot.donated = []
             slot.submit_t = req.submit_t
             slot.prefill_todo = list(tok_ids[shared:]) if chunked else None
             slot.prefill_done = 0
             slot.active = True
+            shared_blocks = shared // self.page_size
             row = np.zeros(self.max_pages_per_seq, np.int32)
             if shared_blocks:
-                row[:shared_blocks] = pfx["pages"]
+                row[:shared_blocks] = match_pages
             row[shared_blocks : shared_blocks + len(pages)] = pages
             self._page_table[slot_idx] = row
             self._lens[slot_idx] = len(tok_ids)
@@ -1130,17 +1192,22 @@ class ContinuousBatchingEngine:
         # sampled first tokens STAY ON DEVICE (slot.pending_first): the next
         # tick merges them into its token input and its single packed fetch
         # carries them back — admission adds zero host round trips.
+        # rows with a prefix hit group by (suffix width, prior-page bucket)
+        # — per-row prior lengths ride the dispatch as data, so different
+        # match depths share one compiled program; cold rows keep the plain
+        # path (identical dispatch to a cache-disabled engine)
         groups: dict[tuple[int, int], list] = {}
         for item in batch:
             shared = item[3]
             width = self._prefill_width(len(item[2]) - shared)
-            groups.setdefault((width, shared), []).append(item)
+            pnb = self._prior_bucket(shared // self.page_size)
+            groups.setdefault((width, pnb), []).append(item)
         max_rows = max(self.ADMIT_BUCKETS)
-        for (width, shared), members in sorted(groups.items()):
+        for (width, pnb), members in sorted(groups.items()):
             for start in range(0, len(members), max_rows):
                 chunk = members[start : start + max_rows]
-                if shared:
-                    self._prefill_chunk_prefixed(width, shared, chunk)
+                if pnb:
+                    self._prefill_chunk_prior(width, pnb, chunk)
                 else:
                     self._prefill_chunk(width, [m[:3] for m in chunk])
         if self._spec_tick is not None:
@@ -1151,11 +1218,15 @@ class ContinuousBatchingEngine:
         FULL prompt (prefix-shared pages are target-side only), grouped by
         full-length width bucket like target admission."""
         self._ensure_draft_cache()
+        # the draft cache window is max_pages_per_seq * page_size per row;
+        # a bucketed width past it would make the [:width] update overhang
+        # the cache axis and fail at trace time (prompts are already
+        # truncated below the window at admission, so clamping is lossless)
+        window = self.max_pages_per_seq * self.page_size
         groups: dict[int, list] = {}
         for slot_idx, _req, tok_ids, _shared in batch:
-            groups.setdefault(self._prefill_width(len(tok_ids)), []).append(
-                (slot_idx, tok_ids)
-            )
+            width = min(self._prefill_width(len(tok_ids)), window)
+            groups.setdefault(width, []).append((slot_idx, tok_ids))
         max_rows = max(self.ADMIT_BUCKETS)
         for width, members in sorted(groups.items()):
             for start in range(0, len(members), max_rows):
@@ -1219,29 +1290,44 @@ class ContinuousBatchingEngine:
         for slot_idx in slot_idxs:
             self.slots[slot_idx].pending_first = True
         self._pending_first.append((first, slot_idxs))
+        # the dispatch above writes these rows' full prompt KV — their
+        # full-page spans now seed the radix cache for later requests
+        for slot_idx, _req, tok_ids in chunk:
+            self._radix_insert(slot_idx, tok_ids, 0)
 
-    def _prefill_chunk_prefixed(
-        self, width: int, shared: int, chunk: list
-    ) -> None:
-        """Suffix-only admission for rows sharing the registered prefix:
-        ids/positions/scatter cover ONLY the post-prefix tokens; the
-        compiled fn primes the cache from the shared pages first."""
-        shared_blocks = shared // self.page_size
+    def _prefill_chunk_prior(self, width: int, pnb: int, chunk: list) -> None:
+        """Suffix-only admission for radix-cache hits: ids/positions/scatter
+        cover ONLY the unmatched tokens; the compiled fn primes each row's
+        cache from its matched prefix pages (per-row table padded to the
+        ``pnb`` page bucket with scratch page 0, per-row true prior lengths
+        riding as data)."""
+        rows_data = []
+        n_prior = []
+        for slot_idx, req, tok_ids, shared in chunk:
+            rows_data.append(
+                (tok_ids[shared:], req.temperature, self.slots[slot_idx].pages)
+            )
+            n_prior.append(shared)
+        rows = bucket_size(len(chunk), self.ADMIT_BUCKETS)
+        n_prior = np.asarray(n_prior + [0] * (rows - len(chunk)), np.int32)
+        prior_tables = np.zeros((rows, pnb), np.int32)
+        for r, (slot_idx, _req, _t, shared) in enumerate(chunk):
+            sb = shared // self.page_size
+            prior_tables[r, :sb] = self._page_table[slot_idx, :sb]
         ids, lens, temps, scat, positions = self._assemble_prefill(
-            [(tok_ids[shared:], req.temperature, self.slots[slot_idx].pages)
-             for slot_idx, req, tok_ids, _sh in chunk],
-            width, pos_offset=shared,
+            rows_data, width, pos_offset=n_prior[:, None],
         )
-        prefix_table = np.asarray([self._prefix["pages"][:shared_blocks]], np.int32)
-        first, self.pool.k, self.pool.v, self._rng = self._prefix_prefill_scatter(
+        first, self.pool.k, self.pool.v, self._rng = self._prior_prefill_scatter(
             self.params, ids, positions, lens, self._rng, temps, scat,
-            self.pool.k, self.pool.v, prefix_table, n_shared=shared,
+            self.pool.k, self.pool.v, prior_tables, n_prior, do_sample=True,
         )
-        self.prefill_tokens_total += sum(len(t) - shared for _i, _r, t, _s in chunk)
+        self.prefill_tokens_total += sum(len(t) - s for _i, _r, t, s in chunk)
         slot_idxs = [slot_idx for slot_idx, _req, _ids, _sh in chunk]
         for slot_idx in slot_idxs:
             self.slots[slot_idx].pending_first = True
         self._pending_first.append((first, slot_idxs))
+        for slot_idx, _req, tok_ids, shared in chunk:
+            self._radix_insert(slot_idx, tok_ids, shared)
 
     def _advance_prefill(self) -> None:
         """Dispatch ONE chunked-prefill segment per tick (bounding how much
@@ -1266,21 +1352,31 @@ class ContinuousBatchingEngine:
             pb = prior // self.page_size
             nb = (len(seg) + self.page_size - 1) // self.page_size
             seg_pages = self._page_table[i, pb : pb + nb].tolist()
+            n_prior = np.asarray([prior], np.int32)
             ids, lens, temps, scat, positions = self._assemble_prefill(
-                [(seg, slot.temperature, seg_pages)], width, pos_offset=prior,
+                [(seg, slot.temperature, seg_pages)], width,
+                pos_offset=n_prior[:, None],
             )
-            prior_table = self._page_table[i : i + 1, :pb].copy()
+            # prior-table width buckets to a power-of-two page count (padded
+            # with scratch page 0) so an 8K prompt compiles O(log window)
+            # segment variants, not one per (prior, width) pair
+            pnb = self._prior_bucket(pb)
+            prior_table = np.zeros((1, pnb), np.int32)
+            prior_table[0, :pb] = self._page_table[i, :pb]
             first, self.pool.k, self.pool.v, self._rng = \
-                self._segment_prefill_scatter(
+                self._prior_prefill_scatter(
                     self.params, ids, positions, lens, self._rng, temps,
                     scat, self.pool.k, self.pool.v, prior_table,
-                    n_prior=prior, do_sample=is_last,
+                    n_prior, do_sample=is_last,
                 )
             self.prefill_tokens_total += len(seg)
             if is_last:
                 slot.prefill_todo = None
                 slot.pending_first = True
                 self._pending_first.append((first, [i]))
+                # the final segment completes the prompt's KV — its
+                # full-page span can now enter the radix cache
+                self._radix_insert(i, slot.prompt_ids, slot.shared_tokens)
             else:
                 slot.prefill_todo = slot.prefill_todo[chunk:]
                 slot.prefill_done += len(seg)
@@ -1510,7 +1606,8 @@ class ContinuousBatchingEngine:
             self.ttft_count += 1
 
     def _retire(self, i: int, reason: str) -> PagedResult:
-        """Free a slot's pages and zero its device-mirror row."""
+        """Free a slot's pages (minus any donated to the radix cache), drop
+        its prefix pins, and zero its device-mirror row."""
         slot = self.slots[i]
         result = PagedResult(
             request_id=slot.request_id,
@@ -1518,8 +1615,19 @@ class ContinuousBatchingEngine:
             tokens=list(slot.emitted),
             prompt_tokens=slot.prompt_tokens,
             finish_reason=reason,
+            prefill_tokens=slot.prompt_tokens - slot.shared_tokens,
+            prefix_hit_tokens=slot.shared_tokens,
         )
-        self.allocator.free(slot.pages)
+        if slot.donated:
+            donated = set(slot.donated)
+            self.allocator.free([p for p in slot.pages if p not in donated])
+        else:
+            self.allocator.free(slot.pages)
+        if self._radix is not None:
+            self._radix.unlock(slot.prefix_node)
+        slot.prefix_node = None
+        slot.prompt_ids = None
+        slot.donated = []
         slot.active = False
         slot.pending_first = False
         slot.inflight_steps = 0
@@ -1549,9 +1657,16 @@ class ContinuousBatchingEngine:
             "prefill_tokens": self.prefill_tokens_total,
             "decode_tokens": self.decode_tokens_total,
         }
-        if self._prefix is not None or self.prefix_hits or self.prefix_misses:
+        if self._radix is not None:
+            hit, miss = self.prefix_hit_tokens_total, self.prefix_miss_tokens_total
             out["prefix_hits"] = self.prefix_hits
             out["prefix_misses"] = self.prefix_misses
+            out["prefix_hit_tokens"] = hit
+            out["prefix_miss_tokens"] = miss
+            if hit + miss:
+                out["prefix_hit_token_ratio"] = round(hit / (hit + miss), 4)
+            out["prefix_cache_pages"] = self._radix.pages_held
+            out["prefix_cache_nodes"] = self._radix.node_count
         if self.ttft_samples:
             s = sorted(self.ttft_samples)
             out["ttft_p50_ms"] = round(s[len(s) // 2] * 1e3, 2)

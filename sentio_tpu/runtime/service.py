@@ -259,7 +259,8 @@ class PagedGenerationService:
         last_prefill = self.engine.prefill_tokens_total
         last_decode = self.engine.decode_tokens_total
         last_spec = self.engine.spec_emitted_total
-        last_prefix = self.engine.prefix_hits
+        last_hit_toks = self.engine.prefix_hit_tokens_total
+        last_miss_toks = self.engine.prefix_miss_tokens_total
         while True:
             with self._mutex:
                 for ticket in self._inbox:
@@ -339,6 +340,7 @@ class PagedGenerationService:
                 queued = len(engine._queue)
                 inbox = len(self._inbox)
                 free = engine.allocator.free_pages
+                radix = getattr(engine, "_radix", None)
                 recorder.record_tick(
                     dur_ms=round(tick_dur_s * 1e3, 3),
                     active_slots=int(active),
@@ -347,14 +349,23 @@ class PagedGenerationService:
                     prefill_tokens=engine.prefill_tokens_total - last_prefill,
                     decode_tokens=engine.decode_tokens_total - last_decode,
                     spec_accepted=engine.spec_emitted_total - last_spec,
-                    prefix_hits=engine.prefix_hits - last_prefix,
+                    # prompt tokens this tick served read-only from the radix
+                    # prefix cache vs actually forwarded, plus the cache's
+                    # page occupancy — the per-tick evidence of prefill
+                    # skipped (replaces the old boolean hit/miss counts)
+                    prefix_hit_tokens=(
+                        engine.prefix_hit_tokens_total - last_hit_toks),
+                    prefix_miss_tokens=(
+                        engine.prefix_miss_tokens_total - last_miss_toks),
+                    prefix_cache_pages=(radix.pages_held if radix else 0),
                     free_pages=free,
                     used_pages=engine.allocator.num_pages - 1 - free,
                 )
                 last_prefill = engine.prefill_tokens_total
                 last_decode = engine.decode_tokens_total
                 last_spec = engine.spec_emitted_total
-                last_prefix = engine.prefix_hits
+                last_hit_toks = engine.prefix_hit_tokens_total
+                last_miss_toks = engine.prefix_miss_tokens_total
                 metrics.record_tick(tick_dur_s, int(active), queued + inbox)
             except Exception:  # noqa: BLE001
                 logger.debug("tick telemetry failed", exc_info=True)
@@ -425,6 +436,8 @@ class PagedGenerationService:
                              if tpot_s is not None else None),
                     tokens=n,
                     prompt_tokens=result.prompt_tokens,
+                    prefill_tokens=result.prefill_tokens,
+                    prefix_hit_tokens=result.prefix_hit_tokens,
                     finish_reason=result.finish_reason,
                 )
         except Exception:  # noqa: BLE001

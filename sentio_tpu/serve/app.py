@@ -522,6 +522,16 @@ def _speculative_info(container: DependencyContainer) -> dict:
         elif gen.prefill_chunk:
             reason = ("PREFILL_CHUNK set (chunked prefill excludes paged "
                       "speculation)")
+    else:
+        # contiguous path (USE_PAGED_KV=0): the SpeculativeDecoder is built
+        # only for a single-chip in-process engine — mirror that gating
+        # (serve/dependencies.py speculative property) so /info never
+        # reports active=true for a decoder that was never constructed
+        if container.mesh is not None:
+            reason = ("device mesh configured (contiguous speculation is "
+                      "single-chip)")
+        elif container.engine is None:
+            reason = "no in-process engine (contiguous speculation needs one)"
     out["active"] = not reason
     if reason:
         out["ignored_reason"] = reason
@@ -576,11 +586,16 @@ def _publish_serving_gauges(container: DependencyContainer):
         "active_slots", "queued", "queued_inbox", "free_pages",
         "avg_active_slots", "max_active_slots",
         "ttft_p50_ms", "ttft_p95_ms", "spec_tokens_per_verify",
+        # radix prefix cache: fraction of prompt tokens served read-only
+        # from cached KV, and the pages the cache currently holds — the
+        # two numbers that say whether prefix caching is paying for itself
+        "prefix_hit_token_ratio", "prefix_cache_pages", "prefix_cache_nodes",
     ):
         if key in stats:
             m.set_serving_stat(key, float(stats[key]))
     for event in ("ticks", "completed", "ttft_count",
                   "prefix_hits", "prefix_misses",
+                  "prefix_hit_tokens", "prefix_miss_tokens",
                   # raw counters so Prometheus can compute a WINDOWED
                   # tokens-per-verify (the lifetime-average gauge above
                   # flattens draft-quality regressions on long uptimes)

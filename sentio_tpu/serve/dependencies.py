@@ -292,23 +292,25 @@ class DependencyContainer:
                 draft_params=draft_params,
                 draft_config=draft_cfg,
                 spec_k=cfg.speculative_k,
+                prefix_cache=cfg.prefix_cache,
                 mesh=self.mesh,  # pool kv-heads shard over tp with the weights
             )
             if cfg.prefix_cache:
-                # every /chat prompt starts with the same rendered template
-                # head (instruction + section header) — prefill its KV once
-                # and let all matching requests reference it read-only
+                # the radix cache learns shared heads automatically from
+                # traffic; warming the rendered template head (instruction +
+                # section header) just spares the FIRST /chat its cold
+                # prefill of that span
                 from sentio_tpu.ops.prompts import PromptBuilder
 
                 prompts = PromptBuilder()
                 head = prompts.static_head(
                     "retrieve", instruction=prompts.load("profile")
                 )
-                shared = paged.register_prefix(head) if head else 0
+                shared = paged.warm_prefix(head) if head else 0
                 if shared:
                     logger.info(
-                        "prefix cache: %d shared tokens across /chat prompts",
-                        shared,
+                        "prefix cache warmed: %d tokens of the /chat "
+                        "template head", shared,
                     )
             return PagedGenerationService(paged)
 

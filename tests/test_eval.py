@@ -82,6 +82,58 @@ class TestOpenAIProvider:
         usage = provider.last_usage
         assert usage["prompt_tokens"] >= 1 and usage["completion_tokens"] >= 1
 
+    def test_switch_base_concurrent_threads_no_flap_no_leak(
+        self, mock_server, monkeypatch
+    ):
+        """Racing 404 fallbacks from concurrent worker threads must
+        converge on ONE base-URL switch (compare-and-swap under the
+        provider lock), every call must still succeed — including a thread
+        whose 404 landed on the retired base mid-switch — and every pooled
+        client ever built must reach close()."""
+        import threading
+
+        import httpx
+
+        from sentio_tpu.ops.generator import OpenAIProvider
+
+        created = []
+        real_client = httpx.Client
+
+        class TrackingClient(real_client):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                created.append(self)
+
+        monkeypatch.setattr(httpx, "Client", TrackingClient)
+        provider = OpenAIProvider(base_url=mock_server.base_url + "/api/v1")
+        n = 8
+        start = threading.Barrier(n)
+        errors = []
+
+        def worker(i):
+            try:
+                start.wait(timeout=10)
+                out = provider.chat(f"[1] Source: a.md\nquestion {i}?",
+                                    max_new_tokens=4, temperature=0.0)
+                assert out
+            except Exception as exc:  # noqa: BLE001 — collected for assert
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        # converged on the stripped base, no flapping back
+        assert provider.base_url == mock_server.base_url + "/v1"
+        assert provider.chat("settled?", max_new_tokens=4, temperature=0.0)
+        provider.close()
+        assert getattr(provider, "_client_cached", None) is None
+        assert getattr(provider, "_retired_clients", []) == []
+        # nothing leaked: every client ever constructed was closed
+        assert created and all(c.is_closed for c in created)
+
 
 
 class TestEvalDataset:

@@ -210,11 +210,36 @@ class TestChunkedPrefill:
         plain = build()
         [want] = plain.run_all([prompt], max_new_tokens=8)
         both = build(prefill_chunk=512)
-        assert both.register_prefix(header) > 0
+        assert both.warm_prefix(header) > 0
         [got] = both.run_all([prompt], max_new_tokens=8)
         assert got.tokens == want.tokens
         assert both.prefix_hits == 1
 
+
+    def test_segment_compile_variants_bounded(self):
+        """Prior-table widths bucket to powers of two, so a long prompt's
+        segment prefills compile O(log window) XLA variants — not one fresh
+        program per (prior, width) pair, which at 8K/PREFILL_CHUNK=1024
+        meant O(window/chunk) compiles stalling the serving thread."""
+        cfg = long_cfg(max_len=4096)
+        eng = ContinuousBatchingEngine(
+            model_config=cfg, max_slots=2, page_size=32,
+            max_pages_per_seq=64, num_pages=1 + 70, ignore_eos=True,
+            prefill_chunk=128,
+        )
+        [res] = eng.run_all([make_prompt(1980)], max_new_tokens=4)
+        assert len(res.tokens) == 4
+        n_segments = -(-res.prompt_tokens // 128)
+        assert n_segments >= 15
+        # distinct traces of the shared prior-prefill program: one per
+        # (suffix-width bucket, pow2 prior-page bucket, do_sample) combo —
+        # {0,4,8,16,32,64} priors x final-segment sampling, NOT one per
+        # segment
+        n_variants = eng._prior_prefill_scatter._cache_size()
+        assert n_variants <= 8, (
+            f"{n_variants} compile variants for {n_segments} segments — "
+            "prior bucketing is not bounding recompilation"
+        )
 
     def test_chunked_prefill_int8_kv(self):
         """Chunking composes with int8 KV pages: segment K's prior primes

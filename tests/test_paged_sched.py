@@ -31,12 +31,15 @@ SMALL = "hi there"  # ~9 tokens -> 1 page
 class TestSkipAhead:
     def test_small_request_jumps_blocked_head(self):
         # 12 usable pages; A takes 8, leaving 4 — B (needs 8) blocks at the
-        # head while C (1 page) must still admit into the idle slot
+        # head while C (1 page) must still admit into the idle slot. B's
+        # prompt is DISTINCT from A's: an identical prompt would match A's
+        # radix-cached span and rightly admit suffix-only instead of
+        # blocking (A pins its span, so eviction can't help B either)
         eng = make_engine(num_pages=13)
         eng.submit(BIG, max_new_tokens=24)
         eng.step()
         assert sum(s.active for s in eng.slots) == 1
-        rid_b = eng.submit(BIG, max_new_tokens=24)
+        rid_b = eng.submit("z" * 100, max_new_tokens=24)
         # max_new > one tick's sub-steps so C is still live when we assert
         eng.submit(SMALL, max_new_tokens=24)
         eng.step()
@@ -67,7 +70,9 @@ class TestSkipAhead:
         eng = make_engine(num_pages=13)
         eng.submit(BIG, max_new_tokens=24)
         eng.step()
-        eng.submit(BIG, max_new_tokens=24)
+        # distinct big prompt: must NOT match A's cached span (see above);
+        # once A retires, its unpinned cached pages evict to admit B
+        eng.submit("z" * 100, max_new_tokens=24)
         eng.submit(SMALL, max_new_tokens=2)
         eng.step()
         assert eng._head_skips == 1
@@ -118,23 +123,37 @@ class TestPrefixTelemetryAndGuard:
 
     def test_hit_and_miss_counters(self):
         eng = make_engine(num_pages=33)
-        assert eng.register_prefix(self.HEADER) > 0
+        assert eng.warm_prefix(self.HEADER) > 0
         eng.run_all([self.HEADER + "question one?", "unrelated prompt"],
                     max_new_tokens=2)
         stats = eng.stats()
         assert stats["prefix_hits"] == 1
         assert stats["prefix_misses"] == 1
+        assert stats["prefix_hit_tokens"] > 0
+        assert stats["prefix_hit_token_ratio"] > 0.0
 
-    def test_register_while_active_raises(self):
+    def test_warm_while_active_is_safe(self):
+        # unlike the old register_prefix, warming the radix cache never
+        # frees pages a live table references — legal while slots decode
         eng = make_engine(num_pages=33)
         eng.submit(SMALL, max_new_tokens=32)
         eng.step()
         assert any(s.active for s in eng.slots)
-        with pytest.raises(RuntimeError, match="slots are active"):
-            eng.register_prefix(self.HEADER)
-        while eng.has_work:  # drain; registration is legal again
+        assert eng.warm_prefix(self.HEADER) > 0
+        while eng.has_work:
             eng.step()
-        assert eng.register_prefix(self.HEADER) > 0
+        # second warm of the same text is an idempotent no-op
+        pages_before = eng._radix.pages_held
+        assert eng.warm_prefix(self.HEADER) > 0
+        assert eng._radix.pages_held == pages_before
+
+    def test_cache_disabled_has_no_radix(self):
+        eng = make_engine(num_pages=33, prefix_cache=False)
+        assert eng.warm_prefix(self.HEADER) == 0
+        eng.run_all([self.HEADER + "question one?"], max_new_tokens=2)
+        stats = eng.stats()
+        assert "prefix_hit_tokens" not in stats
+        assert eng._radix is None
 
 
 class TestSustainedLoadOccupancy:

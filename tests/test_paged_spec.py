@@ -113,7 +113,7 @@ class TestCompositions:
         want = make(cfg, params, ignore_eos=True).run_all(prompts, max_new_tokens=16)
         spec = make(cfg, params, ignore_eos=True, draft_params=dparams,
                     draft_config=dcfg, spec_k=4)
-        assert spec.register_prefix(header) > 0
+        assert spec.warm_prefix(header) > 0
         got = spec.run_all(prompts, max_new_tokens=16)
         assert [w.tokens for w in want] == [g.tokens for g in got]
         assert spec.prefix_hits == 2
@@ -136,6 +136,22 @@ class TestCompositions:
         for w, g in zip(want, got):
             assert len(g.tokens) == 16
             assert g.tokens[0] == w.tokens[0]
+
+    def test_long_prompt_bucket_exceeding_draft_window(self, stack):
+        """Draft-cache overrun regression: with max_pages_per_seq=6 the
+        per-row window is 96 tokens, and a ~70-token prompt buckets its
+        prefill width to 128 — before the clamp, draft prefill's
+        ``.at[:, rows_idx, :width].set`` overhung the 96-wide draft cache
+        axis and failed at trace time, killing the tick thread."""
+        cfg, params, dcfg, dparams = stack
+        prompt = "overrun " * 9  # 72 bytes + BOS → width bucket 128 > 96
+        want = make(cfg, params, ignore_eos=True, max_pages_per_seq=6) \
+            .run_all([prompt], max_new_tokens=4)
+        eng = make(cfg, params, ignore_eos=True, max_pages_per_seq=6,
+                   draft_params=dparams, draft_config=dcfg, spec_k=4)
+        got = eng.run_all([prompt], max_new_tokens=4)
+        assert [w.tokens for w in want] == [g.tokens for g in got]
+        assert got[0].finish_reason in ("stop", "length")
 
     def test_sampled_and_mixed_batch_complete(self, stack):
         """Sampled rows (rejection sampling) and greedy rows serve in the

@@ -1,129 +1,435 @@
-"""Shared-prefix KV caching (runtime/paged.py register_prefix): matching
-requests reuse the prefix pages read-only and prefill only their suffix;
-generation must match the no-prefix engine."""
+"""Radix prefix cache (runtime/radix.py + runtime/paged.py): automatic
+multi-prefix KV reuse. Admission longest-prefix-matches every prompt against
+a token-id radix tree over page-aligned KV page runs, reuses matched pages
+read-only, prefills only the unmatched suffix, and inserts the new span back
+— no registration step. Pins: match/insert/split mechanics, refcount
+pinning vs LRU eviction, token-exact serving vs cold prefill, the
+second-request prefill reduction, cross-node generate→verify reuse, and
+PREFIX_CACHE=0 parity."""
 
-import numpy as np
+from dataclasses import replace
+
 import pytest
 
 from sentio_tpu.models.llama import LlamaConfig
-from sentio_tpu.runtime.paged import ContinuousBatchingEngine
-
-pytestmark = pytest.mark.slow
-
+from sentio_tpu.runtime.paged import ContinuousBatchingEngine, PageAllocator
+from sentio_tpu.runtime.radix import RadixPrefixCache
 
 HEADER = "You are a careful assistant. Cite sources. Answer concisely. "
 
 
 def make_engine(**kw):
-    return ContinuousBatchingEngine(
-        model_config=LlamaConfig.tiny(), max_slots=4, page_size=16,
-        max_pages_per_seq=8, steps_per_tick=4, ignore_eos=True, **kw,
-    )
+    kw.setdefault("model_config", LlamaConfig.tiny())
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("page_size", 16)
+    kw.setdefault("max_pages_per_seq", 8)
+    kw.setdefault("steps_per_tick", 4)
+    kw.setdefault("ignore_eos", True)
+    return ContinuousBatchingEngine(**kw)
 
 
-class TestRegistration:
-    def test_register_returns_page_aligned_count(self):
-        eng = make_engine()
-        n = eng.register_prefix(HEADER)
-        assert n > 0 and n % eng.page_size == 0
-        # ByteTokenizer ~1 token/char (+BOS)
-        assert n <= len(HEADER) + 1
-
-    def test_short_prefix_not_cached(self):
-        eng = make_engine()
-        assert eng.register_prefix("hi") == 0
-        assert eng._prefix is None
-
-    def test_reregister_frees_old_pages(self):
-        eng = make_engine()
-        base = eng.allocator.free_pages
-        eng.register_prefix(HEADER)
-        held = base - eng.allocator.free_pages
-        assert held > 0
-        eng.register_prefix(HEADER + "Extra instruction text here, longer. ")
-        held2 = base - eng.allocator.free_pages
-        assert held2 >= held  # old pages freed, new ones allocated
-
-    def test_short_reregistration_frees_old_pages(self):
-        # a too-short re-registration must still release the old prefix
-        eng = make_engine()
-        base = eng.allocator.free_pages
-        eng.register_prefix(HEADER)
-        assert eng.allocator.free_pages < base
-        assert eng.register_prefix("hi") == 0
-        assert eng.allocator.free_pages == base  # nothing leaked
+# ---------------------------------------------------------------- tree unit
+# Pure-host radix tree mechanics against a real PageAllocator — no device
+# work, so these run in tier-1 (not slow-marked).
 
 
+def toks(*pages):
+    """Flatten page-sized token groups into one list."""
+    out = []
+    for p in pages:
+        out.extend(p)
+    return out
+
+
+PG = 4  # unit-test page size
+
+
+def make_tree(num_pages=64):
+    alloc = PageAllocator(num_pages)
+    return RadixPrefixCache(PG, alloc), alloc
+
+
+class TestRadixTree:
+    def test_insert_then_full_match(self):
+        tree, alloc = make_tree()
+        span = list(range(8))  # 2 pages
+        pages = alloc.alloc(2)
+        node, donated = tree.insert(span, 0, pages)
+        assert donated == pages and node is not None
+        n, got, deepest = tree.match(span)
+        assert n == 8 and got == pages and deepest is node
+
+    def test_partial_match_is_page_aligned(self):
+        tree, alloc = make_tree()
+        pages = alloc.alloc(2)
+        tree.insert(toks([1, 2, 3, 4], [5, 6, 7, 8]), 0, pages)
+        # diverges inside the second page: only the first page matches
+        n, got, node = tree.match(toks([1, 2, 3, 4], [5, 6, 99, 99]))
+        assert n == 4 and got == pages[:1] and node is not None
+        # diverges inside the first page: nothing matches
+        n, got, node = tree.match([1, 2, 99, 99])
+        assert n == 0 and got == [] and node is None
+
+    def test_divergent_insert_splits_edge(self):
+        tree, alloc = make_tree()
+        a_pages = alloc.alloc(3)
+        a = toks([1] * PG, [2] * PG, [3] * PG)
+        tree.insert(a, 0, a_pages)
+        # b shares page 1, then diverges — the 3-page edge must split
+        b = toks([1] * PG, [9] * PG)
+        b_pages = alloc.alloc(2)
+        _, donated = tree.insert(b, 0, b_pages)
+        # only b's second page is new; its first page span was already cached
+        assert donated == b_pages[1:]
+        assert tree.node_count == 3  # split upper + lower + b's tail
+        n, got, _ = tree.match(a)
+        assert n == 12 and got == a_pages
+        n, got, _ = tree.match(b)
+        assert n == 8 and got == [a_pages[0], b_pages[1]]
+
+    def test_match_ignores_trailing_partial_page(self):
+        tree, alloc = make_tree()
+        pages = alloc.alloc(1)
+        tree.insert([1, 2, 3, 4], 0, pages)
+        n, got, _ = tree.match([1, 2, 3, 4, 5, 6])  # 1.5 pages of query
+        assert n == 4 and got == pages
+
+    def test_pin_blocks_eviction_refcount_invariant(self):
+        tree, alloc = make_tree()
+        pages = alloc.alloc(2)
+        node, _ = tree.insert(toks([1] * PG, [2] * PG), 0, pages)
+        tree.lock(node)
+        assert tree.evict(10) == 0  # pinned chain: nothing to free
+        assert tree.pages_held == 2
+        tree.unlock(node)
+        assert tree.evict(10) == 2  # unpinned: fully reclaimed
+        assert tree.pages_held == 0
+        assert alloc.free_pages == alloc.num_pages - 1
+
+    def test_partial_pin_evicts_only_unpinned_tail(self):
+        tree, alloc = make_tree()
+        a_pages = alloc.alloc(1)
+        upper, _ = tree.insert([1] * PG, 0, a_pages)
+        b_pages = alloc.alloc(1)
+        deep, _ = tree.insert(toks([1] * PG, [2] * PG), PG, b_pages)
+        tree.lock(upper)  # pin only the head page's chain
+        assert tree.evict(10) == 1  # the deep tail is unpinned
+        n, got, _ = tree.match(toks([1] * PG, [2] * PG))
+        assert n == PG and got == a_pages  # head survived
+        tree.unlock(upper)
+
+    def test_lru_eviction_order(self):
+        tree, alloc = make_tree()
+        old_pages = alloc.alloc(1)
+        tree.insert([1] * PG, 0, old_pages)
+        new_pages = alloc.alloc(1)
+        tree.insert([2] * PG, 0, new_pages)
+        tree.match([1] * PG)  # refresh the older leaf
+        assert tree.evict(1) == 1
+        # the untouched leaf ([2]*PG) went first
+        n, _, _ = tree.match([2] * PG)
+        assert n == 0
+        n, _, _ = tree.match([1] * PG)
+        assert n == PG
+
+    def test_refcount_underflow_asserts(self):
+        tree, alloc = make_tree()
+        node, _ = tree.insert([1] * PG, 0, alloc.alloc(1))
+        with pytest.raises(AssertionError, match="underflow"):
+            tree.unlock(node)
+
+    def test_duplicate_insert_donates_nothing(self):
+        tree, alloc = make_tree()
+        span = toks([1] * PG, [2] * PG)
+        first = alloc.alloc(2)
+        tree.insert(span, 0, first)
+        second = alloc.alloc(2)
+        node, donated = tree.insert(span, 0, second)
+        assert donated == []  # caller keeps ownership; tree kept `first`
+        assert tree.pages_held == 2
+        _, got, _ = tree.match(span)
+        assert got == first
+
+    def test_split_preserves_chain_refcounts(self):
+        tree, alloc = make_tree()
+        pages = alloc.alloc(2)
+        node, _ = tree.insert(toks([1] * PG, [2] * PG), 0, pages)
+        tree.lock(node)
+        # a divergent insert splits the pinned edge after page 1
+        tree.insert(toks([1] * PG, [7] * PG), 0, alloc.alloc(2))
+        assert tree.evict(10) <= 1  # pinned pages still unreclaimable
+        _, got, _ = tree.match(toks([1] * PG, [2] * PG))
+        assert got == pages  # the pinned span is intact
+        tree.unlock(node)  # symmetric through the split chain — no assert
+
+    def test_clear_returns_all_pages(self):
+        tree, alloc = make_tree()
+        base = alloc.free_pages
+        tree.insert(toks([1] * PG, [2] * PG), 0, alloc.alloc(2))
+        tree.insert([3] * PG, 0, alloc.alloc(1))
+        tree.clear()
+        assert alloc.free_pages == base
+        assert tree.empty and tree.pages_held == 0
+
+
+# ------------------------------------------------------------- engine (jax)
+
+pytestmark_engine = pytest.mark.slow
+
+
+@pytest.mark.slow
 class TestPrefixServing:
-    def test_matches_no_prefix_engine(self):
+    def test_warm_second_request_matches_cold(self):
         prompts = [
             HEADER + "What is a systolic array?",
             HEADER + "Explain BM25 briefly.",
         ]
-        plain = make_engine().run_all(prompts, max_new_tokens=8, temperature=0.0)
-
+        cold = make_engine(prefix_cache=False).run_all(
+            prompts, max_new_tokens=8, temperature=0.0)
         eng = make_engine()
-        n = eng.register_prefix(HEADER)
-        assert n > 0
-        cached = eng.run_all(prompts, max_new_tokens=8, temperature=0.0)
+        # sequential runs so the second request matches the first's span
+        warm = [eng.run_all([p], max_new_tokens=8, temperature=0.0)[0]
+                for p in prompts]
+        assert [r.tokens for r in warm] == [r.tokens for r in cold]
+        assert [r.prompt_tokens for r in warm] == [r.prompt_tokens for r in cold]
+        # request 1 seeded the cache; request 2 skipped the shared head
+        assert warm[0].prefix_hit_tokens == 0
+        assert warm[1].prefix_hit_tokens > 0
+        assert (warm[1].prefill_tokens + warm[1].prefix_hit_tokens
+                == warm[1].prompt_tokens)
 
-        assert [r.tokens for r in cached] == [r.tokens for r in plain]
-        assert [r.prompt_tokens for r in cached] == [r.prompt_tokens for r in plain]
-
-    def test_prefix_pages_survive_retire_and_are_reused(self):
+    def test_second_request_prefill_reduced_by_shared_length(self):
         eng = make_engine()
-        eng.register_prefix(HEADER)
-        after_register = eng.allocator.free_pages
-        eng.run_all([HEADER + "first question"], max_new_tokens=6, temperature=0.0)
-        # per-request pages freed on retire, prefix pages still held
-        assert eng.allocator.free_pages == after_register
-        # second request reuses the same prefix pages
-        out = eng.run_all([HEADER + "second question"], max_new_tokens=6,
-                          temperature=0.0)
-        assert out[0].finish_reason in ("stop", "length")
-        assert eng.allocator.free_pages == after_register
+        q1 = HEADER + "first question here?"
+        q2 = HEADER + "second question, different tail."
+        [r1] = eng.run_all([q1], max_new_tokens=4, temperature=0.0)
+        before = eng.prefill_tokens_total
+        [r2] = eng.run_all([q2], max_new_tokens=4, temperature=0.0)
+        # the shared span is the page-aligned common token prefix (BOS +
+        # HEADER bytes for the byte tokenizer)
+        expected_shared = ((1 + len(HEADER)) // eng.page_size) * eng.page_size
+        assert r2.prefix_hit_tokens == expected_shared
+        assert r2.prefill_tokens == r2.prompt_tokens - expected_shared
+        # the ENGINE did less admission work, not just the bookkeeping
+        assert eng.prefill_tokens_total - before == r2.prefill_tokens
+        assert eng.stats()["prefix_hit_token_ratio"] > 0.0
 
-    def test_non_matching_prompts_unaffected(self):
-        prompts = ["totally different prompt with no header at all"]
-        plain = make_engine().run_all(prompts, max_new_tokens=8, temperature=0.0)
+    def test_cache_learns_without_warming_across_batch(self):
+        # one run_all with 3 same-head prompts: the first seeds, and any
+        # admitted AFTER its insert reuse the head (same-batch admissions
+        # legitimately miss — the span isn't written yet)
         eng = make_engine()
-        eng.register_prefix(HEADER)
+        prompts = [HEADER + f"question {i}?" for i in range(3)]
+        for p in prompts:
+            eng.run_all([p], max_new_tokens=2, temperature=0.0)
+        assert eng.prefix_hits == 2
+        assert eng.prefix_misses == 0
+
+    def test_non_matching_prompt_unaffected(self):
+        prompts = ["totally different prompt with no shared head at all"]
+        plain = make_engine(prefix_cache=False).run_all(
+            prompts, max_new_tokens=8, temperature=0.0)
+        eng = make_engine()
+        eng.warm_prefix(HEADER)
         got = eng.run_all(prompts, max_new_tokens=8, temperature=0.0)
         assert [r.tokens for r in got] == [r.tokens for r in plain]
+        assert got[0].prefix_hit_tokens == 0
 
-    def test_exact_prefix_only_prompt_takes_normal_path(self):
-        """A prompt whose tokens EQUAL the shared span (no suffix) must use
-        the normal prefill — the suffix path would prefill zero tokens."""
+    def test_exact_prefix_only_prompt_still_prefills_one_token(self):
+        """A prompt whose tokens EQUAL a cached span must clamp the match
+        so at least one suffix token prefills (the first sampled token
+        comes from the last prompt logit)."""
         eng = make_engine()
-        n = eng.register_prefix(HEADER)
-        # reconstruct a prompt that tokenizes to exactly the shared tokens:
-        # ByteTokenizer is byte-level, so n shared tokens = BOS + n-1 bytes
-        prompt_exact = HEADER[: n - 1]
-        toks = eng.tokenizer.encode(prompt_exact, add_bos=True)
-        assert toks == eng._prefix["tokens"]  # the boundary case for real
+        n = eng.warm_prefix(HEADER)
+        assert n > 0
+        prompt_exact = HEADER[: n - 1]  # BOS + n-1 bytes == n cached tokens
         out = eng.run_all([prompt_exact], max_new_tokens=4, temperature=0.0)
-        ref = make_engine().run_all([prompt_exact], max_new_tokens=4,
-                                    temperature=0.0)
+        ref = make_engine(prefix_cache=False).run_all(
+            [prompt_exact], max_new_tokens=4, temperature=0.0)
         assert out[0].tokens == ref[0].tokens
+        assert out[0].prefill_tokens >= 1
 
-    def test_mixed_batch_prefix_and_plain(self):
-        prompts = [
-            HEADER + "cached question",
-            "uncached question entirely",
-        ]
-        plain = make_engine().run_all(prompts, max_new_tokens=6, temperature=0.0)
+    def test_mixed_batch_hit_and_cold(self):
         eng = make_engine()
-        eng.register_prefix(HEADER)
+        eng.warm_prefix(HEADER)
+        prompts = [HEADER + "cached question", "uncached question entirely"]
+        plain = make_engine(prefix_cache=False).run_all(
+            prompts, max_new_tokens=6, temperature=0.0)
         got = eng.run_all(prompts, max_new_tokens=6, temperature=0.0)
         assert [r.tokens for r in got] == [r.tokens for r in plain]
 
-    def test_int8_pool_prefix_cache(self):
-        prompts = [HEADER + "int8 plus prefix cache"]
+    def test_int8_pool_composes(self):
+        prompts = [HEADER + "int8 plus radix cache"]
         eng = make_engine(kv_quant="int8")
-        eng.register_prefix(HEADER)
+        eng.warm_prefix(HEADER)
         got = eng.run_all(prompts, max_new_tokens=6, temperature=0.0)
-        ref = make_engine(kv_quant="int8").run_all(
-            prompts, max_new_tokens=6, temperature=0.0
-        )
+        ref = make_engine(kv_quant="int8", prefix_cache=False).run_all(
+            prompts, max_new_tokens=6, temperature=0.0)
         # int8 priming dequantizes the prefix once; first token must agree
         assert got[0].tokens[0] == ref[0].tokens[0]
+
+    def test_disabled_engine_stats_and_pool_idle(self):
+        eng = make_engine(prefix_cache=False)
+        eng.run_all([HEADER + "q"], max_new_tokens=4, temperature=0.0)
+        s = eng.stats()
+        assert "prefix_cache_pages" not in s
+        # no cache: every page returns to the pool at retire
+        assert s["free_pages"] == s["total_pages"] - 1
+
+
+@pytest.mark.slow
+class TestPagePoolSafety:
+    def live_pages(self, eng):
+        out = set()
+        for i, slot in enumerate(eng.slots):
+            if slot.active:
+                blocks = (slot.shared_tokens // eng.page_size) + len(slot.pages)
+                out.update(int(p) for p in eng._page_table[i, :blocks] if p)
+        return out
+
+    def radix_pages(self, eng):
+        out = set()
+        stack = list(eng._radix.root.children.values())
+        while stack:
+            node = stack.pop()
+            out.update(node.pages)
+            stack.extend(node.children.values())
+        return out
+
+    def test_refcount_invariant_under_load(self):
+        """Across a staggered multi-request run: the allocator free list,
+        live slot tables, and radix-held pages never overlap — eviction can
+        never free a page a live page table references."""
+        eng = make_engine(num_pages=1 + 24, max_slots=3)
+        prompts = [HEADER + f"safety question {i}?" for i in range(6)]
+        for p in prompts:
+            eng.submit(p, max_new_tokens=4)
+        while eng.has_work:
+            eng.step()
+            free = set(eng.allocator._free)
+            live = self.live_pages(eng)
+            held = self.radix_pages(eng)
+            assert not free & live, "freed page still in a live page table"
+            assert not free & held, "freed page still owned by the cache"
+        # idle: everything is either free or retained by the cache
+        s = eng.stats()
+        assert s["free_pages"] + s["prefix_cache_pages"] == s["total_pages"] - 1
+
+    def test_eviction_under_pool_exhaustion(self):
+        """Distinct prompts overflow a small pool: LRU leaves must be
+        evicted to admit new work, and serving must stay correct."""
+        # each ~43-token prompt needs 3 pages at admission and donates 2
+        # full pages to the cache, so a 10-page pool hits pressure by the
+        # fifth admission (held 8, free 2, need 3)
+        eng = make_engine(num_pages=1 + 10, max_slots=2, max_pages_per_seq=6)
+        for i in range(6):
+            [r] = eng.run_all([f"prompt number {i} with its own distinct text"],
+                              max_new_tokens=4, temperature=0.0)
+            assert r.finish_reason in ("stop", "length")
+        assert eng._radix.evicted_pages > 0
+        s = eng.stats()
+        assert s["free_pages"] + s["prefix_cache_pages"] == s["total_pages"] - 1
+
+    def test_pinned_prefix_survives_eviction_pressure(self):
+        """A slot decoding against matched pages pins them: pool pressure
+        from a concurrent admission must evict OTHER leaves, never the
+        pinned chain (and never corrupt the pinned request's output)."""
+        eng = make_engine(num_pages=1 + 16, max_slots=2, max_pages_per_seq=6)
+        ref_eng = make_engine(num_pages=1 + 16, max_slots=2,
+                              max_pages_per_seq=6, prefix_cache=False)
+        [want] = ref_eng.run_all([HEADER + "pinned?"], max_new_tokens=8,
+                                 temperature=0.0)
+        eng.run_all([HEADER + "seed"], max_new_tokens=2, temperature=0.0)
+        rid = eng.submit(HEADER + "pinned?", max_new_tokens=8)
+        eng.step()  # admit: matches + pins the HEADER span
+        # pressure: distinct prompts that need the pool while rid decodes
+        eng.submit("filler alpha with plenty of distinct bytes", max_new_tokens=2)
+        eng.submit("filler beta, also made of different bytes!", max_new_tokens=2)
+        done = {}
+        while eng.has_work:
+            for r in eng.step():
+                done[r.request_id] = r
+        assert done[rid].tokens == want.tokens
+
+
+@pytest.mark.slow
+class TestCrossNodeReuse:
+    def test_generate_then_verify_reuses_prompt_head(self):
+        """The acceptance path: within one /chat-shaped request, the verify
+        prompt embeds the generate prompt verbatim — its admission must be
+        served the whole generate-prompt span from the radix cache, visible
+        per-admission in the flight recorder."""
+        from sentio_tpu.config import GeneratorConfig
+        from sentio_tpu.infra.flight import FlightRecorder, set_flight_recorder
+        from sentio_tpu.models.document import Document
+        from sentio_tpu.ops.generator import LLMGenerator, TpuProvider
+        from sentio_tpu.ops.verifier import AnswerVerifier
+        from sentio_tpu.runtime.service import PagedGenerationService
+
+        recorder = FlightRecorder()
+        set_flight_recorder(recorder)
+        try:
+            cfg = replace(LlamaConfig.tiny(), max_len=2048)
+            eng = make_engine(model_config=cfg, max_slots=2, page_size=32,
+                              max_pages_per_seq=48, num_pages=1 + 120)
+            service = PagedGenerationService(eng)
+            gen_cfg = GeneratorConfig(provider="tpu", max_new_tokens=8,
+                                      verifier_max_tokens=8)
+            generator = LLMGenerator(
+                provider=TpuProvider(service=service), config=gen_cfg)
+            verifier = AnswerVerifier(generator=generator, config=gen_cfg)
+            docs = [Document(text="Systolic arrays pump operands through a "
+                                  "grid of MACs.",
+                             metadata={"source": "notes.md", "score": 0.9})]
+            query = "What is a systolic array?"
+
+            answer = generator.generate(query, docs, temperature=0.0,
+                                        request_id="chat-1")
+            verifier.verify(query, answer, docs, request_id="chat-1")
+
+            record = recorder.get("chat-1")
+            admissions = record["engine"]["admissions"]
+            assert len(admissions) == 2, admissions
+            gen_adm, ver_adm = admissions
+            # the verify admission reused the generate prompt head: its
+            # prefix-hit span covers every full page of the generate prompt
+            assert ver_adm["prefix_hit_tokens"] > 0
+            gen_prompt_tokens = gen_adm["prompt_tokens"]
+            expected = (gen_prompt_tokens // eng.page_size) * eng.page_size
+            assert ver_adm["prefix_hit_tokens"] >= expected
+            assert ver_adm["prefill_tokens"] == (
+                ver_adm["prompt_tokens"] - ver_adm["prefix_hit_tokens"])
+            service.close()
+        finally:
+            set_flight_recorder(None)
+
+    def test_two_warm_chat_requests_second_skips_shared_head(self):
+        """Acceptance: with two same-system-prompt requests through the
+        serving facade, the second request's admitted prefill token count
+        (flight recorder) drops by the shared-prefix length."""
+        from sentio_tpu.infra.flight import FlightRecorder, set_flight_recorder
+        from sentio_tpu.runtime.service import PagedGenerationService
+
+        recorder = FlightRecorder()
+        set_flight_recorder(recorder)
+        try:
+            eng = make_engine(max_slots=2)
+            service = PagedGenerationService(eng)
+            service.generate(HEADER + "warmup question?", max_new_tokens=4,
+                             request_id="warm-1")
+            service.generate(HEADER + "second question!", max_new_tokens=4,
+                             request_id="warm-2")
+            first = recorder.get("warm-1")["engine"]["admissions"][0]
+            second = recorder.get("warm-2")["engine"]["admissions"][0]
+            shared = ((1 + len(HEADER)) // eng.page_size) * eng.page_size
+            assert first["prefix_hit_tokens"] == 0
+            assert first["prefill_tokens"] == first["prompt_tokens"]
+            assert second["prefix_hit_tokens"] == shared
+            assert second["prefill_tokens"] == second["prompt_tokens"] - shared
+            # per-tick telemetry carries the matched-token counts too
+            hit_total = sum(t.get("prefix_hit_tokens", 0)
+                            for t in recorder.timeline())
+            assert hit_total == shared
+            service.close()
+        finally:
+            set_flight_recorder(None)

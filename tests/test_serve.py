@@ -260,6 +260,28 @@ class TestHealthAndInfo:
                         container=DependencyContainer(settings=settings,
                                                       mesh=None)))
 
+    def test_info_speculative_contiguous_mesh_gating(self):
+        """USE_PAGED_KV=0 + a device mesh: the contiguous SpeculativeDecoder
+        is never constructed (dependencies.speculative is single-chip-only),
+        so /info must report active=false with the mesh named as the reason
+        — not a dead knob shown as live."""
+
+        async def body(client, container):
+            # the 8 virtual CPU devices build a real dp mesh by default
+            assert container.mesh is not None
+            data = await (await client.get("/info")).json()
+            spec = data["generator"]["speculative"]
+            assert spec["draft_configured"] is True
+            assert spec["active"] is False
+            assert "mesh" in spec["ignored_reason"]
+
+        settings = fast_settings(generator=GeneratorConfig(
+            provider="tpu", model_preset="tiny", use_verifier=False,
+            draft_checkpoint_path="/nonexistent-draft",
+            use_paged_decode=False,
+        ))
+        run(with_client(settings, body))
+
 
 class TestAuth:
     def test_auth_flow(self):
@@ -337,9 +359,10 @@ class TestPagedServing:
             assert stats["max_active_slots"] >= 2, (
                 f"concurrent chats never shared a decode tick: {stats}"
             )
-            # every per-request page returned to the pool after the burst;
-            # the shared prompt-prefix pages (registered at startup) stay held
-            held = len(service.engine._prefix["pages"]) if service.engine._prefix else 0
+            # every per-request page returned to the pool after the burst —
+            # except what the radix prefix cache retained (warmed template
+            # head + the admitted prompts' full-page spans)
+            held = stats.get("prefix_cache_pages", 0)
             assert stats["free_pages"] == stats["total_pages"] - 1 - held
 
             # the decode-engine stats must be PUBLISHED, not just collected:
