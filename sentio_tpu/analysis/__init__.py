@@ -1,0 +1,74 @@
+"""Static analysis + runtime sanitizers for the serving runtime's contracts.
+
+The engine's correctness surfaces live in comments: ``runtime/service.py``
+declares the engine pump-thread-only, the radix cache depends on refcount
+pinning and page-pool conservation, and every ``jax.jit`` site silently
+promises bounded compile variants. This package makes those contracts
+machine-checked, twice over:
+
+* ``sentio lint`` (:mod:`sentio_tpu.analysis.runner`) — an AST lint over the
+  source tree: retrace hazards at jit sites (:mod:`.retrace`), lock
+  discipline against ``guarded-by`` annotations (:mod:`.locks`), and
+  wall-clock / exception hygiene (:mod:`.hygiene`). Findings gate against a
+  committed baseline (``analysis/baseline.json``) so the check starts green
+  and only ratchets down.
+* ``SENTIO_SANITIZE=1`` (:mod:`sentio_tpu.analysis.sanitizer`) — opt-in
+  runtime checks: engine entry points assert the single-driver-thread
+  contract, annotated locks record ownership so lock-held helpers can
+  assert it, and every engine tick verifies page-pool conservation and
+  radix refcount consistency.
+
+Annotation guide
+================
+
+``# guarded-by: <lock>`` — trailing comment on a ``self.<attr> = ...``
+assignment (conventionally in ``__init__``). Declares that every later
+access of ``self.<attr>`` from methods of that class must sit lexically
+inside a ``with self.<lock>:`` block::
+
+    class Service:
+        def __init__(self):
+            self._mutex = threading.Lock()
+            self._inbox = []  # guarded-by: _mutex
+
+Two escape hatches, both of which the checker treats as "the lock is
+already held here":
+
+* a method whose name ends in ``_locked`` (e.g. ``_evict_locked``);
+* a method whose ``def`` line carries ``# lock-held: <lock>``.
+
+The special lock name ``engine-thread`` marks state owned by a single
+driver thread rather than a mutex (the paged engine, the radix cache).
+The static checker skips ``with``-block validation for those attributes —
+thread identity is not lexical — and the runtime sanitizer enforces the
+contract instead: under ``SENTIO_SANITIZE=1`` every mutating engine entry
+point asserts it runs on the bound driver thread (the serving pump rebinds
+ownership at pump start; see :func:`.sanitizer.bind_engine_owner`).
+
+``# wall-clock: <reason>`` — trailing comment allowing a ``time.time()``
+call that genuinely needs the epoch (persisted timestamps, tokens shared
+across processes, comparisons against external timestamps). Durations and
+TTLs must use ``time.perf_counter()``; an unannotated ``time.time()`` is a
+finding.
+
+``# lint: allow(<rule>)`` — trailing comment suppressing one named rule on
+that line, for deliberate, commented exceptions (e.g. a GIL-atomic
+telemetry read of a guarded field).
+"""
+
+from sentio_tpu.analysis.findings import (
+    Finding,
+    diff_baseline,
+    load_baseline,
+    save_baseline,
+)
+from sentio_tpu.analysis.runner import lint_paths, run_gate
+
+__all__ = [
+    "Finding",
+    "diff_baseline",
+    "load_baseline",
+    "save_baseline",
+    "lint_paths",
+    "run_gate",
+]

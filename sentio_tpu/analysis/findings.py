@@ -1,0 +1,160 @@
+"""Finding model + baseline ratchet for ``sentio lint``.
+
+A finding is keyed for baseline matching by ``(rule, path, context)`` where
+``context`` is the stripped source line — NOT the line number, so findings
+survive unrelated edits above them. The baseline is a committed JSON list;
+the gate fails only on findings absent from the baseline (ratchet: fixing a
+baselined finding makes its entry stale, and ``--update-baseline`` prunes
+it — the file only ever shrinks unless a human deliberately re-records).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from collections import Counter
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Optional
+
+__all__ = [
+    "Finding",
+    "SourceFile",
+    "load_baseline",
+    "save_baseline",
+    "diff_baseline",
+]
+
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([\w,\s-]+)\)")
+_WALL_CLOCK_RE = re.compile(r"#\s*wall-clock\b")
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([\w.-]+)")
+_LOCK_HELD_RE = re.compile(r"#\s*lock-held:\s*([\w.-]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint finding. ``context`` is the stripped source line at
+    ``line`` — the stable half of the baseline key."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+    context: str = ""
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule, self.path, self.context)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "context": self.context,
+            "message": self.message,
+        }
+
+
+@dataclass
+class SourceFile:
+    """Parsed view of one file shared by every rule: source text, physical
+    lines, and the per-line annotation maps (allow / wall-clock /
+    guarded-by / lock-held)."""
+
+    path: Path
+    rel: str
+    text: str
+    lines: list[str] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if not self.lines:
+            self.lines = self.text.splitlines()
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def allows(self, lineno: int, rule: str) -> bool:
+        m = _ALLOW_RE.search(self.line_text(lineno))
+        if not m:
+            return False
+        allowed = {r.strip() for r in m.group(1).split(",")}
+        return rule in allowed
+
+    def wall_clock_ok(self, lineno: int) -> bool:
+        """``# wall-clock:`` on the line or the line above (annotations on
+        multi-line expressions land where the comment physically fits)."""
+        return bool(
+            _WALL_CLOCK_RE.search(self.line_text(lineno))
+            or _WALL_CLOCK_RE.search(self.line_text(lineno - 1))
+        )
+
+    def guarded_by(self, lineno: int) -> Optional[str]:
+        m = _GUARDED_RE.search(self.line_text(lineno))
+        return m.group(1) if m else None
+
+    def lock_held_marker(self, lineno: int) -> Optional[str]:
+        m = _LOCK_HELD_RE.search(self.line_text(lineno))
+        return m.group(1) if m else None
+
+    def finding(self, rule: str, lineno: int, message: str) -> Optional[Finding]:
+        """Build a finding unless an inline allow suppresses it."""
+        if self.allows(lineno, rule):
+            return None
+        return Finding(
+            rule=rule,
+            path=self.rel,
+            line=lineno,
+            message=message,
+            context=self.line_text(lineno).strip(),
+        )
+
+
+# ------------------------------------------------------------------ baseline
+
+
+def load_baseline(path: str | Path) -> list[dict]:
+    p = Path(path)
+    if not p.exists():
+        return []
+    data = json.loads(p.read_text())
+    if not isinstance(data, list):
+        raise ValueError(f"baseline {p} must be a JSON list")
+    return data
+
+
+def save_baseline(path: str | Path, findings: Iterable[Finding]) -> None:
+    entries = sorted(
+        (f.to_json() for f in findings),
+        key=lambda e: (e["path"], e["rule"], e["context"]),
+    )
+    Path(path).write_text(json.dumps(entries, indent=1) + "\n")
+
+
+def diff_baseline(
+    findings: list[Finding], baseline: list[dict]
+) -> tuple[list[Finding], list[Finding], list[dict]]:
+    """→ ``(new, matched, stale)``. Matching is by ``(rule, path, context)``
+    with multiplicity: two identical findings need two baseline entries."""
+    budget: Counter = Counter(
+        (e["rule"], e["path"], e.get("context", "")) for e in baseline
+    )
+    new: list[Finding] = []
+    matched: list[Finding] = []
+    for f in findings:
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+            matched.append(f)
+        else:
+            new.append(f)
+    stale = [
+        {"rule": r, "path": p, "context": c}
+        for (r, p, c), n in budget.items()
+        for _ in range(n)
+        if n > 0
+    ]
+    return new, matched, stale

@@ -1,0 +1,84 @@
+"""Hygiene rules: monotonic-clock discipline + exception-swallow discipline.
+
+``wall-clock-duration``
+    ``time.time()`` is the wrong clock for durations — NTP steps the epoch
+    clock backwards/forwards under a live server, which turns TTFT/TPOT
+    samples, TTLs, and rate-limit windows into garbage exactly when the
+    fleet is being re-synced. Every duration/TTL path must use
+    ``time.perf_counter()``. A ``time.time()`` call is a finding unless the
+    line (or the line above) carries ``# wall-clock: <reason>`` declaring a
+    genuine epoch need (persisted timestamps, tokens crossing processes,
+    comparisons against external timestamps).
+
+``baseexception-swallow``
+    An ``except BaseException:`` / bare ``except:`` handler whose body never
+    ``raise``\\ s swallows ``KeyboardInterrupt`` and ``SystemExit`` — Ctrl-C
+    dies silently inside the handler. Cleanup-and-reraise handlers (body
+    contains any ``raise``) pass; swallowing handlers must narrow to
+    ``except Exception`` or re-raise the exiting exceptions.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from sentio_tpu.analysis.findings import Finding, SourceFile
+
+__all__ = ["check_hygiene"]
+
+RULE_CLOCK = "wall-clock-duration"
+RULE_SWALLOW = "baseexception-swallow"
+
+
+def _is_time_time(node: ast.Call) -> bool:
+    fn = node.func
+    return (
+        isinstance(fn, ast.Attribute)
+        and fn.attr == "time"
+        and isinstance(fn.value, ast.Name)
+        and fn.value.id == "time"
+    )
+
+
+def _catches_baseexception(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except:
+    if isinstance(t, ast.Name) and t.id == "BaseException":
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id == "BaseException" for e in t.elts
+        )
+    return False
+
+
+def _body_reraises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def check_hygiene(tree: ast.Module, src: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_time_time(node):
+            if src.wall_clock_ok(node.lineno):
+                continue
+            f = src.finding(
+                RULE_CLOCK, node.lineno,
+                "time.time() in a duration path — NTP steps corrupt the "
+                "measurement; use time.perf_counter(), or annotate "
+                "`# wall-clock: <reason>` if the epoch is genuinely needed",
+            )
+            if f is not None:
+                findings.append(f)
+        elif isinstance(node, ast.ExceptHandler):
+            if _catches_baseexception(node) and not _body_reraises(node):
+                f = src.finding(
+                    RULE_SWALLOW, node.lineno,
+                    "except BaseException without re-raise swallows "
+                    "KeyboardInterrupt/SystemExit — narrow to Exception or "
+                    "re-raise the exiting exceptions",
+                )
+                if f is not None:
+                    findings.append(f)
+    return findings

@@ -1,0 +1,174 @@
+"""Lock-discipline checker: guarded-by annotations → lockset verification.
+
+Eraser-style (Savage et al., TOSP 1997) guarded-by discipline, checked
+lexically instead of dynamically: an attribute annotated
+``# guarded-by: <lock>`` on its initializing assignment may only be touched
+from methods of its class while ``with self.<lock>:`` is lexically open.
+
+What counts as "the lock is held":
+
+* the access sits inside a ``with self.<lock>:`` (or ``with self.<lock>``
+  among multiple items) block of the same method;
+* the method's name ends in ``_locked`` (call-side contract: caller holds
+  the lock);
+* the method's ``def`` line carries ``# lock-held: <lock>``;
+* the method is ``__init__`` / ``__post_init__`` (construction happens
+  before the object is shared).
+
+Functions *defined* inside a ``with`` block (lambdas, closures) do NOT
+inherit the lock — they run later, on whatever thread calls them; accesses
+inside them are checked as unlocked.
+
+The special lock name ``engine-thread`` declares single-driver-thread
+ownership instead of a mutex. The static checker records but does not
+verify those attributes (thread identity is not lexical); the runtime
+sanitizer (:mod:`.sanitizer`) enforces the contract on engine entry points
+under ``SENTIO_SANITIZE=1``.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from sentio_tpu.analysis.findings import Finding, SourceFile
+
+__all__ = ["check_locks", "collect_guarded", "THREAD_LOCKS"]
+
+RULE_LOCK = "lock-discipline"
+
+# lock "names" that mean thread ownership, not a mutex — skipped statically
+THREAD_LOCKS = {"engine-thread", "pump-thread"}
+
+
+@dataclass
+class GuardedClass:
+    name: str
+    # attr -> lock attribute name (e.g. "_mutex")
+    guarded: dict[str, str] = field(default_factory=dict)
+    thread_owned: set[str] = field(default_factory=set)
+
+
+def collect_guarded(tree: ast.Module, src: SourceFile) -> dict[str, GuardedClass]:
+    """Scan every class for ``self.<attr> = ...  # guarded-by: <lock>``
+    annotations (searched on the assignment's first and last physical line,
+    for multi-line initializers)."""
+    out: dict[str, GuardedClass] = {}
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        gc = GuardedClass(cls.name)
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            attrs = [
+                t.attr for t in targets
+                if isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name) and t.value.id == "self"
+            ]
+            if not attrs:
+                continue
+            lock = src.guarded_by(node.lineno) or src.guarded_by(
+                getattr(node, "end_lineno", node.lineno)
+            )
+            if lock is None:
+                continue
+            for attr in attrs:
+                if lock in THREAD_LOCKS:
+                    gc.thread_owned.add(attr)
+                else:
+                    gc.guarded[attr] = lock
+        if gc.guarded or gc.thread_owned:
+            out[cls.name] = gc
+    return out
+
+
+def _with_locks(node: ast.With) -> set[str]:
+    """Lock attribute names context-managed by this ``with``."""
+    out: set[str] = set()
+    for item in node.items:
+        expr = item.context_expr
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            out.add(expr.attr)
+    return out
+
+
+def _method_held_locks(fn: ast.FunctionDef, src: SourceFile) -> set[str]:
+    """Locks the whole method body may assume held."""
+    held: set[str] = set()
+    if fn.name.endswith("_locked"):
+        held.add("*")  # name convention: caller holds whichever lock applies
+    first_body_line = fn.body[0].lineno if fn.body else fn.lineno
+    for line in range(fn.lineno, first_body_line + 1):
+        marker = src.lock_held_marker(line)
+        if marker:
+            held.add(marker)
+    return held
+
+
+def check_locks(tree: ast.Module, src: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+    classes = collect_guarded(tree, src)
+    if not classes:
+        return findings
+
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef) or cls.name not in classes:
+            continue
+        gc = classes[cls.name]
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name in ("__init__", "__post_init__"):
+                continue
+            _scan(fn, gc, src, findings, fn.name)
+    return findings
+
+
+def _scan(method_fn: ast.FunctionDef, gc: GuardedClass, src: SourceFile,
+          findings: list[Finding], method: str) -> None:
+    """Walk one method body tracking the lexically-open lock set."""
+
+    def check(node: ast.AST, held: set[str]) -> None:
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in gc.guarded):
+            lock = gc.guarded[node.attr]
+            if lock not in held and "*" not in held:
+                f = src.finding(
+                    RULE_LOCK, node.lineno,
+                    f"{gc.name}.{method}: `self.{node.attr}` accessed "
+                    f"without holding `self.{lock}` "
+                    f"(guarded-by: {lock})",
+                )
+                if f is not None:
+                    findings.append(f)
+
+    def visit(node: ast.AST, held: set[str]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            # with-items themselves evaluate under the OUTER lockset
+            for item in node.items:
+                visit(item, held)
+            inner = held | _with_locks(node)
+            for stmt in node.body:
+                visit(stmt, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # closures run later, on whatever thread calls them: they only
+            # hold what their own markers declare
+            nested = _method_held_locks(node, src)
+            for child in ast.iter_child_nodes(node):
+                visit(child, nested)
+            return
+        if isinstance(node, ast.Lambda):
+            visit(node.body, set())
+            return
+        check(node, held)
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    visit(method_fn, set())

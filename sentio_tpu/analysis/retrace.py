@@ -1,0 +1,428 @@
+"""Retrace lint: AST rules against silent XLA recompile blowups.
+
+Every ``jax.jit`` site promises a bounded set of compile variants, and the
+two ways that promise silently breaks are (a) a ``static_argnames`` value
+fed from an unbounded host quantity — each distinct value is a fresh XLA
+program — and (b) host Python control flow / casts on traced values, which
+either fail at trace time or (worse, with weak-type promotion) bake a
+constant and recompile per call. A third hazard is a jitted closure reading
+mutable host state (``self.<attr>``): the trace bakes the value at first
+call and goes stale silently. This module finds all three statically.
+
+Rules
+-----
+
+``retrace-unbounded-static``
+    A call to a jitted function passes a static argument derived from an
+    unbounded host quantity (``len(...)``, raw caller parameters, or
+    arithmetic over them) without routing through a bounding helper
+    (``bucket_size`` / ``floor_bucket`` / ``_prefill_width`` /
+    ``_prior_bucket`` / pow2-``bit_length`` / ``min(x, const)``).
+
+``retrace-traced-branch``
+    ``if`` / ``while`` / ternary / ``assert`` on a traced value inside a
+    jitted function body — concretization at trace time.
+
+``retrace-traced-cast``
+    ``int()`` / ``float()`` / ``bool()`` / ``.item()`` / ``np.asarray()``
+    on a traced value inside a jitted function body.
+
+``retrace-host-state``
+    A jitted function body references ``self.<attr>`` — mutable host state
+    captured by the trace (hoist it to a local before the ``def``, the
+    idiom ``_build_fns`` uses everywhere).
+
+Heuristics are deliberately conservative-quiet: unresolvable names count as
+bounded, ``.shape`` / ``.ndim`` / ``.dtype`` products of traced arrays count
+as static. Residual intentional findings live in the committed baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from sentio_tpu.analysis.findings import Finding, SourceFile
+
+__all__ = ["check_retrace"]
+
+RULE_STATIC = "retrace-unbounded-static"
+RULE_BRANCH = "retrace-traced-branch"
+RULE_CAST = "retrace-traced-cast"
+RULE_HOST = "retrace-host-state"
+
+# helpers that launder an unbounded quantity into a bounded set of values
+BOUNDING_CALLS = {
+    "bucket_size",
+    "floor_bucket",
+    "bit_length",
+    "_prefill_width",
+    "_prior_bucket",
+}
+# attributes of traced arrays that are static under trace
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+# parameter annotations that mark a hashable-config static (not a count)
+CONFIG_ANNOTATIONS = ("Config", "bool", "str", "Mesh", "Callable")
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    """``jax.jit`` / ``jit`` / ``pjit.pjit`` reference."""
+    if isinstance(node, ast.Attribute) and node.attr in ("jit", "pjit"):
+        return True
+    return isinstance(node, ast.Name) and node.id in ("jit", "pjit")
+
+
+def _jit_decorator_statics(dec: ast.AST) -> Optional[tuple[list[str], list[int]]]:
+    """If ``dec`` is a jit decorator → (static_argnames, static_argnums);
+    None otherwise."""
+    if _is_jax_jit(dec):
+        return [], []
+    if isinstance(dec, ast.Call):
+        fn = dec.func
+        is_partial = (
+            isinstance(fn, ast.Name) and fn.id == "partial"
+        ) or (isinstance(fn, ast.Attribute) and fn.attr == "partial")
+        if is_partial and dec.args and _is_jax_jit(dec.args[0]):
+            return _extract_statics(dec.keywords)
+        if _is_jax_jit(fn):  # @jax.jit(static_argnames=...) direct form
+            return _extract_statics(dec.keywords)
+    return None
+
+
+def _extract_statics(keywords: list[ast.keyword]) -> tuple[list[str], list[int]]:
+    names: list[str] = []
+    nums: list[int] = []
+    for kw in keywords:
+        if kw.arg == "static_argnames":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    names.append(c.value)
+        elif kw.arg in ("static_argnums", "donate_argnums"):
+            if kw.arg == "static_argnums":
+                for c in ast.walk(kw.value):
+                    if isinstance(c, ast.Constant) and isinstance(c.value, int):
+                        nums.append(c.value)
+    return names, nums
+
+
+def _param_names(fn: ast.FunctionDef) -> list[str]:
+    args = fn.args
+    return [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+
+
+def _annotation_is_config(fn: ast.FunctionDef, name: str) -> bool:
+    for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+        if a.arg == name and a.annotation is not None:
+            try:
+                text = ast.unparse(a.annotation)
+            except Exception:  # pragma: no cover - unparse is total on 3.9+
+                return False
+            return any(tok in text for tok in CONFIG_ANNOTATIONS)
+    return False
+
+
+class _JittedDef:
+    def __init__(self, fn: ast.FunctionDef, static_names: list[str],
+                 static_nums: list[int]) -> None:
+        self.fn = fn
+        params = _param_names(fn)
+        names = set(static_names)
+        for i in static_nums:
+            if i < len(params):
+                names.add(params[i])
+        self.static_names = names
+        self.params = params
+
+
+# --------------------------------------------------------- traced-value rules
+
+
+def _is_traced_expr(node: ast.AST, traced: set[str]) -> bool:
+    """Does evaluating ``node`` concretize a traced value? ``.shape`` /
+    ``.ndim`` / ``.dtype`` chains and ``len()`` are static under trace."""
+    if isinstance(node, ast.Name):
+        return node.id in traced
+    if isinstance(node, ast.Attribute):
+        if node.attr in STATIC_ATTRS:
+            return False
+        return _is_traced_expr(node.value, traced)
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name) and node.func.id == "len":
+            return False
+        return any(
+            _is_traced_expr(a, traced)
+            for a in list(node.args) + [kw.value for kw in node.keywords]
+        ) or _is_traced_expr(node.func, traced)
+    if isinstance(node, ast.Compare):
+        # `x is None` / `x is not None` tests argument STRUCTURE, not the
+        # traced value — the canonical optional-argument idiom
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return False
+        return any(
+            _is_traced_expr(c, traced)
+            for c in [node.left] + list(node.comparators)
+        )
+    if isinstance(node, ast.Subscript):
+        return _is_traced_expr(node.value, traced) or _is_traced_expr(
+            node.slice, traced
+        )
+    return any(
+        _is_traced_expr(c, traced) for c in ast.iter_child_nodes(node)
+    )
+
+
+def _check_jitted_body(src: SourceFile, jd: _JittedDef,
+                       findings: list[Finding]) -> None:
+    traced = {
+        p for p in jd.params
+        if p not in jd.static_names and p not in ("self", "cls")
+    }
+
+    def add(rule: str, node: ast.AST, msg: str) -> None:
+        f = src.finding(rule, node.lineno, msg)
+        if f is not None:
+            findings.append(f)
+
+    def visit(node: ast.AST, traced: set[str], in_nested: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                # nested fns (scan bodies): unknown param tracedness — only
+                # the host-state rule keeps applying inside them
+                visit(child, set(), True)
+                continue
+            if isinstance(child, ast.Assign) and not in_nested:
+                if _is_traced_expr(child.value, traced):
+                    for tgt in child.targets:
+                        for n in ast.walk(tgt):
+                            if isinstance(n, ast.Name):
+                                traced.add(n.id)
+            if isinstance(child, (ast.If, ast.While)):
+                if _is_traced_expr(child.test, traced):
+                    add(RULE_BRANCH, child,
+                        f"Python {'if' if isinstance(child, ast.If) else 'while'} "
+                        f"on a traced value inside jitted "
+                        f"`{jd.fn.name}` — use jnp.where / lax.cond")
+            if isinstance(child, ast.IfExp) and _is_traced_expr(child.test, traced):
+                add(RULE_BRANCH, child,
+                    f"ternary on a traced value inside jitted `{jd.fn.name}`")
+            if isinstance(child, ast.Assert) and _is_traced_expr(child.test, traced):
+                add(RULE_BRANCH, child,
+                    f"assert on a traced value inside jitted `{jd.fn.name}`")
+            if isinstance(child, ast.Call):
+                fn = child.func
+                if (isinstance(fn, ast.Name) and fn.id in ("int", "float", "bool")
+                        and child.args
+                        and _is_traced_expr(child.args[0], traced)):
+                    add(RULE_CAST, child,
+                        f"{fn.id}() concretizes a traced value inside jitted "
+                        f"`{jd.fn.name}`")
+                if (isinstance(fn, ast.Attribute) and fn.attr == "item"
+                        and _is_traced_expr(fn.value, traced)):
+                    add(RULE_CAST, child,
+                        f".item() fetches a traced value inside jitted "
+                        f"`{jd.fn.name}`")
+                if (isinstance(fn, ast.Attribute)
+                        and fn.attr in ("asarray", "array")
+                        and isinstance(fn.value, ast.Name)
+                        and fn.value.id in ("np", "numpy")
+                        and child.args
+                        and _is_traced_expr(child.args[0], traced)):
+                    add(RULE_CAST, child,
+                        f"np.{fn.attr}() forces a traced value to host inside "
+                        f"jitted `{jd.fn.name}`")
+            if isinstance(child, ast.Attribute):
+                if (isinstance(child.value, ast.Name)
+                        and child.value.id == "self"):
+                    add(RULE_HOST, child,
+                        f"jitted `{jd.fn.name}` reads `self.{child.attr}` — "
+                        f"the trace bakes mutable host state; hoist to a "
+                        f"local before the def")
+                    continue  # don't double-report nested attribute chains
+            visit(child, traced, in_nested)
+
+    # the fn node is the root: its direct children (the body statements) and
+    # everything below get visited uniformly
+    visit(jd.fn, traced, False)
+
+
+# ------------------------------------------------------ unbounded-static rule
+
+
+class _BoundednessEnv:
+    """Name resolution scope: assignments within the enclosing function."""
+
+    def __init__(self, enclosing: Optional[ast.FunctionDef]) -> None:
+        self.assignments: dict[str, list[ast.AST]] = {}
+        self.params: set[str] = set()
+        self.fn = enclosing
+        if enclosing is not None:
+            self.params = set(_param_names(enclosing)) - {"self", "cls"}
+            for node in ast.walk(enclosing):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.assignments.setdefault(tgt.id, []).append(
+                                node.value
+                            )
+                elif isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Name
+                ):
+                    self.assignments.setdefault(node.target.id, []).append(
+                        node.value
+                    )
+
+
+def _is_unbounded(node: ast.AST, env: _BoundednessEnv, depth: int = 0,
+                  seen: Optional[set[str]] = None) -> bool:
+    """True when ``node`` can take unboundedly many distinct values per
+    process (each one a fresh compile of the jitted callee)."""
+    if depth > 6:
+        return False  # resolution too deep: stay quiet
+    seen = seen or set()
+    if isinstance(node, ast.Constant):
+        return False
+    if isinstance(node, ast.Call):
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else ""
+        )
+        if name in BOUNDING_CALLS:
+            return False
+        if name == "min":
+            # min(x, bound) is bounded as soon as ANY arm is
+            return all(
+                _is_unbounded(a, env, depth + 1, seen) for a in node.args
+            )
+        if name == "len":
+            return True
+        if name in ("int", "max", "abs", "round"):
+            return any(
+                _is_unbounded(a, env, depth + 1, seen) for a in node.args
+            )
+        return False  # unknown call: stay quiet
+    if isinstance(node, ast.Name):
+        if node.id in seen:
+            return False
+        seen = seen | {node.id}
+        exprs = env.assignments.get(node.id)
+        if exprs:
+            return any(_is_unbounded(e, env, depth + 1, seen) for e in exprs)
+        if node.id in env.params:
+            # raw caller input reaching a static arg — unless annotated as a
+            # hashable config type
+            return not (env.fn is not None
+                        and _annotation_is_config(env.fn, node.id))
+        return False
+    if isinstance(node, (ast.BinOp, ast.UnaryOp)):
+        return any(
+            _is_unbounded(c, env, depth + 1, seen)
+            for c in ast.iter_child_nodes(node)
+            if not isinstance(c, ast.operator)
+        )
+    if isinstance(node, ast.IfExp):
+        return _is_unbounded(node.body, env, depth + 1, seen) or _is_unbounded(
+            node.orelse, env, depth + 1, seen
+        )
+    return False
+
+
+def _check_static_callsites(tree: ast.Module, src: SourceFile,
+                            registry: dict[str, _JittedDef],
+                            findings: list[Finding]) -> None:
+    """Every call whose callee name resolves to a jitted def: classify the
+    expressions feeding its static args."""
+
+    def enclosing_functions(t: ast.Module):
+        stack: list[tuple[ast.AST, Optional[ast.FunctionDef]]] = [(t, None)]
+        while stack:
+            node, fn = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                child_fn = fn
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    child_fn = child
+                stack.append((child, child_fn))
+            if isinstance(node, ast.Call):
+                yield node, fn
+
+    for call, fn in enclosing_functions(tree):
+        callee = None
+        if isinstance(call.func, ast.Name):
+            callee = call.func.id
+        elif isinstance(call.func, ast.Attribute):
+            callee = call.func.attr
+        jd = registry.get(callee or "")
+        if jd is None or not jd.static_names:
+            continue
+        if fn is not None and jd.fn is fn:
+            continue  # recursive mention, not a callsite
+        env = _BoundednessEnv(fn)
+        checked: list[tuple[str, ast.AST]] = []
+        for kw in call.keywords:
+            if kw.arg in jd.static_names:
+                checked.append((kw.arg, kw.value))
+        for i, arg in enumerate(call.args):
+            if i < len(jd.params) and jd.params[i] in jd.static_names:
+                checked.append((jd.params[i], arg))
+        for name, value in checked:
+            if _is_unbounded(value, env):
+                f = src.finding(
+                    RULE_STATIC, value.lineno,
+                    f"static arg `{name}` of jitted `{jd.fn.name}` fed from "
+                    f"an unbounded host quantity — every distinct value "
+                    f"compiles a fresh XLA program; route through "
+                    f"bucket_size/pow2 bucketing",
+                )
+                if f is not None:
+                    findings.append(f)
+
+
+# ----------------------------------------------------------------- entrypoint
+
+
+def check_retrace(tree: ast.Module, src: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+    registry: dict[str, _JittedDef] = {}
+
+    # pass 1: jitted defs (any nesting depth) + alias registration
+    defs_by_name: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            defs_by_name.setdefault(node.name, node)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                statics = _jit_decorator_statics(dec)
+                if statics is not None:
+                    jd = _JittedDef(node, *statics)
+                    registry[node.name] = jd
+                    break
+        elif isinstance(node, ast.Assign):
+            # self._fwd = jax.jit(fwd) / self._step = step_n alias forms
+            value = node.value
+            target_names = [
+                t.attr for t in node.targets if isinstance(t, ast.Attribute)
+            ] + [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if isinstance(value, ast.Call) and _is_jax_jit(value.func):
+                names, nums = _extract_statics(value.keywords)
+                inner = value.args[0] if value.args else None
+                if isinstance(inner, ast.Name) and inner.id in defs_by_name:
+                    jd = _JittedDef(defs_by_name[inner.id], names, nums)
+                    registry.setdefault(inner.id, jd)
+                    for tn in target_names:
+                        registry.setdefault(tn, jd)
+            elif isinstance(value, ast.Name) and value.id in registry:
+                for tn in target_names:
+                    registry.setdefault(tn, registry[value.id])
+
+    # pass 2: body rules per jitted def (dedupe shared defs)
+    seen_defs: set[int] = set()
+    for jd in registry.values():
+        if id(jd.fn) in seen_defs:
+            continue
+        seen_defs.add(id(jd.fn))
+        _check_jitted_body(src, jd, findings)
+
+    # pass 3: static-arg boundedness at every callsite
+    _check_static_callsites(tree, src, registry, findings)
+    return findings

@@ -1,0 +1,168 @@
+"""Lint orchestration: file walking, rule dispatch, baseline gate, CLI.
+
+``lint_paths`` parses each ``.py`` file once and fans it through every rule
+module; ``run_gate`` wraps that in the baseline ratchet (new findings fail,
+baselined findings pass, fixed-but-still-baselined entries report as stale
+so the baseline only shrinks). ``main`` is the ``sentio lint`` entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional, Sequence
+
+from sentio_tpu.analysis.findings import (
+    Finding,
+    SourceFile,
+    diff_baseline,
+    load_baseline,
+    save_baseline,
+)
+from sentio_tpu.analysis.hygiene import check_hygiene
+from sentio_tpu.analysis.locks import check_locks
+from sentio_tpu.analysis.retrace import check_retrace
+
+__all__ = ["lint_paths", "run_gate", "main", "DEFAULT_BASELINE"]
+
+PACKAGE_ROOT = Path(__file__).resolve().parents[1]  # sentio_tpu/
+REPO_ROOT = PACKAGE_ROOT.parent
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+RULES = (check_retrace, check_locks, check_hygiene)
+
+
+def _iter_py_files(path: Path):
+    if path.is_file():
+        if path.suffix == ".py":
+            yield path
+        return
+    for p in sorted(path.rglob("*.py")):
+        if "__pycache__" not in p.parts:
+            yield p
+
+
+def _rel(path: Path) -> str:
+    try:
+        return path.resolve().relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def lint_file(path: Path) -> list[Finding]:
+    text = path.read_text(encoding="utf-8", errors="replace")
+    src = SourceFile(path=path, rel=_rel(path), text=text)
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as exc:
+        return [Finding(
+            rule="syntax-error", path=src.rel,
+            line=exc.lineno or 1,
+            message=f"file does not parse: {exc.msg}",
+            context=src.line_text(exc.lineno or 1).strip(),
+        )]
+    findings: list[Finding] = []
+    for rule in RULES:
+        findings.extend(rule(tree, src))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def lint_paths(paths: Sequence[str | Path]) -> list[Finding]:
+    findings: list[Finding] = []
+    for raw in paths:
+        for f in _iter_py_files(Path(raw)):
+            findings.extend(lint_file(f))
+    return findings
+
+
+@dataclass
+class GateResult:
+    findings: list[Finding] = field(default_factory=list)
+    new: list[Finding] = field(default_factory=list)
+    matched: list[Finding] = field(default_factory=list)
+    stale: list[dict] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+    def summary(self) -> str:
+        return (
+            f"{len(self.findings)} findings: {len(self.new)} new, "
+            f"{len(self.matched)} baselined, {len(self.stale)} stale "
+            f"baseline entries"
+        )
+
+
+def run_gate(
+    paths: Optional[Sequence[str | Path]] = None,
+    baseline_path: Optional[str | Path] = None,
+) -> GateResult:
+    """Lint ``paths`` (default: the installed ``sentio_tpu`` package) and
+    diff against the committed baseline. ``ok`` iff no NEW findings."""
+    paths = list(paths) if paths else [PACKAGE_ROOT]
+    baseline = load_baseline(baseline_path or DEFAULT_BASELINE)
+    findings = lint_paths(paths)
+    new, matched, stale = diff_baseline(findings, baseline)
+    return GateResult(findings=findings, new=new, matched=matched, stale=stale)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="sentio lint",
+        description="AST lint for retrace / lock-discipline / clock / "
+                    "exception hazards, gated on a committed baseline",
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories (default: sentio_tpu/)")
+    parser.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                        help="baseline JSON (default: analysis/baseline.json)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="re-record the baseline from current findings "
+                             "(prunes stale entries)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output")
+    args = parser.parse_args(argv)
+
+    result = run_gate(args.paths or None, baseline_path=args.baseline)
+
+    if args.update_baseline:
+        if args.paths:
+            # a partial lint sees only a subset of findings; rewriting the
+            # baseline from it would silently drop every entry belonging to
+            # an unlinted file and break the next full-tree gate
+            print("--update-baseline requires a full-tree run "
+                  "(drop the explicit paths)", file=sys.stderr)
+            return 2
+        save_baseline(args.baseline, result.findings)
+        print(f"baseline rewritten: {len(result.findings)} entries "
+              f"-> {args.baseline}", file=sys.stderr)
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "ok": result.ok,
+            "new": [dict(f.to_json(), line=f.line) for f in result.new],
+            "baselined": [dict(f.to_json(), line=f.line)
+                          for f in result.matched],
+            "stale": result.stale,
+        }, indent=1))
+    else:
+        for f in result.new:
+            print(f"NEW  {f.render()}")
+        for f in result.matched:
+            print(f"base {f.render()}")
+        for e in result.stale:
+            print(f"stale baseline entry (fixed? run --update-baseline): "
+                  f"{e['path']} [{e['rule']}] {e.get('context', '')}")
+        print(result.summary())
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -1,0 +1,255 @@
+"""Runtime sanitizer: opt-in dynamic checks for the engine's contracts.
+
+Enabled by ``SENTIO_SANITIZE=1`` (read at object construction). Three
+checks, all free when disabled:
+
+* **lock ownership** — ``make_lock`` returns an :class:`OwnedLock` that
+  records its owning thread; helpers documented as lock-held call
+  :func:`assert_held` at entry, so "caller must hold the lock" stops being
+  a comment. Disabled, ``make_lock`` returns a plain ``threading.Lock`` and
+  ``assert_held`` no-ops.
+* **single-driver-thread engine** — the paged engine is touched only by
+  one driver (the serving pump, or the test/bench thread driving it
+  directly). :class:`ThreadGuard` binds the first mutating caller and
+  raises on any mutating entry from a different live thread; the serving
+  pump rebinds explicitly at pump start (:func:`bind_engine_owner`) since
+  pump threads are born and die per burst.
+* **engine invariants** — after every tick,
+  :func:`check_engine_invariants` verifies page-pool conservation (every
+  page id 1..P-1 is owned by exactly one of: the free list, an active
+  slot, the radix cache) and radix refcount consistency (each node's
+  refcount equals the number of active slots whose pinned chain crosses
+  it). A leaked or double-owned page fails THE TICK THAT LEAKED IT, not a
+  pool-exhaustion three workloads later.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+__all__ = [
+    "SanitizerError",
+    "enabled",
+    "make_lock",
+    "assert_held",
+    "OwnedLock",
+    "ThreadGuard",
+    "engine_guard",
+    "bind_engine_owner",
+    "check_engine_invariants",
+]
+
+
+class SanitizerError(RuntimeError):
+    """An engine/lock contract was violated (only raised under
+    ``SENTIO_SANITIZE=1``)."""
+
+
+def enabled() -> bool:
+    return os.environ.get("SENTIO_SANITIZE", "") == "1"
+
+
+# ------------------------------------------------------------ lock ownership
+
+
+class OwnedLock:
+    """``threading.Lock`` recording its owning thread, so lock-held helpers
+    can assert the caller actually holds it. Not reentrant (neither is the
+    lock it wraps)."""
+
+    def __init__(self, name: str = "lock") -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self._owner: Optional[threading.Thread] = None
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._owner = threading.current_thread()
+        return got
+
+    def release(self) -> None:
+        self._owner = None
+        self._lock.release()
+
+    def __enter__(self) -> "OwnedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    @property
+    def held_by_me(self) -> bool:
+        return self._owner is threading.current_thread()
+
+
+def make_lock(name: str = "lock"):
+    """A lock for a ``guarded-by`` annotated structure: plain
+    ``threading.Lock`` normally, :class:`OwnedLock` under the sanitizer."""
+    return OwnedLock(name) if enabled() else threading.Lock()
+
+
+def assert_held(lock) -> None:
+    """No-op on a plain lock; on an :class:`OwnedLock`, raise unless the
+    calling thread holds it."""
+    if isinstance(lock, OwnedLock) and not lock.held_by_me:
+        raise SanitizerError(
+            f"lock-held contract violated: {lock.name} is not held by "
+            f"thread {threading.current_thread().name!r}"
+        )
+
+
+# --------------------------------------------------- single-driver contract
+
+
+class ThreadGuard:
+    """Binds the engine's driver thread and rejects mutating entry from any
+    other live thread. First mutating caller binds implicitly (tests/bench
+    drive the engine directly); the serving pump rebinds explicitly at pump
+    start — an authorized ownership transfer, since the service guarantees
+    at most one pump exists."""
+
+    def __init__(self, name: str = "engine") -> None:
+        self.name = name
+        self._owner: Optional[threading.Thread] = None
+
+    def bind(self) -> None:
+        self._owner = threading.current_thread()
+
+    def enter(self, op: str) -> None:
+        cur = threading.current_thread()
+        owner = self._owner
+        if owner is None or owner is cur:
+            self._owner = cur
+            return
+        if not owner.is_alive():
+            # the previous driver died (a finished pump burst): ownership
+            # migrates to whoever drives next
+            self._owner = cur
+            return
+        raise SanitizerError(
+            f"{self.name}.{op} called from thread {cur.name!r} while the "
+            f"engine is owned by live thread {owner.name!r} — the engine is "
+            f"single-threaded by contract (runtime/service.py); route calls "
+            f"through the pump"
+        )
+
+
+def engine_guard(name: str = "engine") -> Optional[ThreadGuard]:
+    """A :class:`ThreadGuard` when sanitizing, else None (so the per-call
+    cost in the engine is one attribute test)."""
+    return ThreadGuard(name) if enabled() else None
+
+
+def bind_engine_owner(engine) -> None:
+    """Explicitly hand engine ownership to the calling thread (the serving
+    pump calls this at pump start). No-op when the engine carries no guard."""
+    guard = getattr(engine, "_san", None)
+    if guard is not None:
+        guard.bind()
+
+
+# ------------------------------------------------------- engine invariants
+
+
+def _radix_nodes(radix):
+    stack = list(radix.root.children.values())
+    while stack:
+        node = stack.pop()
+        stack.extend(node.children.values())
+        yield node
+
+
+def check_engine_invariants(engine) -> None:
+    """Page-pool conservation + radix refcount consistency. Called by the
+    engine at the end of every tick under the sanitizer.
+
+    Ownership model being verified: page 0 is scratch; every other page id
+    is owned by exactly one of (a) the allocator free list, (b) an active
+    slot's ``pages`` minus the span it donated to the radix cache, (c) the
+    radix tree. Refcounts: each active slot pins the chain from its
+    ``prefix_node`` to the root, contributing exactly 1 per node."""
+    alloc = engine.allocator
+    free = list(alloc._free)
+    free_set = set(free)
+    if len(free_set) != len(free):
+        raise SanitizerError(
+            f"page free-list contains duplicates: "
+            f"{sorted(p for p in free_set if free.count(p) > 1)}"
+        )
+    if 0 in free_set or any(p < 0 or p >= alloc.num_pages for p in free_set):
+        raise SanitizerError("free-list holds out-of-range or scratch page ids")
+
+    slot_pages: list[int] = []
+    donated: set[int] = set()
+    for slot in engine.slots:
+        if not slot.active:
+            continue
+        slot_pages.extend(slot.pages)
+        donated.update(slot.donated)
+    if len(set(slot_pages)) != len(slot_pages):
+        raise SanitizerError("a page id is owned by two active slots")
+    slot_owned = set(slot_pages) - donated
+
+    radix = getattr(engine, "_radix", None)
+    radix_pages: set[int] = set()
+    if radix is not None:
+        for node in _radix_nodes(radix):
+            for p in node.pages:
+                if p in radix_pages:
+                    raise SanitizerError(
+                        f"radix tree holds page {p} in two nodes"
+                    )
+                radix_pages.add(p)
+        if len(radix_pages) != radix.pages_held:
+            raise SanitizerError(
+                f"radix pages_held={radix.pages_held} but tree holds "
+                f"{len(radix_pages)} pages"
+            )
+
+    for a, b, what in (
+        (free_set, slot_owned, "free list and an active slot"),
+        (free_set, radix_pages, "free list and the radix cache"),
+        (slot_owned, radix_pages, "an active slot and the radix cache"),
+    ):
+        both = a & b
+        if both:
+            raise SanitizerError(
+                f"pages {sorted(both)} owned by {what} simultaneously"
+            )
+
+    expected = set(range(1, alloc.num_pages))
+    union = free_set | slot_owned | radix_pages
+    if union != expected:
+        leaked = sorted(expected - union)
+        extra = sorted(union - expected)
+        raise SanitizerError(
+            f"page conservation violated: leaked={leaked} unknown={extra} "
+            f"(free={len(free_set)} slot={len(slot_owned)} "
+            f"radix={len(radix_pages)} total={alloc.num_pages - 1})"
+        )
+
+    if radix is not None:
+        expected_rc: dict[int, int] = {}
+        for slot in engine.slots:
+            if not slot.active:
+                continue
+            node = slot.prefix_node
+            while node is not None and node is not radix.root:
+                expected_rc[id(node)] = expected_rc.get(id(node), 0) + 1
+                node = node.parent
+        for node in _radix_nodes(radix):
+            want = expected_rc.get(id(node), 0)
+            if node.refcount != want:
+                raise SanitizerError(
+                    f"radix refcount mismatch on node "
+                    f"({len(node.tokens)} tokens, pages {node.pages}): "
+                    f"refcount={node.refcount} but {want} live slot chains "
+                    f"cross it"
+                )

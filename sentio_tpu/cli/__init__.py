@@ -1,4 +1,5 @@
-"""Command-line interface: ingest / serve / bench / info / trace / convert.
+"""Command-line interface: ingest / serve / bench / info / trace / convert /
+lint.
 
 Parity with /root/reference/src/cli/ (Typer app with ``ingest``/``api``/
 ``ui``/``run``/``studio`` sub-apps, __init__.py:17-23 there) on stdlib
@@ -190,6 +191,23 @@ def _cmd_train_encoder(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_lint(args: argparse.Namespace) -> int:
+    """Run the static analyzer (analysis/) over the source tree against the
+    committed baseline: retrace hazards at jit sites, lock discipline from
+    guarded-by annotations, wall-clock and exception hygiene. Exit 1 on any
+    finding not in the baseline."""
+    from sentio_tpu.analysis.runner import main as lint_main
+
+    forwarded = list(args.paths)
+    if args.baseline:
+        forwarded += ["--baseline", args.baseline]
+    if args.update_baseline:
+        forwarded.append("--update-baseline")
+    if args.json:
+        forwarded.append("--json")
+    return lint_main(forwarded)
+
+
 def _cmd_info(args: argparse.Namespace) -> int:
     import jax
 
@@ -285,6 +303,21 @@ def main(argv: list[str] | None = None) -> int:
                       help="measure recall@10 on the eval bundle (seed 0) "
                            "after training")
     p_tr.set_defaults(fn=_cmd_train_encoder)
+
+    p_lint = sub.add_parser(
+        "lint",
+        help="static analysis: retrace / lock-discipline / clock / "
+             "exception hazards vs the committed baseline",
+    )
+    p_lint.add_argument("paths", nargs="*",
+                        help="files or directories (default: sentio_tpu/)")
+    p_lint.add_argument("--baseline", default="",
+                        help="baseline JSON (default: analysis/baseline.json)")
+    p_lint.add_argument("--update-baseline", action="store_true",
+                        help="re-record the baseline from current findings")
+    p_lint.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    p_lint.set_defaults(fn=_cmd_lint)
 
     p_info = sub.add_parser("info", help="print version/device/config info")
     p_info.set_defaults(fn=_cmd_info)

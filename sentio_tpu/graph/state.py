@@ -52,7 +52,7 @@ def _merged_meta(state: RAGState, extra: dict[str, Any]) -> dict[str, Any]:
 def add_retrieved_documents(state: RAGState, docs: list[Document]) -> RAGState:
     new = dict(state)
     new["retrieved_documents"] = list(docs)
-    new["metadata"] = _merged_meta(state, {"num_retrieved": len(docs), "retrieved_at": time.time()})
+    new["metadata"] = _merged_meta(state, {"num_retrieved": len(docs), "retrieved_at": time.time()})  # wall-clock: reported metadata timestamp
     return new  # type: ignore[return-value]
 
 

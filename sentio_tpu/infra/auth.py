@@ -94,7 +94,7 @@ class JWT:
         if header.get("alg") != "HS256":
             raise AuthError("unsupported token algorithm")
         exp = payload.get("exp")
-        if exp is not None and time.time() > float(exp):
+        if exp is not None and time.time() > float(exp):  # wall-clock: JWT exp is epoch
             raise AuthError("token expired", code=ErrorCode.TOKEN_EXPIRED)
         return payload
 
@@ -158,7 +158,7 @@ class AuthManager:
         if user is None or user.disabled:
             self.log_security_event("login_failed", username=username, reason="unknown/disabled")
             raise AuthError("invalid credentials")
-        now = time.time()
+        now = time.time()  # wall-clock: lockout epoch, seconds granularity
         if user.locked_until > now:
             self.log_security_event("login_locked", username=username)
             raise AuthError("account locked", code=ErrorCode.ACCOUNT_LOCKED)
@@ -178,7 +178,7 @@ class AuthManager:
     # ----------------------------------------------------------------- tokens
 
     def issue_tokens(self, user: User) -> dict[str, str]:
-        now = time.time()
+        now = time.time()  # wall-clock: JWT iat/exp are epoch
         base = {"sub": user.username, "role": user.role, "scopes": list(ROLE_SCOPES[user.role])}
         access = self.jwt.encode({**base, "type": "access", "iat": now,
                                   "exp": now + self.config.access_ttl_s})
@@ -232,8 +232,8 @@ class AuthManager:
         session = Session(
             session_id=secrets.token_urlsafe(24),
             username=username,
-            created_at=time.time(),
-            last_seen=time.time(),
+            created_at=time.time(),  # wall-clock: session metadata is user-visible
+            last_seen=time.time(),  # wall-clock: session metadata is user-visible
         )
         with self._lock:
             self._sessions[session.session_id] = session
@@ -243,7 +243,7 @@ class AuthManager:
         with self._lock:
             session = self._sessions.get(session_id)
             if session is not None:
-                session.last_seen = time.time()
+                session.last_seen = time.time()  # wall-clock: session metadata is user-visible
             return session
 
     def end_session(self, session_id: str) -> bool:
@@ -268,4 +268,4 @@ class AuthManager:
 
     @staticmethod
     def log_security_event(event: str, **fields: Any) -> None:
-        audit_logger.info(json.dumps({"event": event, "at": time.time(), **fields}))
+        audit_logger.info(json.dumps({"event": event, "at": time.time(), **fields}))  # wall-clock: audit log epoch

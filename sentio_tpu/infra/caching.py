@@ -40,7 +40,7 @@ class MemoryCache:
                 self.misses += 1
                 return None
             value, stored_at, ttl = entry
-            if ttl > 0 and time.time() - stored_at > ttl:
+            if ttl > 0 and time.perf_counter() - stored_at > ttl:
                 del self._store[key]
                 self.misses += 1
                 return None
@@ -53,7 +53,7 @@ class MemoryCache:
             ttl = self.default_ttl_s if ttl_s is None else ttl_s
             if key in self._store:
                 self._store.move_to_end(key)
-            self._store[key] = (value, time.time(), ttl)
+            self._store[key] = (value, time.perf_counter(), ttl)
             while len(self._store) > self.max_entries:
                 self._store.popitem(last=False)
                 self.evictions += 1
@@ -74,7 +74,7 @@ class MemoryCache:
             return len(doomed)
 
     def cleanup_expired(self) -> int:
-        now = time.time()
+        now = time.perf_counter()
         with self._lock:
             doomed = [
                 k for k, (_, at, ttl) in self._store.items() if ttl > 0 and now - at > ttl

@@ -91,7 +91,7 @@ class SentioError(Exception):
         self.details = details or {}
         self.retryable = retryable
         self.error_id = str(uuid.uuid4())
-        self.timestamp = time.time()
+        self.timestamp = time.time()  # wall-clock: reported error timestamp
 
     def to_dict(self) -> dict[str, Any]:
         return {

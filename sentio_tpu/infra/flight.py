@@ -30,6 +30,8 @@ import time
 from collections import OrderedDict, deque
 from typing import Any, Optional
 
+from sentio_tpu.analysis.sanitizer import assert_held, make_lock
+
 __all__ = ["FlightRecorder", "get_flight_recorder", "set_flight_recorder"]
 
 # tick events returned inline with one request's record — the full ring is
@@ -43,12 +45,12 @@ class FlightRecorder:
     worker threads, and the engine pump thread concurrently."""
 
     def __init__(self, max_ticks: int = 4096, max_requests: int = 512) -> None:
-        self._lock = threading.Lock()
-        self._ticks: deque = deque(maxlen=max_ticks)
-        self._tick_seq = 0
-        self._records: "OrderedDict[str, dict]" = OrderedDict()
+        self._lock = make_lock("FlightRecorder._lock")
+        self._ticks: deque = deque(maxlen=max_ticks)  # guarded-by: _lock
+        self._tick_seq = 0  # guarded-by: _lock
+        self._records: "OrderedDict[str, dict]" = OrderedDict()  # guarded-by: _lock
         self.max_requests = max_requests
-        self.dropped_requests = 0  # evicted before anyone read them
+        self.dropped_requests = 0  # guarded-by: _lock
         self._t0 = time.perf_counter()  # timeline origin for tick timestamps
 
     # ------------------------------------------------------------- requests
@@ -57,6 +59,7 @@ class FlightRecorder:
         """Fetch-or-create a record (lock held). Any layer may be the first
         to see an id — HTTP handler, graph executor, CLI, or a direct
         service caller — so every writer creates on demand."""
+        assert_held(self._lock)
         record = self._records.get(request_id)
         if record is None:
             record = {"request_id": request_id, "status": "active",
@@ -218,6 +221,7 @@ class FlightRecorder:
         return time.perf_counter() - self._t0
 
     def _evict_locked(self) -> None:
+        assert_held(self._lock)
         while len(self._records) > self.max_requests:
             self._records.popitem(last=False)
             self.dropped_requests += 1

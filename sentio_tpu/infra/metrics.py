@@ -9,10 +9,11 @@ use, batch occupancy, generated tokens/s (SURVEY.md §2.10 build column).
 
 from __future__ import annotations
 
-import threading
 import time
 from contextlib import contextmanager
 from typing import Any, Optional
+
+from sentio_tpu.analysis.sanitizer import make_lock
 
 try:
     from prometheus_client import (
@@ -34,12 +35,12 @@ class InMemoryMetrics:
     WINDOW = 1000  # retained observations per histogram key
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self.counters: dict[str, float] = {}
-        self.histograms: dict[str, list[float]] = {}
-        self._histo_total: dict[str, int] = {}  # true observation counts
-        self._histo_sum: dict[str, float] = {}  # lifetime sums (true means)
-        self.gauges: dict[str, float] = {}
+        self._lock = make_lock("InMemoryMetrics._lock")
+        self.counters: dict[str, float] = {}  # guarded-by: _lock
+        self.histograms: dict[str, list[float]] = {}  # guarded-by: _lock
+        self._histo_total: dict[str, int] = {}  # guarded-by: _lock
+        self._histo_sum: dict[str, float] = {}  # guarded-by: _lock
+        self.gauges: dict[str, float] = {}  # guarded-by: _lock
 
     def inc(self, name: str, labels: tuple = (), value: float = 1.0) -> None:
         key = f"{name}{labels}"
@@ -92,8 +93,8 @@ class MetricsCollector:
         self.memory = InMemoryMetrics()
         self.registry = None
         self._prom: dict[str, Any] = {}
-        self._inflight = 0
-        self._inflight_lock = threading.Lock()
+        self._inflight = 0  # guarded-by: _inflight_lock
+        self._inflight_lock = make_lock("MetricsCollector._inflight_lock")
         self._serving_last: dict[str, float] = {}
         if PROMETHEUS_AVAILABLE and enabled:
             self.registry = CollectorRegistry()

@@ -31,7 +31,7 @@ class Alert:
     value: float
     threshold: float
     severity: str
-    at: float = field(default_factory=time.time)
+    at: float = field(default_factory=time.perf_counter)
 
 
 class PerformanceMonitor:
@@ -52,7 +52,7 @@ class PerformanceMonitor:
     def record(self, metric: str, value: float) -> None:
         with self._lock:
             series = self._history.setdefault(metric, deque(maxlen=self._history_len))
-            series.append((time.time(), value))
+            series.append((time.perf_counter(), value))
         threshold = self._thresholds.get(metric)
         if threshold and value > threshold[0]:
             alert = Alert(metric, value, threshold[0], threshold[1])
@@ -139,7 +139,7 @@ class ResourceMonitor:
     def health_verdict(self) -> dict[str, Any]:
         system = self.monitor.collect_system()
         alerts = self.monitor.recent_alerts()
-        recent = [a for a in alerts if time.time() - a.at < 300]
+        recent = [a for a in alerts if time.perf_counter() - a.at < 300]
         critical = [a for a in recent if a.severity == "critical"]
         status = "unhealthy" if critical else "degraded" if recent else "healthy"
         recommendations = []

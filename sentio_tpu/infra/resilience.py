@@ -282,9 +282,9 @@ class HealthChecker:
                     ok = bool(probe())
                 except Exception as exc:  # noqa: BLE001
                     ok = False
-                    self._results[name] = {"ok": False, "error": str(exc), "at": time.time()}
+                    self._results[name] = {"ok": False, "error": str(exc), "at": time.time()}  # wall-clock: reported probe time
                     continue
-                self._results[name] = {"ok": ok, "at": time.time()}
+                self._results[name] = {"ok": ok, "at": time.time()}  # wall-clock: reported probe time
             await asyncio.sleep(self.interval_s)
 
     def start(self) -> None:
@@ -340,7 +340,7 @@ class FallbackResponseCache:
 
     def put(self, query: str, response: str) -> None:
         with self._lock:
-            self._store[self._key(query)] = {"response": response, "at": time.time()}
+            self._store[self._key(query)] = {"response": response, "at": time.time()}  # wall-clock: TTL persists across restarts
             self._persist()
 
     def get(self, query: str) -> Optional[str]:
@@ -348,7 +348,7 @@ class FallbackResponseCache:
             entry = self._store.get(self._key(query))
             if entry is None:
                 return None
-            if self.ttl_s > 0 and time.time() - entry["at"] > self.ttl_s:
+            if self.ttl_s > 0 and time.time() - entry["at"] > self.ttl_s:  # wall-clock: TTL persists across restarts
                 del self._store[self._key(query)]
                 return None
             return entry["response"]

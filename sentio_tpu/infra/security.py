@@ -190,7 +190,7 @@ class IPRateLimiter:
     def check(self, ip: str, endpoint: str = "*") -> None:
         cfg = self.per_endpoint.get(endpoint, self.default)
         limit = max(int(cfg.per_minute * self.load_factor), 1)
-        now = time.time()
+        now = time.perf_counter()
         key = (ip, endpoint)
         with self._lock:
             self._maybe_sweep(now)
@@ -207,7 +207,7 @@ class IPRateLimiter:
     def remaining(self, ip: str, endpoint: str = "*") -> int:
         cfg = self.per_endpoint.get(endpoint, self.default)
         limit = max(int(cfg.per_minute * self.load_factor), 1)
-        now = time.time()
+        now = time.perf_counter()
         with self._lock:
             window = [t for t in self._events.get((ip, endpoint), []) if now - t < 60.0]
         return max(limit - len(window), 0)
@@ -218,14 +218,14 @@ class CSRFProtection:
         self._secret = (secret or secrets.token_urlsafe(32)).encode()
 
     def issue(self, session_id: str) -> str:
-        ts = str(int(time.time()))
+        ts = str(int(time.time()))  # wall-clock: CSRF token timestamp crosses workers
         mac = hmac.new(self._secret, f"{session_id}:{ts}".encode(), "sha256").hexdigest()
         return f"{ts}.{mac}"
 
     def verify(self, session_id: str, token: str, max_age_s: float = 3600.0) -> bool:
         try:
             ts, mac = token.split(".")
-            if time.time() - float(ts) > max_age_s:
+            if time.time() - float(ts) > max_age_s:  # wall-clock: CSRF token timestamp crosses workers
                 return False
         except ValueError:
             return False

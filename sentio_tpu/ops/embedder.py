@@ -56,7 +56,7 @@ class EmbeddingCache:
                 self.misses += 1
                 return None
             vec, t, hits = entry
-            if self.ttl_s > 0 and time.time() - t > self.ttl_s:
+            if self.ttl_s > 0 and time.perf_counter() - t > self.ttl_s:
                 del self._store[k]
                 self.misses += 1
                 return None
@@ -73,7 +73,7 @@ class EmbeddingCache:
                 # evict least-frequently-used
                 victim = min(self._store.items(), key=lambda kv: kv[1][2])[0]
                 del self._store[victim]
-            self._store[k] = (vec, time.time(), 0)
+            self._store[k] = (vec, time.perf_counter(), 0)
 
     def stats(self) -> dict:
         with self._lock:

@@ -79,7 +79,7 @@ class RecencyScorer:
     name: str = "recency"
 
     def score(self, query: str, documents: Sequence[Document]) -> np.ndarray:
-        now = time.time()
+        now = time.time()  # wall-clock: compared to doc epoch timestamps
         out = np.full(len(documents), 0.5, np.float32)
         half_life_s = self.half_life_days * 86_400.0
         for i, doc in enumerate(documents):

@@ -277,6 +277,11 @@ class ThreadBatcher(Generic[T, R]):
                 if not pending.event.is_set():
                     pending.error = exc
                     pending.event.set()
+            # exiting exceptions must still exit: waiters are failed above,
+            # but swallowing KeyboardInterrupt/SystemExit here would keep a
+            # dying interpreter's worker thread spinning
+            if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                raise
 
 
 def bucket_size(n: int, buckets: Sequence[int]) -> int:

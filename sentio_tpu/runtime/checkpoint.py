@@ -126,7 +126,7 @@ def save_pytree(path: str | Path, tree: Any, meta: Optional[dict] = None) -> Pat
 
     manifest = {
         "format_version": FORMAT_VERSION,
-        "created_unix": time.time(),
+        "created_unix": time.time(),  # wall-clock: persisted manifest timestamp
         "structure": _structure_of(tree),
         "keys": {f"a{i}": k for i, k in enumerate(flat)},
         "dtypes": dtypes,
@@ -262,7 +262,7 @@ class CheckpointManager:
         try:
             for name, tree in trees.items():
                 save_pytree(tmp / name, tree, meta=meta)
-            (tmp / ".complete").write_text(str(time.time()))
+            (tmp / ".complete").write_text(str(time.time()))  # wall-clock: persisted completion stamp
             _replace_dir(tmp, final)
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)

@@ -56,6 +56,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from sentio_tpu.analysis.sanitizer import check_engine_invariants, engine_guard
 from sentio_tpu.models.llama import LlamaConfig
 from sentio_tpu.parallel.batcher import bucket_size
 
@@ -550,9 +551,14 @@ class ContinuousBatchingEngine:
             self.cfg, num_pages, page_size, mesh=mesh,
             quantized=kv_quant == "int8",
         )
-        self.allocator = PageAllocator(num_pages)
+        self.allocator = PageAllocator(num_pages)  # guarded-by: engine-thread
 
-        self.slots = [_Slot() for _ in range(max_slots)]
+        # SENTIO_SANITIZE=1: single-driver-thread guard on mutating entry
+        # points + page-conservation / radix-refcount checks per tick. None
+        # when disabled, so the steady-state cost is one attribute test.
+        self._san = engine_guard("ContinuousBatchingEngine")
+
+        self.slots = [_Slot() for _ in range(max_slots)]  # guarded-by: engine-thread
         self.last_tick_active = 0
         # device sub-steps actually executed (the scan runs its full static
         # length; every sub-step streams the weights once) — throughput and
@@ -564,7 +570,7 @@ class ContinuousBatchingEngine:
         # under chunked prefill); decode counts every folded sampled token.
         self.prefill_tokens_total = 0
         self.decode_tokens_total = 0
-        self._queue: list[_Request] = []
+        self._queue: list[_Request] = []  # guarded-by: engine-thread
         # skip-ahead admission: a request too large for the current free
         # pages may be jumped by later, smaller requests — but only
         # head_skip_bound times, after which the head gets strict FIFO
@@ -602,10 +608,10 @@ class ContinuousBatchingEngine:
         # whether the draft pays for itself)
         self.spec_emitted_total = 0
         self.spec_verifies_total = 0
-        self._finished_buffer: list[PagedResult] = []
+        self._finished_buffer: list[PagedResult] = []  # guarded-by: engine-thread
         # (first_tokens_device_array, [slot_idx, ...]) per admission chunk,
         # consumed by the next decode tick
-        self._pending_first: list = []
+        self._pending_first: list = []  # guarded-by: engine-thread
         # optional callable the serving layer sets so ticks stay SHORT when
         # callers are waiting upstream of the engine's own queue (the
         # service inbox) — the engine queue alone can't see them
@@ -868,6 +874,8 @@ class ContinuousBatchingEngine:
     # --------------------------------------------------------------- public
 
     def submit(self, prompt: str, max_new_tokens: int = 64, temperature: float = 0.0) -> int:
+        if self._san is not None:
+            self._san.enter("submit")
         rid = next(self._next_id)
         self._queue.append(_Request(
             rid, prompt, max_new_tokens, temperature,
@@ -885,6 +893,8 @@ class ContinuousBatchingEngine:
         warming never frees pages a live table references. Warmed nodes are
         unpinned: LRU eviction reclaims them under page-pool pressure like
         any other cached prefix."""
+        if self._san is not None:
+            self._san.enter("warm_prefix")
         if self._radix is None:
             return 0
         toks = self.tokenizer.encode(text, add_bos=True)
@@ -926,6 +936,8 @@ class ContinuousBatchingEngine:
         """Abandon a request: queued → dropped; decoding → slot retired and
         pages freed (the tokens so far are discarded). Must be called by the
         engine's single driver thread, like every other engine method."""
+        if self._san is not None:
+            self._san.enter("cancel")
         for idx, req in enumerate(self._queue):
             if req.request_id == request_id:
                 del self._queue[idx]
@@ -949,6 +961,8 @@ class ContinuousBatchingEngine:
         which would poison every later tick. Queued and in-flight requests
         are dropped (their callers were already failed by the layer above);
         weights and compiled programs are kept."""
+        if self._san is not None:
+            self._san.enter("reset")
         import jax
 
         self.pool = init_pool(
@@ -1000,6 +1014,8 @@ class ContinuousBatchingEngine:
         BEFORE the previous tick's fetch, overlapping the host round trip
         with device compute (results then lag one tick). Returns results
         completed this tick."""
+        if self._san is not None:
+            self._san.enter("step")
         self.last_tick_active = 0
         self._admit()
         if self.prefill_chunk is not None:
@@ -1015,6 +1031,10 @@ class ContinuousBatchingEngine:
             prev, self._inflight = self._inflight, record
             if prev is not None:
                 out.extend(self._harvest(prev))
+        if self._san is not None:
+            # page conservation + radix refcounts, checked on the tick that
+            # broke them — not at pool exhaustion three workloads later
+            check_engine_invariants(self)
         return out
 
     # -------------------------------------------------------------- private

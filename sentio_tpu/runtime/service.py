@@ -31,6 +31,11 @@ import time
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
+from sentio_tpu.analysis.sanitizer import (
+    assert_held,
+    bind_engine_owner,
+    make_lock,
+)
 from sentio_tpu.infra.flight import get_flight_recorder
 from sentio_tpu.infra.metrics import get_metrics
 from sentio_tpu.runtime.paged import ContinuousBatchingEngine, PagedResult
@@ -85,19 +90,20 @@ class PagedGenerationService:
     ) -> None:
         self.engine = engine
         self.default_timeout_s = default_timeout_s
-        self._mutex = threading.Lock()  # inbox + bookkeeping ONLY, never device work
-        self._inbox: list[_Ticket] = []
-        self._tickets: dict[int, _Ticket] = {}  # rid -> ticket, post-admission
-        self._pump: Optional[threading.Thread] = None
-        self._pump_running = False
-        self._closed = False
-        self._broken = False  # reset failed: paged path permanently down
+        # inbox + bookkeeping ONLY, never device work
+        self._mutex = make_lock("PagedGenerationService._mutex")
+        self._inbox: list[_Ticket] = []  # guarded-by: _mutex
+        self._tickets: dict[int, _Ticket] = {}  # guarded-by: _mutex
+        self._pump: Optional[threading.Thread] = None  # guarded-by: _mutex
+        self._pump_running = False  # guarded-by: _mutex
+        self._closed = False  # guarded-by: _mutex
+        self._broken = False  # guarded-by: _mutex
         # occupancy telemetry (the serving-path answer to BatcherStats):
         # ticks with >1 active slot are decode steps shared across requests
-        self._ticks = 0
-        self._active_sum = 0
-        self._max_active = 0
-        self._completed = 0
+        self._ticks = 0  # guarded-by: _mutex
+        self._active_sum = 0  # guarded-by: _mutex
+        self._max_active = 0  # guarded-by: _mutex
+        self._completed = 0  # guarded-by: _mutex
 
     # ------------------------------------------------------------------ api
 
@@ -216,9 +222,10 @@ class PagedGenerationService:
     def close(self) -> None:
         with self._mutex:
             self._closed = True
-        if self._pump is not None:
-            self._pump.join(timeout=10.0)
-            self._pump = None
+            pump, self._pump = self._pump, None
+        # join OUTSIDE the mutex: the exiting pump needs it to fail waiters
+        if pump is not None:
+            pump.join(timeout=10.0)
 
     def stats(self) -> dict:
         # engine fields are read without a lock: the pump owns the engine,
@@ -238,7 +245,8 @@ class PagedGenerationService:
 
     # ----------------------------------------------------------------- pump
 
-    def _ensure_pump(self) -> None:  # _mutex held
+    def _ensure_pump(self) -> None:  # lock-held: _mutex
+        assert_held(self._mutex)
         if not self._pump_running:
             self._pump_running = True
             self._pump = threading.Thread(
@@ -247,10 +255,13 @@ class PagedGenerationService:
             self._pump.start()
 
     def _run(self) -> None:
+        # sanitizer: pump threads are born per burst — each new pump is an
+        # authorized ownership transfer of the single-driver engine
+        bind_engine_owner(self.engine)
         # short ticks while callers wait in OUR inbox, not just the engine
         # queue (len() reads are GIL-atomic; this is a hint, not a lock)
         # depth, not a bool: the engine scales its tick size by backlog
-        self.engine.pressure_hint = lambda: len(self._inbox)
+        self.engine.pressure_hint = lambda: len(self._inbox)  # lint: allow(lock-discipline)
         recorder = get_flight_recorder()
         metrics = get_metrics()
         # baselines for diffing the engine's lifetime counters into per-tick
@@ -338,7 +349,7 @@ class PagedGenerationService:
             try:
                 engine = self.engine
                 queued = len(engine._queue)
-                inbox = len(self._inbox)
+                inbox = len(self._inbox)  # lint: allow(lock-discipline) — GIL-atomic depth hint
                 free = engine.allocator.free_pages
                 radix = getattr(engine, "_radix", None)
                 recorder.record_tick(
@@ -443,8 +454,9 @@ class PagedGenerationService:
         except Exception:  # noqa: BLE001
             logger.debug("completion telemetry failed", exc_info=True)
 
-    def _fail_all_locked(self, reason: str) -> None:  # _mutex held
+    def _fail_all_locked(self, reason: str) -> None:  # lock-held: _mutex
         """A dying pump must not leave callers hanging forever."""
+        assert_held(self._mutex)
         for ticket in list(self._tickets.values()) + self._inbox:
             if not ticket.event.is_set():
                 ticket.result = PagedResult(

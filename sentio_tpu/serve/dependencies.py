@@ -35,7 +35,7 @@ class DependencyContainer:
         self._cache: dict[str, Any] = dict(overrides)
         self._lock = threading.RLock()
         self._initialized = False
-        self.started_at = time.time()
+        self.started_at = time.perf_counter()
 
     def _get(self, name: str, build) -> Any:
         with self._lock:

@@ -265,7 +265,7 @@ class HealthHandler:
         return {
             "status": "healthy",
             "service": "sentio-tpu",
-            "uptime_s": round(time.time() - self.container.started_at, 1),
+            "uptime_s": round(time.perf_counter() - self.container.started_at, 1),
         }
 
     def live(self) -> dict[str, Any]:
@@ -278,7 +278,7 @@ class HealthHandler:
 
     async def detailed(self) -> dict[str, Any]:
         async with self._lock:
-            now = time.time()
+            now = time.perf_counter()
             if self._cached is not None and now - self._cached_at < self.CACHE_TTL_S:
                 return {**self._cached, "cached": True}
             try:

@@ -28,6 +28,41 @@ import pytest  # noqa: E402
 
 from sentio_tpu.config import Settings, set_settings  # noqa: E402
 
+# Suites exercising the paged engine / radix cache / decode service run with
+# the runtime sanitizer armed (analysis/sanitizer.py): engine entry points
+# assert the single-driver-thread contract, annotated locks record
+# ownership, and every tick verifies page-pool conservation + radix
+# refcounts. A regression in those invariants fails HERE, on the tick that
+# introduced it, instead of as a pool-exhaustion heisenbug later.
+_SANITIZED_MODULES = {
+    "test_paged",
+    "test_paged_sched",
+    "test_paged_spec",
+    "test_prefix_cache",
+    "test_service",
+    "test_sanitize",
+}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _sanitize_engine_suites(request):
+    # module-scoped (not function-scoped): autouse fixtures instantiate
+    # before other fixtures of the same scope, so the env var is set before
+    # any module-scoped engine fixture constructs its engine — a
+    # function-scoped monkeypatch would arm the sanitizer AFTER those
+    # engines were already built with _san=None
+    module = getattr(request, "module", None)
+    if module is None or module.__name__ not in _SANITIZED_MODULES:
+        yield
+        return
+    prior = os.environ.get("SENTIO_SANITIZE")
+    os.environ["SENTIO_SANITIZE"] = "1"
+    yield
+    if prior is None:
+        os.environ.pop("SENTIO_SANITIZE", None)
+    else:
+        os.environ["SENTIO_SANITIZE"] = prior
+
 
 @pytest.fixture()
 def settings():

@@ -1,0 +1,45 @@
+"""Clean module — the analyzer must report nothing here."""
+import threading
+import time
+from functools import partial
+
+import jax
+
+BUCKETS = (8, 16, 32)
+
+
+def bucket_size(n, buckets):
+    for b in sorted(buckets):
+        if n <= b:
+            return b
+    return n
+
+
+@partial(jax.jit, static_argnames=("steps",))
+def run(x, steps):
+    return x + steps
+
+
+def drive(x, prompts):
+    # bounded: routed through the bucketing helper
+    return run(x, steps=bucket_size(len(prompts), BUCKETS))
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # guarded-by: _lock
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._n
+
+
+def measure(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0

@@ -1,0 +1,10 @@
+"""Seeded wall-clock violation — analyzer test fixture, never imported."""
+import time
+
+
+def elapsed(t0):
+    return time.time() - t0  # VIOLATION wall-clock-duration
+
+
+def stamp():
+    return time.time()  # wall-clock: persisted timestamp — allowed

@@ -1,0 +1,25 @@
+"""Seeded lock-discipline violation — analyzer test fixture, never imported."""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # guarded-by: _lock
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def drain(self):
+        out = list(self._items)  # VIOLATION lock-discipline
+        with self._lock:
+            self._items.clear()
+        return out
+
+    def _compact_locked(self):
+        # name convention: caller holds the lock — no finding
+        self._items.sort()
+
+    def peek(self):  # lock-held: _lock
+        return list(self._items)  # caller-holds marker — no finding

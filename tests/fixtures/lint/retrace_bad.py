@@ -1,0 +1,41 @@
+"""Seeded retrace violations — analyzer test fixture, never imported."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("steps",))
+def decode(tok, steps):
+    out = tok
+    for _ in range(steps):
+        out = out + 1
+    return out
+
+
+def drive(prompts, tok):
+    n = len(prompts)
+    return decode(tok, steps=n)  # VIOLATION retrace-unbounded-static
+
+
+@jax.jit
+def branchy(x):
+    if x.sum() > 0:  # VIOLATION retrace-traced-branch
+        return x
+    return -x
+
+
+@jax.jit
+def casty(x):
+    return int(x)  # VIOLATION retrace-traced-cast
+
+
+class Host:
+    def __init__(self):
+        self.scale = 2.0
+
+    def build(self):
+        @jax.jit
+        def f(x):
+            return x * self.scale  # VIOLATION retrace-host-state
+
+        return f

@@ -1,0 +1,15 @@
+"""Seeded BaseException swallow — analyzer test fixture, never imported."""
+
+
+def guard(fn):
+    try:
+        return fn()
+    except BaseException:  # VIOLATION baseexception-swallow
+        return None
+
+
+def cleanup(fn):
+    try:
+        return fn()
+    except BaseException:
+        raise  # re-raises: no finding

@@ -34,8 +34,11 @@ class TestEmbeddingCache:
 
         cache = EmbeddingCache(max_size=10, ttl_s=1.0)
         cache.put("x", np.zeros(2))
-        real = time_mod.time()
-        monkeypatch.setattr("sentio_tpu.ops.embedder.time.time", lambda: real + 10)
+        # TTLs clock on the monotonic perf_counter (NTP-step immune)
+        real = time_mod.perf_counter()
+        monkeypatch.setattr(
+            "sentio_tpu.ops.embedder.time.perf_counter", lambda: real + 10
+        )
         assert cache.get("x") is None
 
 

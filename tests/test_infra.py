@@ -493,10 +493,11 @@ def test_rate_limiter_sweeps_idle_keys():
     rl._checks_since_sweep = 0
     for i in range(100):
         rl.check(f"10.0.0.{i}", "/x")
-    # age everything out and force a sweep
+    # age everything out and force a sweep (the limiter clocks windows on
+    # the monotonic perf_counter, not the NTP-steppable epoch clock)
     with rl._lock:
         for key in list(rl._events):
-            rl._events[key] = [time.time() - 120.0]
+            rl._events[key] = [time.perf_counter() - 120.0]
         rl._checks_since_sweep = 10_000
     rl.check("fresh-ip", "/x")
     assert len(rl._events) <= 2

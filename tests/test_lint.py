@@ -1,0 +1,173 @@
+"""Static analyzer (sentio_tpu/analysis) self-tests + the tier-1 gate.
+
+Three layers: seeded-violation fixtures must each produce EXACTLY their
+expected finding (the analyzer works), the baseline ratchet must fail new
+findings while passing baselined ones (the gate semantics work), and the
+committed baseline must hold over the real source tree (the repo is clean
+— this test IS ``sentio lint`` in CI).
+"""
+
+from pathlib import Path
+
+from sentio_tpu.analysis.findings import Finding, diff_baseline, load_baseline
+from sentio_tpu.analysis.runner import DEFAULT_BASELINE, lint_paths, run_gate
+
+FIXTURES = Path(__file__).parent / "fixtures" / "lint"
+
+
+def _findings(name: str) -> list[Finding]:
+    return lint_paths([FIXTURES / name])
+
+
+class TestSeededFixtures:
+    def test_retrace_fixture_exact_findings(self):
+        got = [(f.rule, f.line) for f in _findings("retrace_bad.py")]
+        assert got == [
+            ("retrace-unbounded-static", 17),
+            ("retrace-traced-branch", 22),
+            ("retrace-traced-cast", 29),
+            ("retrace-host-state", 39),
+        ]
+
+    def test_lock_fixture_exact_finding(self):
+        got = _findings("locks_bad.py")
+        assert [(f.rule, f.line) for f in got] == [("lock-discipline", 15)]
+        # the finding names both the attribute and the missing lock
+        assert "_items" in got[0].message and "_lock" in got[0].message
+
+    def test_clock_fixture_exact_finding(self):
+        got = _findings("clock_bad.py")
+        assert [(f.rule, f.line) for f in got] == [("wall-clock-duration", 6)]
+        # the annotated stamp() call produced nothing
+
+    def test_swallow_fixture_exact_finding(self):
+        got = _findings("swallow_bad.py")
+        assert [(f.rule, f.line) for f in got] == [("baseexception-swallow", 7)]
+        # the cleanup-and-reraise handler produced nothing
+
+    def test_clean_fixture_is_clean(self):
+        assert _findings("clean.py") == []
+
+
+class TestBaselineRatchet:
+    F1 = Finding(rule="r", path="a.py", line=3, message="m", context="x = 1")
+    F2 = Finding(rule="r", path="a.py", line=9, message="m", context="y = 2")
+
+    def test_new_finding_fails(self):
+        new, matched, stale = diff_baseline(
+            [self.F1, self.F2],
+            [self.F1.to_json()],
+        )
+        assert new == [self.F2]
+        assert matched == [self.F1]
+        assert stale == []
+
+    def test_baselined_finding_passes(self):
+        new, matched, stale = diff_baseline(
+            [self.F1], [self.F1.to_json(), self.F2.to_json()]
+        )
+        assert new == []
+        assert matched == [self.F1]
+        # the fixed F2 entry reports stale so the baseline only shrinks
+        assert len(stale) == 1 and stale[0]["context"] == "y = 2"
+
+    def test_line_moves_do_not_break_matching(self):
+        moved = Finding(rule="r", path="a.py", line=100, message="m",
+                        context="x = 1")
+        new, matched, _ = diff_baseline([moved], [self.F1.to_json()])
+        assert new == [] and matched == [moved]
+
+    def test_multiplicity(self):
+        # two identical findings need two baseline entries
+        new, matched, _ = diff_baseline(
+            [self.F1, self.F1], [self.F1.to_json()]
+        )
+        assert len(matched) == 1 and len(new) == 1
+
+    def test_inline_allow_suppresses(self, tmp_path):
+        bad = tmp_path / "allow.py"
+        bad.write_text(
+            "import time\n\n"
+            "def f(t0):\n"
+            "    return time.time() - t0  # lint: allow(wall-clock-duration)\n"
+        )
+        assert lint_paths([bad]) == []
+
+
+class TestRepoGate:
+    def test_baseline_committed(self):
+        assert DEFAULT_BASELINE.exists()
+        entries = load_baseline(DEFAULT_BASELINE)
+        assert isinstance(entries, list)
+
+    def test_sentio_tpu_gate_green(self):
+        """The committed gate: the analyzer over the real tree must produce
+        no findings beyond the committed baseline, and no stale entries."""
+        result = run_gate()
+        assert result.ok, "NEW findings (fix or baseline):\n" + "\n".join(
+            f.render() for f in result.new
+        )
+        assert not result.stale, (
+            "stale baseline entries (finding fixed — shrink the baseline "
+            "with `sentio lint --update-baseline`):\n"
+            + "\n".join(str(e) for e in result.stale)
+        )
+
+    def test_guarded_annotations_present(self):
+        """The lock checker only has power if the annotations exist: the
+        serving/telemetry classes must declare their guarded state."""
+        import ast
+
+        from sentio_tpu.analysis.findings import SourceFile
+        from sentio_tpu.analysis.locks import collect_guarded
+
+        repo = Path(__file__).resolve().parents[1]
+        expectations = {
+            "sentio_tpu/runtime/service.py": ("PagedGenerationService",
+                                              "_inbox"),
+            "sentio_tpu/infra/flight.py": ("FlightRecorder", "_records"),
+            "sentio_tpu/infra/metrics.py": ("InMemoryMetrics", "histograms"),
+        }
+        for rel, (cls, attr) in expectations.items():
+            p = repo / rel
+            src = SourceFile(path=p, rel=rel, text=p.read_text())
+            guarded = collect_guarded(ast.parse(src.text), src)
+            assert cls in guarded, f"{rel}: {cls} lost its annotations"
+            assert attr in guarded[cls].guarded, (
+                f"{rel}: {cls}.{attr} lost its guarded-by annotation"
+            )
+
+
+class TestCli:
+    def test_cli_lint_green(self, capsys):
+        from sentio_tpu.cli import main
+
+        assert main(["lint"]) == 0
+        out = capsys.readouterr().out
+        assert "0 new" in out
+
+    def test_cli_lint_fails_on_fixture(self, capsys):
+        from sentio_tpu.cli import main
+
+        rc = main(["lint", str(FIXTURES / "clock_bad.py")])
+        assert rc == 1
+        assert "wall-clock-duration" in capsys.readouterr().out
+
+    def test_cli_update_baseline_refuses_partial_tree(self, capsys):
+        # a subset lint must not rewrite (truncate) the full-tree baseline
+        from sentio_tpu.cli import main
+
+        rc = main(["lint", str(FIXTURES / "clean.py"), "--update-baseline"])
+        assert rc == 2
+        assert "full-tree" in capsys.readouterr().err
+        assert load_baseline(DEFAULT_BASELINE), "baseline was truncated"
+
+    def test_cli_lint_json(self, capsys):
+        import json
+
+        from sentio_tpu.cli import main
+
+        assert main(["lint", "--json", str(FIXTURES / "swallow_bad.py")]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["ok"] is False
+        assert payload["new"][0]["rule"] == "baseexception-swallow"
