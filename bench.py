@@ -247,8 +247,23 @@ def phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
         if time.perf_counter() - t_drain > 10.0:
             break
         time.sleep(0.01)
+
+    # compile accounting over the TIMED window (analysis/audit/fence.py):
+    # warmup pays the jit cost up front, so a steady-state run should
+    # report xla_compiles == 0 — any other number is a recompile the
+    # latency percentiles silently absorbed. SENTIO_COMPILE_FENCE=1 arms
+    # the fence so such a recompile fails the bench outright; the graph
+    # burst above only compiled the variants its prompts happened to hit,
+    # so the declared width/prior buckets are warmed explicitly first.
+    from sentio_tpu.analysis.audit import fence
+
+    if fence.enabled():
+        service.warmup()
     get_flight_recorder().clear()
     set_metrics(MetricsCollector())
+    compiles_before = fence.compiles_total()
+    if fence.enabled():
+        fence.arm()
 
     latencies: list[float] = []
     node_ms: dict[str, list[float]] = {}
@@ -280,6 +295,9 @@ def phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
         t.join()
     wall = time.perf_counter() - t_run
     stats = service.stats()
+    if fence.enabled():
+        fence.disarm()
+    xla_compiles = fence.compiles_total() - compiles_before
     service.close()
 
     ticks = stats["ticks"] - stats_before["ticks"]
@@ -308,6 +326,7 @@ def phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
         "avg_active_slots": round(active / max(ticks, 1), 2),
         "max_active_slots": stats["max_active_slots"],
         "ingest_docs_per_s": round(docs_per_s, 1),
+        "xla_compiles": xla_compiles,
     }
     # radix prefix cache: fraction of admitted prompt tokens served
     # read-only from cached KV over the TIMED window (the before/after
@@ -321,7 +340,8 @@ def phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
         f"qps={result['qps']} occupancy={result['avg_active_slots']} "
         f"nodes={result['node_p50_ms']} "
         f"ttft={result.get('ttft_ms')} tpot={result.get('tpot_ms')} "
-        f"prefix_hit={result.get('prefix_hit_token_ratio')}")
+        f"prefix_hit={result.get('prefix_hit_token_ratio')} "
+        f"xla_compiles={result['xla_compiles']}")
     return result
 
 

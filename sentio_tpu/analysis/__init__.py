@@ -17,6 +17,14 @@ machine-checked, twice over:
   contract, annotated locks record ownership so lock-held helpers can
   assert it, and every engine tick verifies page-pool conservation and
   radix refcount consistency.
+* ``sentio audit`` (:mod:`sentio_tpu.analysis.audit`) — the artifact-level
+  half the AST cannot see: every ``jit_family`` site is AOT-lowered over
+  its declared variant space on a tiny CPU config and gated against the
+  committed ``analysis/compile_manifest.json`` (variant count, donation
+  aliasing, mesh sharding, static HBM). ``SENTIO_COMPILE_FENCE=1`` arms
+  the runtime half: post-warmup recompiles become hard errors.
+
+``sentio check`` runs lint + audit as one gate.
 
 Annotation guide
 ================

@@ -1,0 +1,33 @@
+"""Compile-manifest audit: AOT accounting for every jitted family.
+
+``sentio lint`` (PR 3) pattern-matches the AST; it cannot see what XLA will
+really build. This package closes that gap at the artifact level, the way
+production TPU serving stacks gate recompile and donation regressions
+before they reach a pod:
+
+* **registry** — ``jit_family(name, ...)`` replaces bare
+  ``@partial(jax.jit, ...)`` at every serving-critical jit site. It applies
+  ``jax.jit`` itself (single source of truth for static/donated argnums),
+  records the family in a process-global registry, and counts XLA cache
+  misses per call — the raw signal for both telemetry and the fence.
+* **fence** — ``SENTIO_COMPILE_FENCE=1`` + ``fence.arm()`` (after warmup)
+  turns any further compile at a registered family into a hard
+  ``CompileFenceError`` carrying the family name and the abstract call
+  signature. Compile counts/events feed ``sentio_tpu_xla_compiles_total``
+  and the flight recorder's per-tick events.
+* **specs / lowering** — builds tiny-config engines on CPU, enumerates each
+  family's DECLARED variant space (the same ``bucket_size`` /
+  ``_prefill_width`` / ``_prior_bucket`` / tick-ladder helpers the runtime
+  uses), and abstractly lowers every variant (``.lower()`` on tiny shapes —
+  no compute) to extract donation aliasing, static HBM footprint, and mesh
+  sharding signatures.
+* **manifest / runner** — diffs the report against the committed
+  ``analysis/compile_manifest.json`` with the same ratchet semantics as the
+  lint baseline: a new variant, a lost donation, HBM growth, or sharding
+  drift on a hot-path array fails ``sentio audit`` (and tier-1);
+  ``--update-manifest`` re-records honestly.
+"""
+
+from sentio_tpu.analysis.audit.registry import jit_family  # noqa: F401
+
+__all__ = ["jit_family"]

@@ -1,0 +1,165 @@
+"""Compile-manifest IO + ratchet diff.
+
+Same gate semantics as the lint baseline (analysis/findings.py): the
+committed ``analysis/compile_manifest.json`` is the promise, the fresh
+audit report is the reality, and only REGRESSIONS fail —
+
+* a family or variant that exists now but not in the manifest (the compile
+  space grew),
+* a changed static/donate contract,
+* a donated buffer that lowering no longer aliases,
+* static HBM footprint growth on any variant,
+* a sharding-spec change on any hot-path array or lowered signature.
+
+Improvements (variant removed, donation gained, footprint shrunk) report as
+STALE — the run stays green but nags for ``--update-manifest``, so the
+manifest only drifts when a human re-records it deliberately. ``info``
+fields (flops / bytes accessed) are never gated: they are XLA facts, not
+contracts.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+__all__ = [
+    "DEFAULT_MANIFEST",
+    "AuditDiff",
+    "load_manifest",
+    "save_manifest",
+    "diff_manifest",
+]
+
+DEFAULT_MANIFEST = Path(__file__).resolve().parents[1] / "compile_manifest.json"
+
+
+def load_manifest(path: str | Path) -> Optional[dict]:
+    p = Path(path)
+    if not p.exists():
+        return None
+    data = json.loads(p.read_text())
+    if not isinstance(data, dict):
+        raise ValueError(f"manifest {p} must be a JSON object")
+    return data
+
+
+def save_manifest(path: str | Path, report: dict) -> None:
+    Path(path).write_text(
+        json.dumps(report, indent=1, sort_keys=True) + "\n"
+    )
+
+
+@dataclass
+class AuditDiff:
+    regressions: list[dict] = field(default_factory=list)
+    stale: list[dict] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.regressions
+
+    def summary(self) -> str:
+        return (
+            f"{len(self.regressions)} regressions, "
+            f"{len(self.stale)} stale manifest entries"
+        )
+
+
+def _fail(diff: AuditDiff, kind: str, where: str, detail: str) -> None:
+    diff.regressions.append({"kind": kind, "where": where, "detail": detail})
+
+
+def _stale(diff: AuditDiff, kind: str, where: str, detail: str) -> None:
+    diff.stale.append({"kind": kind, "where": where, "detail": detail})
+
+
+def _diff_variant(diff: AuditDiff, where: str, cur: dict, man: dict) -> None:
+    if cur.get("aliased", 0) < man.get("aliased", 0):
+        _fail(diff, "donation-dropped", where,
+              f"lowering aliases {cur.get('aliased', 0)} donated leaves, "
+              f"manifest promises {man.get('aliased', 0)}")
+    elif (cur.get("aliased"), cur.get("donated_leaves")) != (
+            man.get("aliased"), man.get("donated_leaves")):
+        _stale(diff, "donation-changed", where,
+               f"{man.get('aliased')}/{man.get('donated_leaves')} -> "
+               f"{cur.get('aliased')}/{cur.get('donated_leaves')}")
+    cur_hbm = cur.get("arg_bytes", 0) + cur.get("out_bytes", 0)
+    man_hbm = man.get("arg_bytes", 0) + man.get("out_bytes", 0)
+    if cur_hbm > man_hbm:
+        _fail(diff, "hbm-growth", where,
+              f"static footprint {man_hbm} -> {cur_hbm} bytes")
+    elif cur_hbm < man_hbm:
+        _stale(diff, "hbm-shrunk", where, f"{man_hbm} -> {cur_hbm} bytes")
+    if cur.get("arg_shardings") != man.get("arg_shardings"):
+        _fail(diff, "sharding-drift", where,
+              f"lowered arg shardings {man.get('arg_shardings')} -> "
+              f"{cur.get('arg_shardings')}")
+
+
+def _diff_family(diff: AuditDiff, name: str, cur: dict, man: dict) -> None:
+    for key in ("static_argnames", "donate_argnums"):
+        if list(cur.get(key, [])) != list(man.get(key, [])):
+            _fail(diff, "contract-changed", name,
+                  f"{key}: {man.get(key)} -> {cur.get(key)}")
+    cur_v, man_v = cur.get("variants", {}), man.get("variants", {})
+    for vkey in sorted(set(cur_v) - set(man_v)):
+        _fail(diff, "new-variant", f"{name}[{vkey}]",
+              "compile variant not in manifest — the variant space grew")
+    for vkey in sorted(set(man_v) - set(cur_v)):
+        _stale(diff, "variant-removed", f"{name}[{vkey}]",
+               "manifest variant no longer declared")
+    for vkey in sorted(set(cur_v) & set(man_v)):
+        _diff_variant(diff, f"{name}[{vkey}]", cur_v[vkey], man_v[vkey])
+
+
+def _diff_sharding(diff: AuditDiff, cur: Optional[dict],
+                   man: Optional[dict]) -> None:
+    if man is None and cur is None:
+        return
+    if cur is None:
+        _stale(diff, "sharding-unavailable", "sharding",
+               "report built with < 2 devices; mesh section not audited")
+        return
+    if man is None:
+        _fail(diff, "sharding-drift", "sharding",
+              "manifest has no sharding section; run --update-manifest")
+        return
+    for section in ("state", "lowered"):
+        cur_s, man_s = cur.get(section, {}), man.get(section, {})
+        for key in sorted(set(cur_s) - set(man_s)):
+            _fail(diff, "sharding-drift", f"sharding.{section}.{key}",
+                  "new sharded array/signature not in manifest")
+        for key in sorted(set(man_s) - set(cur_s)):
+            _stale(diff, "sharding-removed", f"sharding.{section}.{key}",
+                   "manifest entry no longer present")
+        for key in sorted(set(cur_s) & set(man_s)):
+            if section == "state":
+                if cur_s[key] != man_s[key]:
+                    _fail(diff, "sharding-drift", f"sharding.state.{key}",
+                          f"{man_s[key]} -> {cur_s[key]} (replication creep?)")
+            else:
+                _diff_variant(diff, f"sharding.lowered.{key}",
+                              cur_s[key], man_s[key])
+
+
+def diff_manifest(report: dict, manifest: Optional[dict]) -> AuditDiff:
+    diff = AuditDiff()
+    if manifest is None:
+        _fail(diff, "no-manifest", "manifest",
+              "no committed compile manifest; run "
+              "`sentio audit --update-manifest` and commit the result")
+        return diff
+    cur_f = report.get("families", {})
+    man_f = manifest.get("families", {})
+    for name in sorted(set(cur_f) - set(man_f)):
+        _fail(diff, "new-family", name,
+              "jit family not in manifest — new compile surface")
+    for name in sorted(set(man_f) - set(cur_f)):
+        _stale(diff, "family-removed", name, "manifest family not audited")
+    for name in sorted(set(cur_f) & set(man_f)):
+        _diff_family(diff, name, cur_f[name], man_f[name])
+    _diff_sharding(diff, report.get("sharding"), manifest.get("sharding"))
+    return diff

@@ -1,0 +1,139 @@
+"""``sentio audit`` orchestration: report -> coverage check -> manifest gate.
+
+``run_audit`` builds the tiny-config report (specs.py), verifies every
+``jit_family`` registered in this process has an audit spec (a NEW jit site
+without one fails — the registry is the discovery mechanism), and diffs
+against the committed manifest. ``main`` is the CLI entry point; when it
+owns the process (jax not yet imported) it pins the platform to CPU with
+two virtual devices so the committed manifest is reproducible on any host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from dataclasses import dataclass
+from typing import Optional
+
+__all__ = ["run_audit", "main", "AuditResult"]
+
+
+@dataclass
+class AuditResult:
+    report: dict
+    diff: "object"  # AuditDiff
+
+    @property
+    def ok(self) -> bool:
+        return self.diff.ok
+
+    def variant_count(self) -> int:
+        return sum(
+            len(f.get("variants", {}))
+            for f in self.report.get("families", {}).values()
+        )
+
+
+def _check_coverage(report: dict, diff) -> None:
+    """Every family name registered in this process must have been lowered
+    by the report — adding a ``jit_family`` site without an audit spec is
+    itself a finding. Unregistered test fixtures use ``register=False``."""
+    from sentio_tpu.analysis.audit.manifest import _fail
+    from sentio_tpu.analysis.audit.registry import families
+
+    audited = set(report.get("families", {}))
+    for name in sorted(set(families()) - audited):
+        _fail(diff, "family-unaudited", name,
+              "jit_family registered but analysis/audit/specs.py has no "
+              "variant spec for it")
+
+
+def run_audit(manifest_path: Optional[str] = None,
+              include_mesh: bool = True) -> AuditResult:
+    from sentio_tpu.analysis.audit.manifest import (
+        DEFAULT_MANIFEST,
+        diff_manifest,
+        load_manifest,
+    )
+    from sentio_tpu.analysis.audit.specs import build_audit_report
+
+    report = build_audit_report(include_mesh=include_mesh)
+    manifest = load_manifest(manifest_path or DEFAULT_MANIFEST)
+    diff = diff_manifest(report, manifest)
+    _check_coverage(report, diff)
+    return AuditResult(report=report, diff=diff)
+
+
+def _pin_platform() -> None:
+    """CPU + 2 virtual devices, but only when this process has not already
+    initialized jax (in-process callers keep their platform)."""
+    if "jax" in sys.modules:
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    from sentio_tpu.analysis.audit.manifest import DEFAULT_MANIFEST
+
+    parser = argparse.ArgumentParser(
+        prog="sentio audit",
+        description="AOT-lower every registered jit family on a tiny CPU "
+                    "config and gate variants/donation/sharding/HBM against "
+                    "the committed compile manifest",
+    )
+    parser.add_argument("--manifest", default=str(DEFAULT_MANIFEST),
+                        help="manifest JSON (default: "
+                             "analysis/compile_manifest.json)")
+    parser.add_argument("--update-manifest", action="store_true",
+                        help="re-record the manifest from the current audit")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output")
+    parser.add_argument("--no-mesh", action="store_true",
+                        help="skip the 2-device sharding section")
+    args = parser.parse_args(argv)
+
+    _pin_platform()
+    result = run_audit(manifest_path=args.manifest,
+                       include_mesh=not args.no_mesh)
+
+    if args.update_manifest:
+        from sentio_tpu.analysis.audit.manifest import save_manifest
+
+        save_manifest(args.manifest, result.report)
+        print(
+            f"manifest rewritten: {len(result.report['families'])} families, "
+            f"{result.variant_count()} variants -> {args.manifest}",
+            file=sys.stderr,
+        )
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "ok": result.ok,
+            "families": len(result.report["families"]),
+            "variants": result.variant_count(),
+            "regressions": result.diff.regressions,
+            "stale": result.diff.stale,
+        }, indent=1))
+    else:
+        for r in result.diff.regressions:
+            print(f"FAIL  {r['kind']}: {r['where']} — {r['detail']}")
+        for s in result.diff.stale:
+            print(f"stale {s['kind']}: {s['where']} — {s['detail']} "
+                  f"(run --update-manifest)")
+        print(
+            f"audited {len(result.report['families'])} families / "
+            f"{result.variant_count()} variants: {result.diff.summary()}"
+        )
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -1,0 +1,397 @@
+"""Audit targets: tiny-config engines + per-family variant lowering.
+
+The audit builds REAL engines at micro scale (1-layer, dim-16 model on
+CPU), asks each for its declared compile-variant space
+(``compile_variant_space()`` — derived from the same ``bucket_size`` /
+``_prefill_width`` / ``_prior_bucket`` / tick-ladder helpers the serving
+paths call), and abstractly lowers every declared variant through the
+engine's OWN jitted functions. Nothing here re-implements a signature: the
+args handed to ``.lower()`` are the engine's live state arrays plus
+host-numpy call args shaped exactly like ``_dispatch_tick`` /
+``_prefill_chunk`` / ``generate`` would shape them.
+
+Variant-space honesty notes:
+
+* the spaces scale with engine config — the micro configs here keep the
+  tier-1 lowering count at ~100; a production-config audit enumerates the
+  production bucket sets with the same code;
+* ``speculative.spec_generate`` (the contiguous fallback path) shares its
+  batch/width/window axes with ``engine.generate_fused`` (audited there);
+  its variants here sweep the static axes (steps x sampled) at one
+  representative shape point;
+* mesh variants lower the same families with 2-device tp-sharded state and
+  record the ``mhlo.sharding`` argument signatures; the live params/pool
+  sharding specs land in the report's ``sharding`` section.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["build_audit_report", "MICRO_VOCAB"]
+
+MICRO_VOCAB = 320  # ByteTokenizer floor is 261
+
+
+def _micro_cfg():
+    from sentio_tpu.models.llama import LlamaConfig
+
+    return LlamaConfig(
+        vocab_size=MICRO_VOCAB, dim=16, n_layers=1, n_heads=2, n_kv_heads=2,
+        mlp_dim=32, max_len=64, rope_theta=10_000.0,
+    )
+
+
+def _micro_draft_cfg():
+    from sentio_tpu.models.llama import LlamaConfig
+
+    return LlamaConfig(
+        vocab_size=MICRO_VOCAB, dim=8, n_layers=1, n_heads=2, n_kv_heads=2,
+        mlp_dim=16, max_len=64, rope_theta=10_000.0,
+    )
+
+
+def _variant_key(desc: dict) -> str:
+    return "|".join(f"{k}={desc[k]}" for k in sorted(desc))
+
+
+# ------------------------------------------------------------------- engines
+
+
+def _paged_engine(prefill_chunk: Optional[int] = 8, draft: bool = False):
+    import jax
+
+    from sentio_tpu.models.llama import init_llama
+    from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+
+    kwargs: dict = {}
+    if draft:
+        dcfg = _micro_draft_cfg()
+        kwargs.update(
+            draft_params=init_llama(jax.random.PRNGKey(7), dcfg),
+            draft_config=dcfg, spec_k=2, prefill_chunk=None,
+        )
+    else:
+        kwargs.update(prefill_chunk=prefill_chunk)
+    return ContinuousBatchingEngine(
+        model_config=_micro_cfg(), max_slots=2, page_size=8,
+        max_pages_per_seq=4, steps_per_tick=4, max_tick_steps=8,
+        use_pallas=False, **kwargs,
+    )
+
+
+def _generator_engine(mesh=None):
+    from sentio_tpu.config import GeneratorConfig
+    from sentio_tpu.runtime.engine import GeneratorEngine
+
+    eng = GeneratorEngine(
+        config=GeneratorConfig(
+            provider="tpu", model_preset="tiny",
+            max_prompt_tokens=24, max_new_tokens=8,
+        ),
+        model_config=_micro_cfg(), mesh=mesh,
+    )
+    # instance-level bucket sets: the audit engine's variant space is the
+    # product of these, and lowering ~100 variants must stay inside a
+    # tier-1 budget. compile_variant_space()/_encode_batch/_stable_steps
+    # all read self.*, so the instance stays self-consistent — a
+    # production-config audit simply skips these overrides.
+    eng.BATCH_BUCKETS = (1, 4)
+    eng.STEP_BUCKETS = (1, 8, 32)
+    return eng
+
+
+# ------------------------------------------------------- per-family lowering
+
+
+def _paged_args(eng, family: str, desc: dict):
+    """(args, static_kwargs) for one paged-engine variant descriptor —
+    shaped exactly like the engine's own dispatch sites shape them."""
+    import numpy as np
+
+    S = eng.max_slots
+    page = eng.page_size
+
+    def prefill_common(rows: int, width: int):
+        ids = np.full((rows, width), eng.tokenizer.pad_id, np.int32)
+        lens = np.ones(rows, np.int32)
+        temps = np.zeros(rows, np.float32)
+        scat = np.zeros((rows, width // page), np.int32)
+        positions = np.zeros((rows, width), np.int32)
+        return ids, positions, lens, temps, scat
+
+    if family == "paged.step_n":
+        return (
+            (eng.params, np.zeros(S, np.int32), np.zeros(S, np.int32),
+             np.zeros(S, bool), eng._page_table.copy(), eng.pool.k,
+             eng.pool.v, eng._rng, np.zeros(S, np.float32),
+             np.zeros(S, np.int32)),
+            {"steps": desc["steps"]},
+        )
+    if family == "paged.merge_admitted":
+        r = desc["rows"]
+        return (
+            (np.zeros(S, np.int32), np.zeros(S, np.int32), np.zeros(S, bool),
+             np.zeros(r, np.int32), np.zeros(r, np.int32),
+             np.full(r, S, np.int32)),
+            {},
+        )
+    if family == "paged.prefill_scatter":
+        ids, positions, lens, temps, scat = prefill_common(
+            desc["rows"], desc["width"])
+        return (
+            (eng.params, ids, positions, lens, eng._rng, temps, scat,
+             eng.pool.k, eng.pool.v),
+            {},
+        )
+    if family == "paged.prior_prefill_scatter":
+        rows = desc["rows"]
+        ids, positions, lens, temps, scat = prefill_common(
+            rows, desc["width"])
+        prior = np.zeros((rows, desc["pnb"]), np.int32)
+        n_prior = np.zeros(rows, np.int32)
+        return (
+            (eng.params, ids, positions, lens, eng._rng, temps, scat,
+             eng.pool.k, eng.pool.v, prior, n_prior),
+            {"do_sample": desc["do_sample"]},
+        )
+    if family == "paged.draft_prefill":
+        eng._ensure_draft_cache()
+        rows = desc["rows"]
+        ids = np.full((rows, desc["width"]), eng.tokenizer.pad_id, np.int32)
+        return (
+            (eng.draft_params, ids, eng._spec_dk, eng._spec_dv,
+             np.full(rows, S, np.int32), np.ones(rows, np.int32)),
+            {},
+        )
+    if family == "paged_spec.spec_tick":
+        eng._ensure_draft_cache()
+        steps = desc["steps"]
+        return (
+            (eng.params, eng.draft_params, np.zeros(S, np.int32),
+             np.zeros(S, np.int32), np.zeros(S, bool),
+             eng._page_table.copy(), eng.pool.k, eng.pool.v, eng._spec_dk,
+             eng._spec_dv, eng._rng, np.zeros(S, np.float32),
+             np.zeros(S, np.int32)),
+            {"k": eng.spec_k, "out_w": steps + eng.spec_k + 1},
+        )
+    raise KeyError(f"no arg builder for paged family {family!r}")
+
+
+def _paged_fn(eng, family: str):
+    return {
+        "paged.step_n": eng._step_n,
+        "paged.merge_admitted": eng._merge_admitted,
+        "paged.prefill_scatter": eng._prefill_scatter,
+        "paged.prior_prefill_scatter": eng._prior_prefill_scatter,
+        "paged.draft_prefill": getattr(eng, "_draft_prefill", None),
+        "paged_spec.spec_tick": eng._spec_tick,
+    }[family]
+
+
+def _generator_args(eng, family: str, desc: dict):
+    import numpy as np
+
+    from sentio_tpu.models.llama import init_cache
+
+    cfg = eng.model_config
+    rows = desc["rows"]
+    window = desc["window"]
+    cache = init_cache(cfg, rows, window)
+
+    def ids_pos_mask(width: int):
+        ids = np.full((rows, width), eng.tokenizer.pad_id, np.int32)
+        positions = np.zeros((rows, width), np.int32)
+        pad_mask = np.zeros((rows, width), bool)
+        return ids, positions, pad_mask
+
+    if family == "engine.prefill":
+        ids, positions, pad_mask = ids_pos_mask(desc["width"])
+        return (eng.params, ids, positions, cache, pad_mask), {}
+    if family == "engine.decode_step":
+        return (
+            (eng.params, np.zeros((rows, 1), np.int32),
+             np.zeros(rows, np.int32), cache, eng._rng, np.float32(0.0),
+             np.int32(0)),
+            {},
+        )
+    if family == "engine.generate_fused":
+        ids, positions, pad_mask = ids_pos_mask(desc["width"])
+        return (
+            (eng.params, ids, positions, np.ones(rows, np.int32), cache,
+             eng._rng, np.float32(0.0)),
+            {"steps": desc["steps"], "top_k": np.int32(0),
+             "eos_id": eng.tokenizer.eos_id, "pad_mask": pad_mask},
+        )
+    raise KeyError(f"no arg builder for generator family {family!r}")
+
+
+def _generator_fn(eng, family: str):
+    return {
+        "engine.prefill": eng._prefill,
+        "engine.decode_step": eng._decode_step,
+        "engine.generate_fused": eng._generate_fused,
+    }[family]
+
+
+# --------------------------------------------------------------- the report
+
+
+def _audit_family(name, fn, variants, arg_builder) -> dict:
+    from sentio_tpu.analysis.audit.lowering import audit_variant
+    from sentio_tpu.analysis.audit.registry import get_family
+
+    fam = get_family(name)
+    donate = fam.donate_argnums if fam is not None else ()
+    statics = fam.static_argnames if fam is not None else ()
+    entry: dict = {
+        "static_argnames": list(statics),
+        "donate_argnums": list(donate),
+        "variant_count": len(variants),
+        "variants": {},
+    }
+    for desc in variants:
+        args, static_kwargs = arg_builder(desc)
+        entry["variants"][_variant_key(desc)] = audit_variant(
+            fn, donate, args, static_kwargs
+        )
+    return entry
+
+
+def _sharding_section(mesh) -> dict:
+    """Live-array sharding specs for the hot-path state: params leaves and
+    the paged KV pool. A leaf whose spec string changes (e.g. silently
+    replicating a tp-sharded weight) fails the manifest diff."""
+    import jax
+
+    out: dict = {}
+    gen = _generator_engine(mesh=mesh)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(gen.params)[0]:
+        key = "params" + jax.tree_util.keystr(path)
+        sharding = getattr(leaf, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        out[key] = str(spec)
+
+    from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+
+    paged = ContinuousBatchingEngine(
+        model_config=gen.model_config, params=gen.params,
+        tokenizer=gen.tokenizer, max_slots=2, page_size=8,
+        max_pages_per_seq=4, mesh=mesh, use_pallas=False,
+    )
+    out["paged.pool.k"] = str(paged.pool.k.sharding.spec)
+    out["paged.pool.v"] = str(paged.pool.v.sharding.spec)
+
+    # mesh lowerings: the mhlo.sharding argument signature of the two
+    # hottest families — replication creep inside the COMPILED artifact
+    from sentio_tpu.analysis.audit.lowering import audit_variant
+
+    mesh_variants: dict = {}
+    steps = min(paged.tick_step_sizes())
+    args, statics = _paged_args(paged, "paged.step_n", {"steps": steps})
+    mesh_variants["paged.step_n"] = dict(
+        audit_variant(paged._step_n, (5, 6), args, statics,
+                      collect_shardings=True),
+        variant=f"steps={steps}",
+    )
+    ids, positions, lens, cache, _n, window, pad_mask = gen._encode_batch(
+        ["warm"], 4)
+    low = audit_variant(
+        gen._prefill, (), (gen.params, ids, positions, cache, pad_mask), {},
+        collect_shardings=True,
+    )
+    mesh_variants["engine.prefill"] = dict(low, variant=f"window={window}")
+    return {"state": out, "lowered": mesh_variants}
+
+
+def build_audit_report(include_mesh: bool = True) -> dict:
+    """Build every audit engine, lower every declared variant, and return
+    the manifest-shaped report dict."""
+    import jax
+
+    from sentio_tpu.models.llama import init_llama, llama_forward, llama_loss
+
+    report: dict = {"version": 1, "families": {}, "sharding": None}
+
+    plain = _paged_engine(prefill_chunk=8)
+    plain_space = plain.compile_variant_space()
+    for name in ("paged.step_n", "paged.merge_admitted",
+                 "paged.prefill_scatter", "paged.prior_prefill_scatter"):
+        report["families"][name] = _audit_family(
+            name, _paged_fn(plain, name), plain_space[name],
+            lambda desc, _n=name: _paged_args(plain, _n, desc),
+        )
+
+    spec = _paged_engine(draft=True)
+    spec_space = spec.compile_variant_space()
+    for name in ("paged.draft_prefill", "paged_spec.spec_tick"):
+        report["families"][name] = _audit_family(
+            name, _paged_fn(spec, name), spec_space[name],
+            lambda desc, _n=name: _paged_args(spec, _n, desc),
+        )
+
+    gen = _generator_engine()
+    gen_space = gen.compile_variant_space()
+    for name in ("engine.prefill", "engine.decode_step",
+                 "engine.generate_fused"):
+        report["families"][name] = _audit_family(
+            name, _generator_fn(gen, name), gen_space[name],
+            lambda desc, _n=name: _generator_args(gen, _n, desc),
+        )
+
+    # contiguous speculative fallback: static axes at one shape point (the
+    # batch/width/window axes are the generator's, audited above)
+    from sentio_tpu.models.llama import init_cache
+    from sentio_tpu.runtime.speculative import build_spec_generate
+
+    import numpy as np
+
+    cfg, dcfg = gen.model_config, _micro_draft_cfg()
+    spec_fn = build_spec_generate(
+        llama_forward, cfg, llama_forward, dcfg,
+        eos_id=gen.tokenizer.eos_id, attn_fn=None,
+    )
+    draft_params = init_llama(jax.random.PRNGKey(11), dcfg)
+    spec_k = 2
+    rows, width, window = 1, 32, 64
+    steps_set = [b for b in gen.STEP_BUCKETS if b <= cfg.max_len - 1]
+
+    def spec_args(desc):
+        ids = np.full((rows, width), gen.tokenizer.pad_id, np.int32)
+        return (
+            (gen.params, draft_params, ids, np.zeros((rows, width), np.int32),
+             np.ones(rows, np.int32), init_cache(cfg, rows, window),
+             init_cache(dcfg, rows, window)),
+            {"steps": desc["steps"], "k": spec_k,
+             "pad_mask": np.zeros((rows, width), bool), "rng": gen._rng,
+             "temperature": np.float32(0.0), "sampled": desc["sampled"]},
+        )
+
+    report["families"]["speculative.spec_generate"] = _audit_family(
+        "speculative.spec_generate", spec_fn,
+        [{"steps": s, "sampled": smp}
+         for s in steps_set for smp in (False, True)],
+        spec_args,
+    )
+
+    # training objective (multi-chip dry-run train step): one canonical shape
+    def loss_args(desc):
+        b, t = desc["b"], desc["t"]
+        return (
+            (gen.params, cfg, np.zeros((b, t + 1), np.int32),
+             np.ones((b, t + 1), np.int32)),
+            {},
+        )
+
+    report["families"]["llama.loss"] = _audit_family(
+        "llama.loss", llama_loss, [{"b": 2, "t": 16}], loss_args,
+    )
+
+    if include_mesh and len(jax.devices()) >= 2:
+        from sentio_tpu.config import MeshConfig
+        from sentio_tpu.parallel.mesh import build_mesh
+
+        mesh = build_mesh(MeshConfig(dp_size=1, tp_size=2),
+                          devices=jax.devices()[:2])
+        report["sharding"] = _sharding_section(mesh)
+    return report

@@ -72,6 +72,15 @@ def _is_jax_jit(node: ast.AST) -> bool:
     return isinstance(node, ast.Name) and node.id in ("jit", "pjit")
 
 
+def _is_jit_family(node: ast.AST) -> bool:
+    """``jit_family(...)`` — the audit registry decorator (analysis/audit/
+    registry.py) applies ``jax.jit`` itself, so its sites carry the same
+    retrace hazards as bare jit sites and must keep the same coverage."""
+    if isinstance(node, ast.Attribute) and node.attr == "jit_family":
+        return True
+    return isinstance(node, ast.Name) and node.id == "jit_family"
+
+
 def _jit_decorator_statics(dec: ast.AST) -> Optional[tuple[list[str], list[int]]]:
     """If ``dec`` is a jit decorator → (static_argnames, static_argnums);
     None otherwise."""
@@ -84,7 +93,8 @@ def _jit_decorator_statics(dec: ast.AST) -> Optional[tuple[list[str], list[int]]
         ) or (isinstance(fn, ast.Attribute) and fn.attr == "partial")
         if is_partial and dec.args and _is_jax_jit(dec.args[0]):
             return _extract_statics(dec.keywords)
-        if _is_jax_jit(fn):  # @jax.jit(static_argnames=...) direct form
+        if _is_jax_jit(fn) or _is_jit_family(fn):
+            # @jax.jit(static_argnames=...) / @jit_family("name", ...) forms
             return _extract_statics(dec.keywords)
     return None
 

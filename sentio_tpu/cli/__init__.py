@@ -1,5 +1,5 @@
 """Command-line interface: ingest / serve / bench / info / trace / convert /
-lint.
+lint / audit / check.
 
 Parity with /root/reference/src/cli/ (Typer app with ``ingest``/``api``/
 ``ui``/``run``/``studio`` sub-apps, __init__.py:17-23 there) on stdlib
@@ -208,6 +208,64 @@ def _cmd_lint(args: argparse.Namespace) -> int:
     return lint_main(forwarded)
 
 
+def _cmd_audit(args: argparse.Namespace) -> int:
+    """AOT-lower every registered jit family on a tiny CPU config and gate
+    compile variants / donation aliasing / sharding / static HBM against
+    the committed analysis/compile_manifest.json. Exit 1 on regressions."""
+    from sentio_tpu.analysis.audit.runner import main as audit_main
+
+    forwarded: list[str] = []
+    if args.manifest:
+        forwarded += ["--manifest", args.manifest]
+    if args.update_manifest:
+        forwarded.append("--update-manifest")
+    if args.json:
+        forwarded.append("--json")
+    if args.no_mesh:
+        forwarded.append("--no-mesh")
+    return audit_main(forwarded)
+
+
+def _cmd_check(args: argparse.Namespace) -> int:
+    """The one-stop static gate: ``sentio lint`` (AST analysis vs baseline)
+    then ``sentio audit`` (compile manifest). Exit non-zero when either
+    fails; both always run so one invocation reports everything. With
+    ``--json`` the two results nest under ONE parseable envelope."""
+    if not args.json:
+        from sentio_tpu.analysis.audit.runner import main as audit_main
+        from sentio_tpu.analysis.runner import main as lint_main
+
+        lint_rc = lint_main([])
+        audit_rc = audit_main([])
+        return lint_rc or audit_rc
+
+    from sentio_tpu.analysis.audit.runner import _pin_platform, run_audit
+    from sentio_tpu.analysis.runner import run_gate
+
+    lint = run_gate()
+    _pin_platform()
+    audit = run_audit()
+    ok = lint.ok and audit.ok
+    print(json.dumps({
+        "ok": ok,
+        "lint": {
+            "ok": lint.ok,
+            "new": [dict(f.to_json(), line=f.line) for f in lint.new],
+            "baselined": [dict(f.to_json(), line=f.line)
+                          for f in lint.matched],
+            "stale": lint.stale,
+        },
+        "audit": {
+            "ok": audit.ok,
+            "families": len(audit.report["families"]),
+            "variants": audit.variant_count(),
+            "regressions": audit.diff.regressions,
+            "stale": audit.diff.stale,
+        },
+    }, indent=1))
+    return 0 if ok else 1
+
+
 def _cmd_info(args: argparse.Namespace) -> int:
     import jax
 
@@ -318,6 +376,29 @@ def main(argv: list[str] | None = None) -> int:
     p_lint.add_argument("--json", action="store_true",
                         help="machine-readable output")
     p_lint.set_defaults(fn=_cmd_lint)
+
+    p_audit = sub.add_parser(
+        "audit",
+        help="compile-manifest audit: AOT-lower every jit family and gate "
+             "variants/donation/sharding/HBM vs the committed manifest",
+    )
+    p_audit.add_argument("--manifest", default="",
+                         help="manifest JSON (default: "
+                              "analysis/compile_manifest.json)")
+    p_audit.add_argument("--update-manifest", action="store_true",
+                         help="re-record the manifest from the current audit")
+    p_audit.add_argument("--json", action="store_true",
+                         help="machine-readable output")
+    p_audit.add_argument("--no-mesh", action="store_true",
+                         help="skip the 2-device sharding section")
+    p_audit.set_defaults(fn=_cmd_audit)
+
+    p_check = sub.add_parser(
+        "check", help="run `sentio lint` and `sentio audit` as one gate"
+    )
+    p_check.add_argument("--json", action="store_true",
+                         help="machine-readable output")
+    p_check.set_defaults(fn=_cmd_check)
 
     p_info = sub.add_parser("info", help="print version/device/config info")
     p_info.set_defaults(fn=_cmd_info)

@@ -167,6 +167,14 @@ class MetricsCollector:
                 [], buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 5),
                 registry=r,
             ),
+            # XLA compilations observed at registered jit families
+            # (analysis/audit): after warmup this should flatline — any
+            # increase is a recompile regression a latency SLO will feel
+            "xla_compiles": Counter(
+                "sentio_tpu_xla_compiles_total",
+                "XLA compilations at registered jit families", ["family"],
+                registry=r,
+            ),
             # the HPA scaling signal (deploy/kubernetes/hpa.yaml): CPU% is
             # meaningless for a TPU pod, queue depth is what saturates a slice
             "inflight": Gauge(
@@ -242,6 +250,15 @@ class MetricsCollector:
         self.set_serving_stat("tick_queue_depth", float(queue_depth))
         if self._prom:
             self._prom["tick_duration"].observe(duration_s)
+
+    def record_compiles(self, family: str, n: int = 1) -> None:
+        """``n`` XLA compilations at jit family ``family`` (fed by the audit
+        registry's cache-miss accounting, analysis/audit/fence.py)."""
+        if not self.enabled:
+            return
+        self.memory.inc("xla_compiles", (family,), n)
+        if self._prom:
+            self._prom["xla_compiles"].labels(family).inc(n)
 
     def record_breaker(self, name: str, state: str) -> None:
         value = {"closed": 0.0, "half_open": 1.0, "open": 2.0}.get(state, 0.0)

@@ -20,12 +20,12 @@ row-sharded → two psums per block, inserted by XLA from the shardings.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
+from sentio_tpu.analysis.audit.registry import jit_family
 from sentio_tpu.models import layers as L
 
 Array = jax.Array
@@ -274,7 +274,7 @@ def llama_forward(
     return logits.astype(jnp.float32), cache
 
 
-@partial(jax.jit, static_argnames=("cfg",))
+@jit_family("llama.loss", static_argnames=("cfg",))
 def llama_loss(params: dict, cfg: LlamaConfig, ids: Array, mask: Array) -> Array:
     """Mean next-token cross-entropy over unpadded positions — the training
     objective for fine-tuning and for the multi-chip dry-run train step."""

@@ -21,11 +21,11 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from functools import partial
 from typing import Iterator, Optional, Sequence
 
 import numpy as np
 
+from sentio_tpu.analysis.audit.registry import jit_family
 from sentio_tpu.config import GeneratorConfig, get_settings
 from sentio_tpu.models.llama import LlamaConfig
 from sentio_tpu.parallel.batcher import bucket_size
@@ -175,7 +175,7 @@ class GeneratorEngine:
 
         self._attn_fn = attn_fn  # exposed for the speculative decoder
 
-        @jax.jit
+        @jit_family("engine.prefill")
         def prefill(params, ids, positions, cache, pad_mask):
             # pad_mask marks real (row, token) cells: llama ignores it on the
             # cache path, routed families (MoE) need it so padding claims no
@@ -186,9 +186,13 @@ class GeneratorEngine:
             )
             return logits, cache
 
-        @partial(jax.jit, static_argnames=("top_k",))
+        @jit_family("engine.decode_step")
         def decode_step(params, tok, lens, cache, rng, temperature, top_k):
-            # tok [B,1]; lens [B] = current absolute position per row
+            # tok [B,1]; lens [B] = current absolute position per row.
+            # top_k rides TRACED (int32 scalar): per-request values share one
+            # compiled program — the old static_argnames form recompiled the
+            # whole decode step per distinct k (analysis/baseline.json entry,
+            # now fixed)
             logits, cache = llama_forward(
                 params, cfg, tok, positions=lens[:, None], cache=cache, cache_index=lens
             )
@@ -196,14 +200,18 @@ class GeneratorEngine:
             nxt = sample_tokens(logits[:, -1], sub, temperature, top_k=top_k)
             return nxt, cache, rng
 
-        @partial(jax.jit, static_argnames=("steps", "top_k", "eos_id"))
+        @jit_family("engine.generate_fused",
+                    static_argnames=("steps", "eos_id"))
         def generate_fused(params, ids, positions, lens, cache, rng, temperature,
                            steps, top_k, eos_id, pad_mask):
             """Prefill + first-token sample + the whole decode scan as ONE
             compiled program. The bulk path dispatches this once and fetches
             one output — on remote-attached devices every extra blocking
             host<->device round trip costs ~RTT (measured ~70 ms through a
-            tunnel), which dwarfs the actual compute at serving batch sizes."""
+            tunnel), which dwarfs the actual compute at serving batch sizes.
+            ``steps`` comes from ``_stable_steps`` (STEP_BUCKETS only) and
+            ``top_k`` is traced, so the variant space stays the bounded set
+            the compile manifest commits to."""
             logits, cache = llama_forward(
                 params, cfg, ids, positions=positions, cache=cache, cache_index=0,
                 pad_mask=pad_mask, attn_fn=attn_fn,
@@ -289,18 +297,69 @@ class GeneratorEngine:
     STEP_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 
     def _stable_steps(self, requested: int, headroom: int) -> int:
-        """Static scan lengths must come from a small set or every distinct
-        clamped value recompiles the whole decode loop. The config value is
-        used as-is (stable across requests); a cache-headroom clamp rounds
-        DOWN to a step bucket (finish_reason becomes 'length')."""
+        """Static scan lengths must come from the committed STEP_BUCKETS set
+        or every distinct value recompiles the whole fused decode loop (the
+        compile manifest pins this family's variant space). Requested counts
+        round UP to a bucket — the scan over-runs by at most a bucket gap
+        and ``generate`` truncates host-side — while a cache-headroom clamp
+        rounds DOWN (finish_reason becomes 'length')."""
         from sentio_tpu.parallel.batcher import floor_bucket
 
         # _encode_batch truncates prompts to leave >= 8 slots, so headroom >= 8
         # always holds in practice; the assert guards the invariant
         assert headroom >= 1, f"no KV headroom ({headroom}); prompt truncation failed"
-        if requested <= headroom:
-            return max(requested, 1)
-        return max(min(floor_bucket(headroom, self.STEP_BUCKETS), headroom), 1)
+        # min() with the top bucket: bucket_size returns n ITSELF past the
+        # last bucket, which would reopen the one-program-per-value hole
+        # for requests above max(STEP_BUCKETS) — those clamp (length-finish
+        # at the top bucket) instead of compiling off-manifest
+        steps = min(bucket_size(max(requested, 1), self.STEP_BUCKETS),
+                    max(self.STEP_BUCKETS))
+        if steps > headroom:
+            steps = floor_bucket(headroom, self.STEP_BUCKETS)
+        return max(min(steps, headroom), 1)
+
+    def compile_variant_space(self) -> dict[str, list[dict]]:
+        """The DECLARED compile-variant space per jit family — every
+        (shape-static) combination the serving paths above can request,
+        derived from the same constants/helpers they use. ``sentio audit``
+        abstractly lowers each descriptor and diffs the result against the
+        committed compile manifest; widening any bucket set here (or in the
+        helpers) is a deliberate, manifest-visible act."""
+        cfg = self.model_config
+        max_prompt = min(self.config.max_prompt_tokens, cfg.max_len - 8)
+        # achievable prefill widths: bucket_size over 1..max_prompt
+        top_w = bucket_size(max_prompt, self.PREFILL_BUCKETS)
+        widths = sorted(
+            {b for b in self.PREFILL_BUCKETS if b <= top_w} | {top_w}
+        )
+        # achievable cache windows per width (_encode_batch): the bucket set
+        # extended by max_len, values above width, capped at max_len
+        ext = sorted(set(self.PREFILL_BUCKETS) | {cfg.max_len})
+
+        def windows(width: int) -> list[int]:
+            return sorted({min(cfg.max_len, b) for b in ext if b > width})
+
+        rows = list(self.BATCH_BUCKETS)
+        # achievable fused-scan lengths (_stable_steps: STEP_BUCKETS only,
+        # down-clamped by headroom < max_len)
+        steps = [b for b in self.STEP_BUCKETS if b <= cfg.max_len - 1]
+        space: dict[str, list[dict]] = {
+            "engine.prefill": [
+                {"rows": r, "width": w, "window": win}
+                for w in widths for win in windows(w) for r in rows
+            ],
+            "engine.decode_step": [
+                {"rows": r, "window": win}
+                for win in sorted({win for w in widths for win in windows(w)})
+                for r in rows
+            ],
+            "engine.generate_fused": [
+                {"rows": r, "width": w, "window": win, "steps": s}
+                for w in widths for win in windows(w) for r in rows
+                for s in steps if s < win
+            ],
+        }
+        return space
 
     # ----------------------------------------------------------------- public
 
@@ -335,23 +394,25 @@ class GeneratorEngine:
             return out
 
         t0 = time.perf_counter()
-        max_new = max_new_tokens or self.config.max_new_tokens
+        requested = max_new_tokens or self.config.max_new_tokens
         temp = self.config.temperature() if temperature is None else temperature
-        ids, positions, lens, cache, n, window, pad_mask = self._encode_batch(prompts, max_new)
-        max_new = self._stable_steps(max_new, window - int(lens.max()))
+        ids, positions, lens, cache, n, window, pad_mask = self._encode_batch(prompts, requested)
+        max_new = self._stable_steps(requested, window - int(lens.max()))
 
         # one dispatch, one fetch: prefill + sampling + decode scan fused
         self._rng, sub = jax.random.split(self._rng)
         toks = np.asarray(self._generate_fused(
             self.params, ids, positions, lens, cache, sub,
-            jnp.asarray(temp, jnp.float32), max_new, top_k, self.tokenizer.eos_id,
-            pad_mask,
+            jnp.asarray(temp, jnp.float32), max_new, np.int32(top_k),
+            self.tokenizer.eos_id, pad_mask,
         ))
         dt_ms = (time.perf_counter() - t0) * 1000.0
 
         out = []
         for i in range(n):
-            row = toks[i].tolist()
+            # steps round UP to a bucket; the over-run tail past the caller's
+            # budget is dropped here (EOS inside it must not flip the reason)
+            row = toks[i, :requested].tolist()
             if self.tokenizer.eos_id in row:
                 cut = row.index(self.tokenizer.eos_id)
                 row, reason = row[:cut], "stop"
@@ -383,7 +444,9 @@ class GeneratorEngine:
         max_new = max_new_tokens or self.config.max_new_tokens
         temp = self.config.temperature() if temperature is None else temperature
         ids, positions, lens, cache, _, window, pad_mask = self._encode_batch([prompt], max_new)
-        max_new = self._stable_steps(max_new, window - int(lens.max()))
+        # the stream loop is host-driven (no static scan length), so the
+        # caller's budget applies exactly — only the cache window clamps it
+        max_new = max(min(max_new, window - int(lens.max())), 1)
 
         logits, cache = self._prefill(self.params, ids, positions, cache, pad_mask)
         last = jnp.take_along_axis(logits, (lens - 1)[:, None, None], axis=1)[:, 0]
@@ -408,7 +471,7 @@ class GeneratorEngine:
                 flushed = safe
             tok, cache, self._rng = self._decode_step(
                 self.params, tok[:, None], lens, cache, self._rng,
-                jnp.asarray(temp, jnp.float32), top_k,
+                jnp.asarray(temp, jnp.float32), np.int32(top_k),
             )
             lens = lens + 1
         final = self.tokenizer.decode(emitted)

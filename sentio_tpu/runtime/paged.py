@@ -51,11 +51,11 @@ import itertools
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from functools import partial
 from typing import Optional, Sequence
 
 import numpy as np
 
+from sentio_tpu.analysis.audit.registry import jit_family
 from sentio_tpu.analysis.sanitizer import check_engine_invariants, engine_guard
 from sentio_tpu.models.llama import LlamaConfig
 from sentio_tpu.parallel.batcher import bucket_size
@@ -616,6 +616,11 @@ class ContinuousBatchingEngine:
         # callers are waiting upstream of the engine's own queue (the
         # service inbox) — the engine queue alone can't see them
         self.pressure_hint = None
+        # warmup override: pins the next ticks' fused-scan length to one
+        # declared ladder rung so the compile fence can warm every rung
+        # deterministically instead of racing a backlog into existence
+        # (service.warmup); ignored unless the value is in tick_step_sizes()
+        self.force_tick_steps: Optional[int] = None
         # device-resident decode carry (tok, lens, halted) threaded from the
         # previous tick's outputs; None until the first dispatch
         self._dev_state = None
@@ -660,7 +665,8 @@ class ContinuousBatchingEngine:
 
         ignore_eos = self.ignore_eos
 
-        @partial(jax.jit, static_argnames=("steps",), donate_argnums=(5, 6))
+        @jit_family("paged.step_n", static_argnames=("steps",),
+                    donate_argnums=(5, 6))
         def step_n(params, tok, lens, halted, page_table, k_pages, v_pages,
                    rng, temps, budgets, steps):
             """``steps`` decode sub-steps fused into one dispatch (lax.scan).
@@ -708,7 +714,7 @@ class ContinuousBatchingEngine:
 
         self._step_n = step_n
 
-        @jax.jit
+        @jit_family("paged.merge_admitted")
         def merge_admitted(tok, lens, halted, first, new_lens, idxs):
             """Scatter admission's device-resident first tokens (plus their
             prompt lengths, and a cleared halt flag) into the carried decode
@@ -721,7 +727,7 @@ class ContinuousBatchingEngine:
 
         self._merge_admitted = merge_admitted
 
-        @partial(jax.jit, donate_argnums=(7, 8))
+        @jit_family("paged.prefill_scatter", donate_argnums=(7, 8))
         def prefill_scatter(params, ids, positions, lens, rng, temps, scat,
                             k_pages, v_pages):
             """Batched admission in ONE dispatch: contiguous prefill forward,
@@ -751,7 +757,8 @@ class ContinuousBatchingEngine:
 
         page_size = self.page_size
 
-        @partial(jax.jit, static_argnames=("do_sample",), donate_argnums=(7, 8))
+        @jit_family("paged.prior_prefill_scatter",
+                    static_argnames=("do_sample",), donate_argnums=(7, 8))
         def prior_prefill_scatter(params, ids, positions, lens, rng, temps,
                                   scat, k_pages, v_pages, prior_table,
                                   n_prior, do_sample):
@@ -835,7 +842,7 @@ class ContinuousBatchingEngine:
                 page_size=self.page_size,
             )
 
-            @partial(jax.jit, donate_argnums=(2, 3))
+            @jit_family("paged.draft_prefill", donate_argnums=(2, 3))
             def draft_prefill(params_d, ids, d_k, d_v, rows_idx, lens):
                 """Fill the persistent draft cache rows for freshly admitted
                 slots (the draft's analogue of prefill_scatter; prefix pages
@@ -1059,6 +1066,68 @@ class ContinuousBatchingEngine:
         if n_blocks <= 0:
             return 0
         return min(1 << (n_blocks - 1).bit_length(), self.max_pages_per_seq)
+
+    def tick_step_sizes(self) -> tuple[int, ...]:
+        """Every fused-tick scan length ``_dispatch_tick`` can request: the
+        idle-queue big tick plus the 3-rung pressure ladder. Each distinct
+        value is one compiled ``step_n`` (or spec-tick) variant — the set
+        the compile manifest commits to."""
+        sizes = {self.max_tick_steps}
+        for shrink in (1, 2, 4):
+            sizes.add(max(self.steps_per_tick // shrink, 2))
+        return tuple(sorted(sizes))
+
+    def compile_variant_space(self) -> dict[str, list[dict]]:
+        """The DECLARED compile-variant space per jit family, derived from
+        the same bucketing helpers the admission/decode paths call
+        (``_prefill_width`` / ``_prior_bucket`` / ``tick_step_sizes`` /
+        ADMIT_BUCKETS). ``sentio audit`` lowers every descriptor and gates
+        the result against the committed manifest, so growing any of these
+        sets is a deliberate, reviewable act."""
+        window = self.max_pages_per_seq * self.page_size
+        # reserve = min(max_new + 2, window // 2) >= 3, so admitted prompts
+        # never exceed window - 3 tokens
+        max_prompt = max(window - 3, 1)
+        widths = sorted({self._prefill_width(n)
+                         for n in range(1, max_prompt + 1)})
+        pnbs = sorted({self._prior_bucket(b)
+                       for b in range(1, self.max_pages_per_seq)})
+        rows = list(self.ADMIT_BUCKETS)
+        space: dict[str, list[dict]] = {
+            "paged.step_n": [{"steps": s} for s in self.tick_step_sizes()],
+            "paged.merge_admitted": [{"rows": r} for r in rows],
+            "paged.prefill_scatter": [
+                {"width": w, "rows": r} for w in widths for r in rows
+            ],
+            # radix-hit admission: suffix width x prior bucket x row bucket,
+            # always sampling the first token
+            "paged.prior_prefill_scatter": [
+                {"width": w, "pnb": p, "rows": r, "do_sample": True}
+                for w in widths for p in pnbs for r in rows
+            ],
+        }
+        if self.prefill_chunk is not None:
+            # chunked segments dispatch one row at a time; non-final
+            # segments skip sampling and the first segment may have no
+            # prior at all (pnb 0)
+            seg_widths = sorted({self._prefill_width(n)
+                                 for n in range(1, self.prefill_chunk + 1)})
+            space["paged.prior_prefill_scatter"] += [
+                {"width": w, "pnb": p, "rows": 1, "do_sample": False}
+                for w in seg_widths for p in [0] + pnbs
+            ]
+        if self.draft_params is not None:
+            # the draft always prefills the FULL prompt, width clamped to
+            # its cache window
+            full_widths = sorted({min(self._prefill_width(n), window)
+                                  for n in range(1, max_prompt + 1)})
+            space["paged.draft_prefill"] = [
+                {"width": w, "rows": r} for w in full_widths for r in rows
+            ]
+            space["paged_spec.spec_tick"] = [
+                {"steps": s} for s in self.tick_step_sizes()
+            ]
+        return space
 
     def _match_radix(self, tok_ids: Sequence[int]):
         """Longest-prefix match against the radix cache, clamped so at
@@ -1447,7 +1516,8 @@ class ContinuousBatchingEngine:
         # 9.6x p95/p50 tail with the old two-size switch). An idle queue
         # runs the big tick so long generations cost few fetches. Each
         # distinct step count is its own compiled variant; the pressured
-        # ladder is capped at 3 sizes (+1 idle) to bound compilations.
+        # ladder is capped at 3 sizes (+1 idle) to bound compilations —
+        # ``tick_step_sizes()`` declares exactly this set for the audit.
         waiting = len(self._queue)
         if self.pressure_hint is not None:
             waiting += int(self.pressure_hint())
@@ -1456,6 +1526,8 @@ class ContinuousBatchingEngine:
         else:
             shrink = 1 << min(waiting // max(self.max_slots, 1), 2)  # 1, 2, 4
             steps = max(self.steps_per_tick // shrink, 2)
+        if self.force_tick_steps in self.tick_step_sizes():
+            steps = self.force_tick_steps  # warmup rung pin, never off-ladder
         budgets = np.minimum(remaining, steps).astype(np.int32)
         pending_slots = [i for _, idxs in pending for i in idxs
                          if self.slots[i].active]

@@ -56,8 +56,7 @@ rounds/ticks at the same offsets.
 
 from __future__ import annotations
 
-from functools import partial
-
+from sentio_tpu.analysis.audit.registry import jit_family
 from sentio_tpu.runtime.speculative import accept_and_correct
 
 
@@ -85,8 +84,8 @@ def build_spec_tick(target_fwd, cfg, draft_fwd, dcfg, eos_id: int,
         lcount, s, nb, pg, hk, hd = dense.shape
         return dense.reshape(lcount, s, nb * pg, hk, hd)
 
-    @partial(jax.jit, static_argnames=("k", "out_w"),
-             donate_argnums=(6, 7, 8, 9))
+    @jit_family("paged_spec.spec_tick", static_argnames=("k", "out_w"),
+                donate_argnums=(6, 7, 8, 9))
     def spec_tick(params_t, params_d, tok, lens, halted, page_table,
                   k_pages, v_pages, d_k, d_v, rng, temps, budgets,
                   k, out_w):

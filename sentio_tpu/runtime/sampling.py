@@ -18,12 +18,16 @@ def sample_tokens(
     logits: Array,
     rng: Array,
     temperature: Array | float = 0.0,
-    top_k: int = 0,
+    top_k: Array | int = 0,
     top_p: float = 1.0,
 ) -> Array:
     """[B, V] → [B] int32. ``temperature`` may be a traced scalar or a [B]
     vector (continuous batching mixes generator/verifier rows at different
-    temperatures); 0 = greedy. top_k / top_p are static (compiled in)."""
+    temperatures); 0 = greedy. ``top_k`` may be a static Python int (0 =
+    off, compiled in) or a TRACED int32 scalar / [B] vector — the serving
+    engines pass it traced so per-request values share ONE compiled program
+    instead of recompiling the decode loop per distinct k; <= 0 disables
+    per row. top_p is static (compiled in)."""
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
@@ -31,9 +35,31 @@ def sample_tokens(
     temp_col = temp[:, None] if temp.ndim == 1 else temp
     scaled = logits / jnp.maximum(temp_col, 1e-6)
 
-    if top_k and top_k > 0:
-        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
-        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    if isinstance(top_k, int):
+        if top_k > 0:
+            kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    else:
+        k = jnp.asarray(top_k, jnp.int32)
+        k_col = (
+            k[:, None] if k.ndim == 1
+            else jnp.broadcast_to(k, (scaled.shape[0],))[:, None]
+        )
+        v = scaled.shape[-1]
+
+        def _mask_topk(s):
+            # kth-largest per row via one ascending sort + traced-index
+            # gather; rows with k <= 0 keep everything (the jnp.where arm).
+            # Matches the static path exactly: values == kth survive.
+            srt = jnp.sort(s, axis=-1)
+            idx = jnp.clip(v - k_col, 0, v - 1)
+            kth = jnp.take_along_axis(srt, idx, axis=-1)
+            return jnp.where((k_col > 0) & (s < kth), -jnp.inf, s)
+
+        # cond skips the [B, V] sort entirely on the common top_k=0 ticks
+        scaled = jax.lax.cond(
+            jnp.any(k_col > 0), _mask_topk, lambda s: s, scaled
+        )
     if top_p < 1.0:
         sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)

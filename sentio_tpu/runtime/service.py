@@ -243,6 +243,113 @@ class PagedGenerationService:
                 "max_active_slots": self._max_active,
             }
 
+    def warmup(self, max_new_tokens: int = 4) -> dict:
+        """Compile the paged serving families before traffic (and before
+        the compile fence arms — serve startup and bench call this under
+        ``SENTIO_COMPILE_FENCE=1``). Coverage, all through the normal
+        submit path so the pump keeps sole engine ownership:
+
+        * one cold admission per achievable prefill-width bucket;
+        * a radix head chain, then one admission per feasible
+          (prior-bucket x suffix-width) pair sharing exactly that many
+          pages with the head — any later request's radix hit lands on a
+          compiled ``prior_prefill_scatter`` variant;
+        * every tick-ladder rung, pinned deterministically via the
+          engine's ``force_tick_steps`` hint (one short generation per
+          rung);
+        * a concurrent short-prompt burst sized to fill the multi-row
+          admission buckets (best-effort: row grouping depends on drain
+          timing).
+
+        The full declared variant space remains the compile manifest's
+        job (``sentio audit``); a fence error after this warmup names the
+        residual variant to add here. Returns the prompt count and the
+        XLA compiles the burst triggered."""
+        import threading
+
+        from sentio_tpu.analysis.audit import fence
+
+        eng = self.engine
+        before = fence.compiles_total()
+        page = eng.page_size
+        window = eng.max_pages_per_seq * page
+        reserve = max_new_tokens + 2  # admission keeps this much headroom
+        space = eng.compile_variant_space()
+        widths = sorted({d["width"] for d in space["paged.prefill_scatter"]})
+        pnbs = sorted({d["pnb"]
+                       for d in space.get("paged.prior_prefill_scatter", [])
+                       if d.get("pnb")})
+        prompts = 0
+
+        def run(text: str) -> None:
+            nonlocal prompts
+            self.generate(text, max_new_tokens=max_new_tokens,
+                          temperature=0.0)
+            prompts += 1
+
+        # ByteTokenizer: 1 char = 1 token, +1 for BOS — a (w - 1)-char
+        # prompt admits at exactly width bucket w. Each width uses a
+        # DISTINCT digit: same-char prompts would radix-match the previous
+        # width's inserted pages and take the prior path, leaving the cold
+        # prefill_scatter variant uncompiled.
+        for i, width in enumerate(widths):
+            n = min(width, window - reserve) - 1
+            if n >= 1:
+                run(str(i % 10) * n)
+        if pnbs:
+            head_chars = min(window - reserve, max(pnbs) * page + 2) - 1
+            if head_chars >= page:
+                head = "h" * head_chars
+                run(head)  # seeds the radix chain the combos match into
+                run(head)  # full-match re-admission: deepest-prior variant
+                combo = 0
+                for pnb in pnbs:
+                    # share exactly pnb pages with the head (BOS + chars),
+                    # then diverge into a width-bucket suffix; the cycled
+                    # suffix char (never 'h') keeps combos from matching
+                    # EACH OTHER deeper than the intended prior
+                    keep = pnb * page - 1
+                    if keep < 1 or keep > len(head):
+                        continue
+                    for width in widths:
+                        if pnb * page + width > window - reserve:
+                            continue
+                        fill = "abcdefgijklmnopqrstuvwxyz"[combo % 25]
+                        run(head[:keep] + fill * width)
+                        combo += 1
+        # every declared fused-scan length, pinned via force_tick_steps so
+        # rung coverage never races backlog timing (each rung decodes at
+        # least max_new_tokens steps only if the rung allows — one short
+        # generation per rung suffices to compile it)
+        n_short = max(min(widths[0], window - reserve) - 1, 1)
+        try:
+            for rung in eng.tick_step_sizes():
+                eng.force_tick_steps = rung
+                run("r" * n_short)
+        finally:
+            eng.force_tick_steps = None
+        # concurrent burst for the >1-row admission buckets; capped — row
+        # grouping needs only max(ADMIT_BUCKETS)-deep backlog, not one
+        # thread per production slot (run() is not used here — the count
+        # is added after the join, avoiding a cross-thread race)
+        burst_n = min(3 * eng.max_slots, 4 * max(eng.ADMIT_BUCKETS))
+        threads = [
+            threading.Thread(
+                target=self.generate, args=("b" * n_short,),
+                kwargs={"max_new_tokens": max_new_tokens,
+                        "temperature": 0.0},
+                daemon=True,
+            )
+            for _ in range(burst_n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        prompts += len(threads)
+        return {"prompts": prompts,
+                "xla_compiles": fence.compiles_total() - before}
+
     # ----------------------------------------------------------------- pump
 
     def _ensure_pump(self) -> None:  # lock-held: _mutex
@@ -267,9 +374,27 @@ class PagedGenerationService:
         # baselines for diffing the engine's lifetime counters into per-tick
         # attributions (pump-local: a restarted pump re-baselines, so the
         # first tick of a new burst never inherits the previous burst's work)
+        from sentio_tpu.analysis.audit import fence
+
+        def paged_compiles() -> int:
+            # per-ENGINE attribution: sum the cache-miss counts of this
+            # engine's own FamilyFn instances (their `_seen` fields) — a
+            # concurrent contiguous-engine compile, train step, or a
+            # second paged service in the same process must not be pinned
+            # on an innocent tick of THIS pump
+            total = 0
+            for attr in ("_step_n", "_merge_admitted", "_prefill_scatter",
+                         "_prior_prefill_scatter", "_draft_prefill",
+                         "_spec_tick"):
+                fn = getattr(self.engine, attr, None)
+                total += getattr(fn, "_seen", 0) or 0
+            return total
+
         last_prefill = self.engine.prefill_tokens_total
         last_decode = self.engine.decode_tokens_total
         last_spec = self.engine.spec_emitted_total
+        last_compiles = paged_compiles()
+        fence.drain_events()  # events before this burst belong to no tick
         last_hit_toks = self.engine.prefix_hit_tokens_total
         last_miss_toks = self.engine.prefix_miss_tokens_total
         while True:
@@ -352,7 +477,27 @@ class PagedGenerationService:
                 inbox = len(self._inbox)  # lint: allow(lock-discipline) — GIL-atomic depth hint
                 free = engine.allocator.free_pages
                 radix = getattr(engine, "_radix", None)
+                # XLA compiles this tick triggered (jit-family cache growth,
+                # analysis/audit/fence.py) — steady-state serving should
+                # record 0 here; the event list names the offending family
+                # and abstract signature when it does not
+                compiles_now = paged_compiles()
+                compile_fields: dict = {
+                    "xla_compiles": compiles_now - last_compiles,
+                }
+                if compiles_now != last_compiles:
+                    # the event ring is process-global and drained
+                    # destructively — with several engines alive the
+                    # family filter keeps foreign events off this tick,
+                    # but a second paged pump may consume events first
+                    # (counts above stay exact either way)
+                    compile_fields["compile_events"] = [
+                        e for e in fence.drain_events()
+                        if e["family"].startswith(("paged.", "paged_spec."))
+                    ]
+                last_compiles = compiles_now
                 recorder.record_tick(
+                    **compile_fields,
                     dur_ms=round(tick_dur_s * 1e3, 3),
                     active_slots=int(active),
                     queue_depth=queued,

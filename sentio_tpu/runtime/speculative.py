@@ -36,10 +36,11 @@ in-process here.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import numpy as np
+
+from sentio_tpu.analysis.audit.registry import jit_family
 
 
 class SpeculativeError(Exception):
@@ -99,7 +100,8 @@ def build_spec_generate(target_fwd, target_cfg, draft_fwd, draft_cfg, eos_id: in
     import jax
     import jax.numpy as jnp
 
-    @partial(jax.jit, static_argnames=("steps", "k", "sampled"))
+    @jit_family("speculative.spec_generate",
+                static_argnames=("steps", "k", "sampled"))
     def spec_generate(params_t, params_d, ids, positions, lens, tcache, dcache,
                       steps, k, pad_mask, rng, temperature, sampled=False):
         b, width = ids.shape
@@ -330,7 +332,8 @@ class SpeculativeDecoder:
 
         eng = self.engine
         t0 = _time.perf_counter()
-        max_new = max_new_tokens or eng.config.max_new_tokens
+        requested = max_new_tokens or eng.config.max_new_tokens
+        max_new = requested
         ids, positions, lens, tcache, n, window, pad_mask = eng._encode_batch(
             prompts, max_new + self.k + 1
         )
@@ -368,7 +371,9 @@ class SpeculativeDecoder:
         results = []
         eos = eng.tokenizer.eos_id
         for i in range(n):
-            row = out[i, : min(int(emitted[i]), max_new)].tolist()
+            # max_new rounds UP to a step bucket (_stable_steps); the tail
+            # past the caller's budget is dropped, same as engine.generate
+            row = out[i, : min(int(emitted[i]), max_new, requested)].tolist()
             if eos in row:
                 row, reason = row[: row.index(eos)], "stop"
             else:

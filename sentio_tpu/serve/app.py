@@ -706,6 +706,32 @@ def create_app(
     async def on_startup(app: web.Application) -> None:
         if initialize:
             await asyncio.to_thread(container.initialize_all)
+        from sentio_tpu.analysis.audit import fence
+
+        if fence.enabled():
+            # SENTIO_COMPILE_FENCE=1 (canary/CI pods): warm the paged
+            # engine's single-request compile variants, then arm — any
+            # LATER XLA compile at a registered jit family hard-fails the
+            # tick with the offending family + abstract signature
+            def _warm_and_arm() -> None:
+                service = container.peek("generation_service")
+                if service is None:
+                    # nothing to warm (paged path off / lazy init): arming
+                    # anyway would fail the FIRST request's cold compile
+                    logger.warning(
+                        "compile fence: no paged generation service; "
+                        "fence NOT armed"
+                    )
+                    return
+                stats = service.warmup()
+                logger.info(
+                    "compile fence: warmup compiled %d variants over "
+                    "%d prompts; arming",
+                    stats["xla_compiles"], stats["prompts"],
+                )
+                fence.arm()
+
+            await asyncio.to_thread(_warm_and_arm)
 
     async def on_cleanup(app: web.Application) -> None:
         container.cleanup()

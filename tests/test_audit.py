@@ -1,0 +1,295 @@
+"""Compile-manifest audit (analysis/audit) self-tests + the tier-1 gate.
+
+Four layers, mirroring test_lint.py's structure:
+
+* the REAL audit over the committed manifest must be green (this test IS
+  ``sentio audit`` in CI — one report is built per module and shared);
+* seeded regressions (an extra compile variant, a dropped donation, HBM
+  growth, sharding drift) must each fail the diff / exit non-zero;
+* the donation contract: every declared ``donate_argnums`` leaf of the
+  decode/prefill-scatter/spec families must be aliased by lowering — the
+  artifact-level proof of paged.py's "updated in place, never copied";
+* the registry + compile fence: cache growth is counted per family, and an
+  armed fence turns a post-warmup compile into CompileFenceError.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+
+import numpy as np
+import pytest
+
+from sentio_tpu.analysis.audit import fence
+from sentio_tpu.analysis.audit.manifest import (
+    DEFAULT_MANIFEST,
+    diff_manifest,
+    load_manifest,
+)
+from sentio_tpu.analysis.audit.registry import jit_family
+
+
+@pytest.fixture(scope="module")
+def audit_result():
+    from sentio_tpu.analysis.audit.runner import run_audit
+
+    return run_audit()
+
+
+@pytest.fixture(scope="module")
+def manifest():
+    man = load_manifest(DEFAULT_MANIFEST)
+    assert man is not None, "analysis/compile_manifest.json missing"
+    return man
+
+
+DONATING_FAMILIES = (
+    "paged.step_n",
+    "paged.prefill_scatter",
+    "paged.prior_prefill_scatter",
+    "paged.draft_prefill",
+    "paged_spec.spec_tick",
+)
+
+
+class TestCommittedManifestGate:
+    def test_audit_green_vs_committed_manifest(self, audit_result):
+        assert audit_result.ok, (
+            "compile audit regressions:\n"
+            + "\n".join(str(r) for r in audit_result.diff.regressions)
+        )
+        # the ratchet should also be tight: no stale entries committed
+        assert audit_result.diff.stale == []
+
+    def test_every_registered_family_audited(self, audit_result):
+        from sentio_tpu.analysis.audit.registry import families
+
+        audited = set(audit_result.report["families"])
+        assert set(families()) <= audited
+
+    def test_variant_spaces_are_nontrivial(self, audit_result):
+        fams = audit_result.report["families"]
+        assert len(fams) >= 10
+        assert sum(f["variant_count"] for f in fams.values()) >= 40
+        # the tick ladder and the prior-table pow2 buckets must be visible
+        assert any("steps=" in k for k in fams["paged.step_n"]["variants"])
+        assert any("pnb=" in k
+                   for k in fams["paged.prior_prefill_scatter"]["variants"])
+
+
+class TestSeededRegressions:
+    def test_extra_bucket_fails(self, audit_result, manifest):
+        report = copy.deepcopy(audit_result.report)
+        variants = report["families"]["paged.step_n"]["variants"]
+        variants["steps=1024"] = dict(next(iter(variants.values())))
+        diff = diff_manifest(report, manifest)
+        assert not diff.ok
+        assert any(r["kind"] == "new-variant" and "steps=1024" in r["where"]
+                   for r in diff.regressions)
+
+    def test_dropped_donation_fails(self, audit_result, manifest):
+        report = copy.deepcopy(audit_result.report)
+        variants = report["families"]["paged.prefill_scatter"]["variants"]
+        key = next(iter(variants))
+        variants[key]["aliased"] -= 1
+        diff = diff_manifest(report, manifest)
+        assert any(r["kind"] == "donation-dropped" for r in diff.regressions)
+
+    def test_hbm_growth_fails(self, audit_result, manifest):
+        report = copy.deepcopy(audit_result.report)
+        variants = report["families"]["paged.step_n"]["variants"]
+        key = next(iter(variants))
+        variants[key]["arg_bytes"] += 1 << 20
+        diff = diff_manifest(report, manifest)
+        assert any(r["kind"] == "hbm-growth" for r in diff.regressions)
+
+    def test_sharding_drift_fails(self, audit_result, manifest):
+        report = copy.deepcopy(audit_result.report)
+        state = report["sharding"]["state"]
+        key = next(k for k, v in state.items() if "tp" in v)
+        state[key] = "PartitionSpec()"  # silently replicated weight
+        diff = diff_manifest(report, manifest)
+        assert any(r["kind"] == "sharding-drift" and key in r["where"]
+                   for r in diff.regressions)
+
+    def test_new_jit_family_without_spec_fails(self, audit_result):
+        from sentio_tpu.analysis.audit import registry
+        from sentio_tpu.analysis.audit.runner import _check_coverage
+        from sentio_tpu.analysis.audit.manifest import AuditDiff
+
+        @jit_family("test.rogue_family")
+        def rogue(x):
+            return x + 1
+
+        try:
+            diff = AuditDiff()
+            _check_coverage(audit_result.report, diff)
+            assert any(r["kind"] == "family-unaudited"
+                       and r["where"] == "test.rogue_family"
+                       for r in diff.regressions)
+        finally:
+            registry._REGISTRY.pop("test.rogue_family", None)
+
+    def test_seeded_regression_exits_nonzero(self, audit_result, tmp_path,
+                                             monkeypatch, capsys):
+        """CLI contract: a manifest missing a now-declared variant makes
+        ``sentio audit`` exit 1 (the report itself is reused — only the
+        gate runs)."""
+        import sentio_tpu.analysis.audit.runner as runner_mod
+        from sentio_tpu.cli import main as cli_main
+
+        tampered = copy.deepcopy(audit_result.report)
+        victim = tampered["families"]["paged.step_n"]["variants"]
+        victim.pop(next(iter(victim)))
+        path = tmp_path / "manifest.json"
+        path.write_text(json.dumps(tampered))
+        monkeypatch.setattr(runner_mod, "run_audit",
+                            lambda manifest_path=None, include_mesh=True:
+                            runner_mod.AuditResult(
+                                report=audit_result.report,
+                                diff=diff_manifest(audit_result.report,
+                                                   load_manifest(path)),
+                            ))
+        rc = cli_main(["audit", "--manifest", str(path), "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1 and not out["ok"]
+        assert any(r["kind"] == "new-variant" for r in out["regressions"])
+
+
+class TestDonationAliasing:
+    def test_all_declared_donations_alias(self, audit_result):
+        """Regression guard for the in-place pool contract: every donated
+        leaf of every decode/scatter variant must be aliased by XLA. A
+        future edit that reorders outputs or drifts a dtype breaks the
+        alias silently at runtime — and loudly here."""
+        for name in DONATING_FAMILIES:
+            fam = audit_result.report["families"][name]
+            assert fam["donate_argnums"], name
+            for key, variant in fam["variants"].items():
+                assert variant["donated_leaves"] > 0, (name, key)
+                assert variant["aliased"] == variant["donated_leaves"], (
+                    f"{name}[{key}]: {variant['aliased']} of "
+                    f"{variant['donated_leaves']} donated leaves aliased"
+                )
+
+    def test_dropped_donation_detected_by_lowering(self):
+        """A donated arg that cannot alias (not returned) lowers with zero
+        aliasing — the signal the manifest gate rides on."""
+        from sentio_tpu.analysis.audit.lowering import audit_variant
+
+        @jit_family("test.bad_donor", donate_argnums=(0,), register=False)
+        def bad_donor(pool, x):
+            return x * 2.0  # pool never returned -> donation unusable
+
+        import jax
+
+        entry = audit_variant(
+            bad_donor, (0,),
+            (jax.ShapeDtypeStruct((8, 4), np.float32),
+             jax.ShapeDtypeStruct((4,), np.float32)),
+            {},
+        )
+        assert entry["donated_leaves"] == 1
+        assert entry["aliased"] == 0
+
+
+class TestCompileFence:
+    @pytest.fixture(autouse=True)
+    def _clean_fence(self):
+        fence.reset()
+        yield
+        fence.reset()
+
+    def test_family_counts_cache_growth(self):
+        @jit_family("test.counting", register=False)
+        def fn(x):
+            return x + 1
+
+        base = fence.compiles_total()
+        fn(np.ones(3, np.float32))
+        assert fence.compiles_total() == base + 1
+        fn(np.zeros(3, np.float32))  # same shape: cached, no compile
+        assert fence.compiles_total() == base + 1
+        fn(np.ones(5, np.float32))  # new shape: one more variant
+        assert fence.compiles_total() == base + 2
+        events = fence.drain_events()
+        assert [e["family"] for e in events] == ["test.counting"] * 2
+        assert "float32[5]" in events[-1]["signature"]
+
+    def test_armed_fence_raises_with_family_and_signature(self):
+        @jit_family("test.fenced", register=False)
+        def fn(x):
+            return x * 2
+
+        fn(np.ones(3, np.float32))  # warmup
+        fence.arm()
+        fn(np.ones((3,), np.float32))  # warm shape: fine
+        with pytest.raises(fence.CompileFenceError) as exc:
+            fn(np.ones(7, np.float32))
+        assert exc.value.family == "test.fenced"
+        assert "float32[7]" in exc.value.signature
+        fence.disarm()
+        fn(np.ones(9, np.float32))  # disarmed: counted, not fatal
+
+    def test_lowering_never_feeds_the_counters(self):
+        import jax
+
+        @jit_family("test.aot", register=False)
+        def fn(x):
+            return x + 1
+
+        base = fence.compiles_total()
+        fn.lower(jax.ShapeDtypeStruct((4,), np.float32))
+        assert fence.compiles_total() == base
+
+
+class TestServingTelemetry:
+    def test_ticks_carry_compile_counts_and_fence_survives_warm_traffic(self):
+        """One tiny service burst: warmup compiles, the fence arms, warm
+        traffic decodes without tripping it, and flight-recorder ticks
+        carry the per-tick xla_compiles attribution."""
+        from sentio_tpu.analysis.audit.specs import _paged_engine
+        from sentio_tpu.infra.flight import FlightRecorder, set_flight_recorder
+        from sentio_tpu.runtime.service import PagedGenerationService
+
+        fence.reset()
+        recorder = FlightRecorder()
+        set_flight_recorder(recorder)
+        service = PagedGenerationService(_paged_engine(prefill_chunk=None))
+        try:
+            stats = service.warmup(max_new_tokens=2)
+            assert stats["prompts"] > 0
+            assert stats["xla_compiles"] > 0  # cold engine really compiled
+            fence.arm()
+            out = service.generate("warm again", max_new_tokens=2)
+            assert out.finish_reason in ("stop", "length")
+            ticks = recorder.timeline()
+            assert ticks and all("xla_compiles" in t for t in ticks)
+            # compile events are attributed to the tick that paid for them
+            compiled_ticks = [t for t in ticks if t["xla_compiles"]]
+            assert compiled_ticks
+            assert any("family" in e
+                       for t in compiled_ticks
+                       for e in t.get("compile_events", []))
+            # the armed window itself stayed compile-free
+            armed_ticks = ticks[-1]
+            assert armed_ticks["xla_compiles"] == 0
+        finally:
+            fence.reset()
+            service.close()
+            set_flight_recorder(None)
+
+    def test_metrics_counter_increments(self):
+        from sentio_tpu.infra.metrics import MetricsCollector, get_metrics, set_metrics
+
+        fence.reset()
+        set_metrics(MetricsCollector())
+        try:
+            fence.note_compile("test.metrics", "(float32[1])", 2)
+            snap = get_metrics().export_json()
+            key = "xla_compiles('test.metrics',)"
+            assert snap["counters"].get(key) == 2.0
+        finally:
+            fence.reset()
+            set_metrics(None)

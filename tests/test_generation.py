@@ -272,9 +272,17 @@ class TestReviewRegressions:
         assert out.count("SOURCES") == 1
 
     def test_stable_steps_buckets_headroom_clamp(self, engine):
-        assert engine._stable_steps(100, 1000) == 100  # config value passes through
+        # requested counts round UP to a STEP_BUCKET (generate truncates the
+        # over-run host-side) so the fused-scan variant space stays the
+        # bounded set the compile manifest commits to
+        assert engine._stable_steps(100, 1000) == 128
+        assert engine._stable_steps(16, 1000) == 16  # bucket values pass through
         assert engine._stable_steps(1000, 700) == 512  # clamped -> bucket floor
         assert engine._stable_steps(1000, 1) == 1
+        # above the top bucket, bucket_size returns n itself — the clamp
+        # keeps such requests on-manifest instead of one-program-per-value
+        top = max(engine.STEP_BUCKETS)
+        assert engine._stable_steps(top + 999, top * 2) == top
 
 
 def test_relaxed_parse_preserves_true_inside_strings():
