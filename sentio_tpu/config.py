@@ -357,6 +357,19 @@ class ServeConfig:
     batch_max_size: int = 8
     # /upload multipart body cap (binary documents: pdf/docx)
     max_upload_mb: int = 32
+    # overload & deadline controls for the paged decode service:
+    # default per-request deadline (ms) applied when the caller sends none
+    # (X-Deadline-Ms header / deadline_ms body field); 0 = no default
+    default_deadline_ms: float = 0.0
+    # admission bound on waiting decode work (inbox + admitted); 0 = derive
+    # from the engine (max(8 * max_slots, 64))
+    admission_max_queue: int = 0
+    # crash containment: requeues granted per request after a failed decode
+    # tick whose engine reset succeeded
+    crash_retry_budget: int = 1
+    # graceful-shutdown drain window: in-flight requests get this long to
+    # finish after the server stops admitting
+    drain_deadline_s: float = 10.0
 
     @classmethod
     def from_env(cls) -> "ServeConfig":
@@ -377,6 +390,10 @@ class ServeConfig:
             batch_deadline_ms=_env_float(["BATCH_DEADLINE_MS"], 8.0),
             batch_max_size=_env_int(["BATCH_MAX_SIZE"], 8),
             max_upload_mb=_env_int(["MAX_UPLOAD_MB"], 32),
+            default_deadline_ms=_env_float(["DEADLINE_MS", "DEFAULT_DEADLINE_MS"], 0.0),
+            admission_max_queue=_env_int(["ADMISSION_MAX_QUEUE"], 0),
+            crash_retry_budget=_env_int(["CRASH_RETRY_BUDGET"], 1),
+            drain_deadline_s=_env_float(["DRAIN_DEADLINE_S"], 10.0),
         )
 
 

@@ -77,7 +77,10 @@ class CompiledGraph:
                 if inspect.isawaitable(update):
                     update = await update
             except Exception as exc:  # noqa: BLE001 — soft-fail ladder by design
-                if not node.soft_fail:
+                # typed shed/deadline errors opt OUT of soft-fail: turning a
+                # 429/503/504 into a degraded 200 would hide overload from
+                # the caller, whose retry-elsewhere is the correct response
+                if not node.soft_fail or getattr(exc, "soft_fail_exempt", False):
                     raise
                 logger.exception("node %s failed softly", node.name)
                 update = {"metadata": {f"{node.name}_error": str(exc)}}

@@ -18,7 +18,12 @@ import time
 from typing import Any, Optional
 
 from sentio_tpu.config import Settings, get_settings
-from sentio_tpu.graph.state import RAGState, best_documents
+from sentio_tpu.graph.state import (
+    RAGState,
+    best_documents,
+    deadline_remaining_s,
+    deadline_ts,
+)
 from sentio_tpu.models.document import Document
 
 logger = logging.getLogger(__name__)
@@ -141,6 +146,9 @@ def create_generator_node(generator, settings: Optional[Settings] = None):
         # flight-recorder trace context: ties this generation's engine
         # tickets/ticks to the serving layer's request id
         request_id = meta.get("query_id")
+        # caller deadline: rides metadata from the HTTP layer down into the
+        # decode service's ticket, so an expired caller's decode is cancelled
+        deadline = deadline_ts(state)
         t0 = time.perf_counter()
         try:
             # device generation is the longest stage — keep it off the event
@@ -151,9 +159,12 @@ def create_generator_node(generator, settings: Optional[Settings] = None):
                     state["query"], docs, mode=mode,
                     temperature=temperature if temperature is None else float(temperature),
                     request_id=str(request_id) if request_id else None,
+                    deadline_ts=deadline,
                 ),
             )
         except Exception as exc:  # noqa: BLE001
+            if getattr(exc, "soft_fail_exempt", False):
+                raise  # shed/deadline errors surface as 429/503/504, not prose
             logger.exception("generation failed")
             return {"response": "", "metadata": {"generation_error": str(exc)}}
         return {
@@ -175,17 +186,35 @@ def create_verifier_node(verifier, settings: Optional[Settings] = None):
         answer = state.get("response", "")
         if not answer:
             return {"evaluation": {"verdict": "warn", "notes": ["empty answer"]}}
+        # verification is an optional quality stage: with the caller's
+        # deadline already spent, running it would burn decode ticks on an
+        # answer nobody may read in time — return the unverified answer
+        remaining = deadline_remaining_s(state)
+        if remaining is not None and remaining <= 0:
+            return {
+                "evaluation": {
+                    "verdict": "skip",
+                    "notes": ["deadline expired; verification skipped"],
+                },
+                "metadata": {"verify_skipped": "deadline"},
+            }
         docs = best_documents(state)
         # same trace id as the generate node: the verify admission lands on
         # the same flight record, where its prefix_hit_tokens show the
         # generate prompt head being reused from the radix cache
         request_id = state.get("metadata", {}).get("query_id")
+        # the remaining deadline bounds the audit decode too — without it
+        # the pump's expiry sweep could never cancel an expired caller's
+        # verify slot (verifier soft-fails internally, so an expiry here
+        # degrades to a 'warn' verdict rather than failing the answer)
+        deadline = deadline_ts(state)
         t0 = time.perf_counter()
         result = await asyncio.get_running_loop().run_in_executor(
             None,
             lambda: verifier.verify(
                 state["query"], answer, docs,
                 request_id=str(request_id) if request_id else None,
+                deadline_ts=deadline,
             ),
         )
         update: dict[str, Any] = {

@@ -86,6 +86,26 @@ def set_evaluation(state: RAGState, evaluation: dict[str, Any]) -> RAGState:
     return new  # type: ignore[return-value]
 
 
+def deadline_ts(state: RAGState) -> float | None:
+    """The request's absolute ``time.perf_counter()`` deadline, if the
+    serving layer stamped one into metadata (``deadline_ts``). Nodes use it
+    to bound decode work and to skip optional stages for expired callers."""
+    value = state.get("metadata", {}).get("deadline_ts")
+    try:
+        return float(value) if value is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
+def deadline_remaining_s(state: RAGState) -> float | None:
+    """Seconds left on the request deadline (negative = expired); None when
+    the request carries no deadline."""
+    ts = deadline_ts(state)
+    if ts is None:
+        return None
+    return ts - time.perf_counter()
+
+
 def best_documents(state: RAGState) -> list[Document]:
     """The most-processed document list available — selector falls back through
     reranked → retrieved (reference nodes.py:269-301 semantics)."""

@@ -28,8 +28,10 @@ class ErrorCode(str, Enum):
     VALIDATION_ERROR = "VALIDATION_ERROR"
     INVALID_INPUT = "INVALID_INPUT"
     PAYLOAD_TOO_LARGE = "PAYLOAD_TOO_LARGE"
-    # rate limiting
+    # rate limiting / load shedding
     RATE_LIMITED = "RATE_LIMITED"
+    OVERLOADED = "OVERLOADED"
+    DEADLINE_EXCEEDED = "DEADLINE_EXCEEDED"
     # resources
     NOT_FOUND = "NOT_FOUND"
     ALREADY_EXISTS = "ALREADY_EXISTS"
@@ -61,6 +63,8 @@ _DEFAULT_STATUS = {
     ErrorCode.INVALID_INPUT: 400,
     ErrorCode.PAYLOAD_TOO_LARGE: 413,
     ErrorCode.RATE_LIMITED: 429,
+    ErrorCode.OVERLOADED: 503,
+    ErrorCode.DEADLINE_EXCEEDED: 504,
     ErrorCode.NOT_FOUND: 404,
     ErrorCode.ALREADY_EXISTS: 409,
     ErrorCode.SERVICE_UNAVAILABLE: 503,
@@ -127,6 +131,33 @@ class RateLimitError(SentioError):
 
 class NotFoundError(SentioError):
     code = ErrorCode.NOT_FOUND
+
+
+class ServiceOverloaded(SentioError):
+    """Load shed at admission: the serving queue is full, the service is
+    draining, or the request's deadline cannot be met. Carries
+    ``retry_after_s`` so handlers can answer 429/503 + ``Retry-After`` —
+    shedding fast beats timing out slow (the caller retries elsewhere
+    instead of holding a connection that will die anyway)."""
+
+    code = ErrorCode.OVERLOADED
+    # the degradation ladder must NOT swallow sheds into a 200 "apology":
+    # the whole point is a fast, honest 429/503 the caller can act on
+    soft_fail_exempt = True
+
+    def __init__(self, message: str = "service overloaded",
+                 retry_after_s: float = 1.0, **kw) -> None:
+        kw.setdefault("retryable", True)
+        super().__init__(message, **kw)
+        self.details.setdefault("retry_after_s", retry_after_s)
+
+
+class DeadlineExceededError(SentioError):
+    """The caller-supplied deadline passed before (or while) the request
+    was served; any in-flight decode work was cancelled."""
+
+    code = ErrorCode.DEADLINE_EXCEEDED
+    soft_fail_exempt = True  # an expired caller gets 504, not an apology
 
 
 class ServiceUnavailableError(SentioError):

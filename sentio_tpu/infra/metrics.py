@@ -175,6 +175,15 @@ class MetricsCollector:
                 "XLA compilations at registered jit families", ["family"],
                 registry=r,
             ),
+            # admission-control outcomes: requests dropped at or after the
+            # decode-service door (queue_full / draining / deadline /
+            # expired / cancelled) — the overload story's headline series;
+            # a nonzero rate here is the signal to scale out or shed earlier
+            "shed": Counter(
+                "sentio_tpu_shed_total",
+                "requests shed / expired / cancelled by the decode service",
+                ["reason"], registry=r,
+            ),
             # the HPA scaling signal (deploy/kubernetes/hpa.yaml): CPU% is
             # meaningless for a TPU pod, queue depth is what saturates a slice
             "inflight": Gauge(
@@ -259,6 +268,16 @@ class MetricsCollector:
         self.memory.inc("xla_compiles", (family,), n)
         if self._prom:
             self._prom["xla_compiles"].labels(family).inc(n)
+
+    def record_shed(self, reason: str, n: int = 1) -> None:
+        """One request dropped by admission control or deadline enforcement
+        (``reason``: queue_full | draining | deadline | expired |
+        cancelled | crash)."""
+        if not self.enabled:
+            return
+        self.memory.inc("shed", (reason,), n)
+        if self._prom:
+            self._prom["shed"].labels(reason).inc(n)
 
     def record_breaker(self, name: str, state: str) -> None:
         value = {"closed": 0.0, "half_open": 1.0, "open": 2.0}.get(state, 0.0)

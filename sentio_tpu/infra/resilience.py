@@ -159,19 +159,33 @@ class CircuitBreaker:
 
 @dataclass
 class RetryPolicy:
-    """Jittered exponential backoff (reference AsyncRetry, patterns.py:403-462)."""
+    """Jittered exponential backoff (reference AsyncRetry, patterns.py:403-462).
+
+    ``rng`` injects a seeded ``random.Random`` so backoff jitter is
+    deterministic in tests; None uses the module-level generator."""
 
     max_attempts: int = 3
     base_delay_s: float = 0.2
     max_delay_s: float = 10.0
     jitter: float = 0.25
     retry_on: tuple[type[Exception], ...] = (Exception,)
+    rng: Optional[random.Random] = None
+
+    def _check_attempts(self) -> None:
+        # max_attempts <= 0 used to fall through the loop and `raise None`
+        # (a TypeError masking the config error) — fail with the real cause
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"RetryPolicy.max_attempts must be >= 1, got {self.max_attempts}"
+            )
 
     def delay(self, attempt: int) -> float:
         d = min(self.base_delay_s * (2**attempt), self.max_delay_s)
-        return d * (1.0 + random.uniform(-self.jitter, self.jitter))
+        jitter = (self.rng or random).uniform(-self.jitter, self.jitter)
+        return d * (1.0 + jitter)
 
     def run(self, fn: Callable[..., T], *args, **kwargs) -> T:
+        self._check_attempts()
         last: Optional[Exception] = None
         for attempt in range(self.max_attempts):
             try:
@@ -183,6 +197,7 @@ class RetryPolicy:
         raise last  # type: ignore[misc]
 
     async def arun(self, fn: Callable[..., Awaitable[T]], *args, **kwargs) -> T:
+        self._check_attempts()
         last: Optional[Exception] = None
         for attempt in range(self.max_attempts):
             try:
@@ -311,11 +326,18 @@ class HealthChecker:
 class FallbackResponseCache:
     """Disk-persisted query→response cache, sha256 keys + TTL (reference
     FallbackManager, fallbacks.py:18-159). Tier 1 of the degradation ladder:
-    a failing pipeline first replays the last good answer."""
+    a failing pipeline first replays the last good answer.
 
-    def __init__(self, cache_dir: Optional[str] = None, ttl_s: float = 24 * 3600.0) -> None:
+    Bounded: at most ``max_entries`` responses are kept (oldest-written
+    evicted first), and every mutation — including expired-entry deletion,
+    which previously lived only in memory and resurrected on restart —
+    persists to disk."""
+
+    def __init__(self, cache_dir: Optional[str] = None, ttl_s: float = 24 * 3600.0,
+                 max_entries: int = 512) -> None:
         self.dir = Path(cache_dir or Path.home() / ".cache" / "sentio_tpu_fallback")
         self.ttl_s = ttl_s
+        self.max_entries = max(int(max_entries), 1)
         self._path = self.dir / "responses.json"
         self._store: dict[str, dict[str, Any]] = {}
         self._lock = threading.Lock()
@@ -338,9 +360,23 @@ class FallbackResponseCache:
         except OSError:
             logger.warning("fallback cache persist failed", exc_info=True)
 
+    def _evict_locked(self) -> None:
+        """Drop least-recently-USED entries past the cap — an unbounded disk
+        cache grows one JSON blob per distinct query forever. Recency falls
+        back to the write stamp for entries never read (or loaded from a
+        pre-LRU disk file)."""
+        while len(self._store) > self.max_entries:
+            oldest = min(
+                self._store,
+                key=lambda k: self._store[k].get(
+                    "last_used", self._store[k].get("at", 0.0)),
+            )
+            del self._store[oldest]
+
     def put(self, query: str, response: str) -> None:
         with self._lock:
             self._store[self._key(query)] = {"response": response, "at": time.time()}  # wall-clock: TTL persists across restarts
+            self._evict_locked()
             self._persist()
 
     def get(self, query: str) -> Optional[str]:
@@ -350,7 +386,14 @@ class FallbackResponseCache:
                 return None
             if self.ttl_s > 0 and time.time() - entry["at"] > self.ttl_s:  # wall-clock: TTL persists across restarts
                 del self._store[self._key(query)]
+                # persist the deletion: an expired entry that only dies in
+                # memory comes back from disk on the next restart
+                self._persist()
                 return None
+            # recency for LRU eviction; deliberately NOT persisted per get
+            # (a disk write per cache hit on the degraded path would be
+            # worse than losing recency hints across restarts)
+            entry["last_used"] = time.time()  # wall-clock: stored beside the TTL stamp
             return entry["response"]
 
 

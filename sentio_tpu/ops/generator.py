@@ -82,16 +82,22 @@ class TpuProvider:
     name: str = "tpu"
 
     def chat(self, prompt: str, max_new_tokens: int, temperature: float,
-             request_id: Optional[str] = None) -> str:
+             request_id: Optional[str] = None,
+             deadline_ts: Optional[float] = None) -> str:
         if self.service is not None:
             try:
                 result = self.service.generate(
                     prompt, max_new_tokens=max_new_tokens, temperature=temperature,
-                    request_id=request_id,
+                    request_id=request_id, deadline_ts=deadline_ts,
                 )
                 if result.finish_reason != "error":
                     return result.text
-            except Exception:  # noqa: BLE001 — contiguous engine is the escape hatch
+            except Exception as exc:  # noqa: BLE001 — contiguous engine is the escape hatch
+                if getattr(exc, "soft_fail_exempt", False):
+                    # shed / expired deadline: retrying on the contiguous
+                    # engine would serve a caller that gave up (or double
+                    # the load the shed was protecting against) — fail fast
+                    raise
                 if self.engine is None:
                     raise
             if self.engine is None:
@@ -109,20 +115,23 @@ class TpuProvider:
         return result.text
 
     def stream(self, prompt: str, max_new_tokens: int, temperature: float,
-               request_id: Optional[str] = None) -> Iterator[str]:
+               request_id: Optional[str] = None,
+               deadline_ts: Optional[float] = None) -> Iterator[str]:
         if self.service is not None and hasattr(self.service, "generate_stream"):
             yielded_any = False
             try:
                 for piece in self.service.generate_stream(
                     prompt, max_new_tokens=max_new_tokens, temperature=temperature,
-                    request_id=request_id,
+                    request_id=request_id, deadline_ts=deadline_ts,
                 ):
                     yielded_any = True
                     yield piece
                 return
-            except Exception:  # noqa: BLE001 — contiguous engine is the escape hatch
-                # restarting after partial output would duplicate the answer
-                if yielded_any or self.engine is None:
+            except Exception as exc:  # noqa: BLE001 — contiguous engine is the escape hatch
+                # restarting after partial output would duplicate the
+                # answer; typed shed/deadline errors must not be retried
+                if (yielded_any or self.engine is None
+                        or getattr(exc, "soft_fail_exempt", False)):
                     raise
         yield from self.engine.stream(
             prompt, max_new_tokens=max_new_tokens, temperature=temperature
@@ -430,30 +439,42 @@ class LLMGenerator:
 
     # ------------------------------------------------------------- generation
 
-    def _trace_kwargs(self, method: str, request_id: Optional[str]) -> dict:
-        """``{"request_id": ...}`` only when the provider's method accepts
-        it — every real request is traced now, and an externally registered
-        provider with the pre-trace signature must stay working untraced
-        instead of TypeError-ing into the degradation ladder on all traffic.
-        Introspected once per (provider, method)."""
-        if not request_id:
-            return {}
-        cache = getattr(self, "_accepts_request_id", None)
+    def _method_accepts(self, method: str, kwarg: str) -> bool:
+        """Whether the provider's ``method`` takes ``kwarg`` — externally
+        registered providers with older signatures must keep working
+        (untraced / deadline-blind) instead of TypeError-ing into the
+        degradation ladder on all traffic. Introspected once per
+        (method, kwarg)."""
+        cache = getattr(self, "_accepts_kwarg", None)
         if cache is None:
-            cache = self._accepts_request_id = {}
-        accepts = cache.get(method)
+            cache = self._accepts_kwarg = {}
+        key = (method, kwarg)
+        accepts = cache.get(key)
         if accepts is None:
             import inspect
 
             try:
                 params = inspect.signature(getattr(self.provider, method)).parameters
-                accepts = "request_id" in params or any(
+                accepts = kwarg in params or any(
                     p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
                 )
             except (TypeError, ValueError):  # builtins/C callables: assume yes
                 accepts = True
-            cache[method] = accepts
-        return {"request_id": request_id} if accepts else {}
+            cache[key] = accepts
+        return accepts
+
+    def _trace_kwargs(
+        self, method: str, request_id: Optional[str],
+        deadline_ts: Optional[float] = None,
+    ) -> dict:
+        """The optional per-request context kwargs (trace id, absolute
+        deadline) the provider's method is able to receive."""
+        out: dict = {}
+        if request_id and self._method_accepts(method, "request_id"):
+            out["request_id"] = request_id
+        if deadline_ts is not None and self._method_accepts(method, "deadline_ts"):
+            out["deadline_ts"] = deadline_ts
+        return out
 
     def generate(
         self,
@@ -463,6 +484,7 @@ class LLMGenerator:
         temperature: Optional[float] = None,
         max_new_tokens: Optional[int] = None,
         request_id: Optional[str] = None,
+        deadline_ts: Optional[float] = None,
     ) -> str:
         prompt = self.build_prompt(query, documents)
         temp = temperature if temperature is not None else self.config.temperature(mode)
@@ -470,7 +492,7 @@ class LLMGenerator:
             prompt,
             max_new_tokens=max_new_tokens or self.config.max_new_tokens,
             temperature=temp,
-            **self._trace_kwargs("chat", request_id),
+            **self._trace_kwargs("chat", request_id, deadline_ts),
         )
 
     def stream(
@@ -481,6 +503,7 @@ class LLMGenerator:
         temperature: Optional[float] = None,
         max_new_tokens: Optional[int] = None,
         request_id: Optional[str] = None,
+        deadline_ts: Optional[float] = None,
     ) -> Iterator[str]:
         prompt = self.build_prompt(query, documents)
         temp = temperature if temperature is not None else self.config.temperature(mode)
@@ -488,18 +511,19 @@ class LLMGenerator:
             prompt,
             max_new_tokens=max_new_tokens or self.config.max_new_tokens,
             temperature=temp,
-            **self._trace_kwargs("stream", request_id),
+            **self._trace_kwargs("stream", request_id, deadline_ts),
         )
 
     def chat_raw(self, prompt: str, max_new_tokens: int, temperature: float,
-                 request_id: Optional[str] = None) -> str:
+                 request_id: Optional[str] = None,
+                 deadline_ts: Optional[float] = None) -> str:
         """Direct provider access (verifier path — shares the weights). A
         ``request_id`` ties the call into the flight recorder, so the
         verify node's engine admission shows up on the same trace as the
         generate node's."""
         return self.provider.chat(
             prompt, max_new_tokens=max_new_tokens, temperature=temperature,
-            **self._trace_kwargs("chat", request_id),
+            **self._trace_kwargs("chat", request_id, deadline_ts),
         )
 
 

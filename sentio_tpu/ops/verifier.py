@@ -52,6 +52,7 @@ class AnswerVerifier:
         answer: str,
         documents: Sequence[Document],
         request_id: Optional[str] = None,
+        deadline_ts: Optional[float] = None,
     ) -> VerifyResult:
         try:
             # the audit prompt EMBEDS the generate prompt verbatim as its
@@ -67,11 +68,14 @@ class AnswerVerifier:
                 query=query,
                 answer=answer,
             )
+            # the caller's deadline bounds the audit decode too — an
+            # expired caller's verification is cancelled like its generation
             reply = self.generator.chat_raw(
                 prompt,
                 max_new_tokens=self.config.verifier_max_tokens,
                 temperature=0.0,
                 request_id=request_id,
+                deadline_ts=deadline_ts,
             )
             return self._normalize(reply)
         except Exception as exc:  # noqa: BLE001 — the audit must never 500

@@ -57,6 +57,7 @@ import numpy as np
 
 from sentio_tpu.analysis.audit.registry import jit_family
 from sentio_tpu.analysis.sanitizer import check_engine_invariants, engine_guard
+from sentio_tpu.infra import faults
 from sentio_tpu.models.llama import LlamaConfig
 from sentio_tpu.parallel.batcher import bucket_size
 
@@ -381,6 +382,11 @@ class _Request:
     max_new: int
     temperature: float
     submit_t: float = 0.0
+    # absolute time.perf_counter() deadline (None = no deadline). The queue
+    # drops an expired request BEFORE admission — prefilling for a caller
+    # that already gave up wastes exactly the ticks continuous batching is
+    # supposed to reclaim (Yu et al., OSDI '22)
+    deadline_ts: Optional[float] = None
     # lazily cached tokenization — _admit may inspect a queued request many
     # times (skip-ahead scans the queue every tick) without re-encoding
     tok_ids: Optional[list] = None
@@ -392,7 +398,7 @@ class PagedResult:
     text: str
     tokens: list[int]
     prompt_tokens: int
-    finish_reason: str  # "stop" | "length"
+    finish_reason: str  # "stop" | "length" | "cancelled" | "expired" | "error"
     # prompt tokens actually forwarded at admission vs served read-only from
     # the radix prefix cache (prefill_tokens + prefix_hit_tokens ==
     # prompt_tokens) — the per-request evidence of prefill work skipped
@@ -880,13 +886,17 @@ class ContinuousBatchingEngine:
 
     # --------------------------------------------------------------- public
 
-    def submit(self, prompt: str, max_new_tokens: int = 64, temperature: float = 0.0) -> int:
+    def submit(self, prompt: str, max_new_tokens: int = 64, temperature: float = 0.0,
+               deadline_ts: Optional[float] = None) -> int:
+        """``deadline_ts`` is an absolute ``time.perf_counter()`` deadline:
+        the queue drops the request (finish_reason="expired") if it is still
+        waiting for a slot when the deadline passes."""
         if self._san is not None:
             self._san.enter("submit")
         rid = next(self._next_id)
         self._queue.append(_Request(
             rid, prompt, max_new_tokens, temperature,
-            submit_t=time.perf_counter(),
+            submit_t=time.perf_counter(), deadline_ts=deadline_ts,
         ))
         return rid
 
@@ -1023,6 +1033,9 @@ class ContinuousBatchingEngine:
         completed this tick."""
         if self._san is not None:
             self._san.enter("step")
+        # chaos-drill injection point: a raised fault propagates exactly like
+        # a real failed device dispatch (the serving pump resets + requeues)
+        faults.hit("paged.step")
         self.last_tick_active = 0
         self._admit()
         if self.prefill_chunk is not None:
@@ -1171,9 +1184,22 @@ class ContinuousBatchingEngine:
             return
 
         batch: list[tuple[int, _Request, list[int], int]] = []
+        now = time.perf_counter()
         qi = 0
         while qi < len(self._queue) and free:
             req = self._queue[qi]
+            if req.deadline_ts is not None and now >= req.deadline_ts:
+                # caller's deadline passed while queued: drop BEFORE paying
+                # prefill — the result surfaces so the layer above can close
+                # out its waiter with a typed deadline error
+                self._queue.pop(qi)
+                if qi == 0:
+                    self._head_skips = 0
+                self._finished_buffer.append(PagedResult(
+                    request_id=req.request_id, text="", tokens=[],
+                    prompt_tokens=0, finish_reason="expired",
+                ))
+                continue
             if req.tok_ids is None:
                 req.tok_ids = self.tokenizer.encode(req.prompt, add_bos=True)
             tok_ids = req.tok_ids
@@ -1365,6 +1391,7 @@ class ContinuousBatchingEngine:
     ) -> None:
         """One prefill+scatter+sample dispatch for up to max(ADMIT_BUCKETS)
         same-width-bucket rows (rows pad up to a batch bucket)."""
+        faults.hit("paged.admit_scatter")
         ids, lens, temps, scat, positions = self._assemble_prefill(
             [(tok_ids, req.temperature, self.slots[slot_idx].pages)
              for slot_idx, req, tok_ids in chunk],
@@ -1390,6 +1417,7 @@ class ContinuousBatchingEngine:
         cache from its matched prefix pages (per-row table padded to the
         ``pnb`` page bucket with scratch page 0, per-row true prior lengths
         riding as data)."""
+        faults.hit("paged.admit_scatter")
         rows_data = []
         n_prior = []
         for slot_idx, req, tok_ids, shared in chunk:

@@ -20,6 +20,25 @@ by the pump thread (no lock held across device ticks — an engine-wide lock
 would let the pump starve submitters, since a hot loop reacquires an
 uncontended lock before waiters wake). Submitters and the pump meet at
 ``_mutex``, held only for quick inbox/bookkeeping operations.
+
+Overload & failure semantics (the request-lifecycle robustness layer):
+
+* **admission control** — the inbox + admitted set is bounded by
+  ``max_queue``; a submit over the bound (or while draining, or whose
+  deadline the projected wait already exceeds) raises a typed
+  :class:`~sentio_tpu.infra.exceptions.ServiceOverloaded` that the HTTP
+  layer maps to 429/503 + ``Retry-After`` — shed fast, don't time out slow;
+* **deadlines** — a per-request absolute deadline rides the ticket and the
+  engine ``_Request``; the pump drops expired tickets before admission and
+  cancels expired in-flight slots every tick, so the fused decode batch
+  never spends sub-steps on a caller that already gave up;
+* **crash containment** — a failed decode tick resets the engine and, when
+  the reset succeeds, REQUEUES innocent waiters (each ticket carries a
+  retry budget) instead of failing all of them; only exhausted-budget
+  tickets see an error result, and ``_broken`` still latches when the
+  reset itself fails;
+* **graceful drain** — :meth:`drain` stops admitting, lets in-flight slots
+  finish within a deadline, then closes (the serve app's shutdown hook).
 """
 
 from __future__ import annotations
@@ -36,13 +55,19 @@ from sentio_tpu.analysis.sanitizer import (
     bind_engine_owner,
     make_lock,
 )
+from sentio_tpu.infra.exceptions import DeadlineExceededError, ServiceOverloaded
 from sentio_tpu.infra.flight import get_flight_recorder
 from sentio_tpu.infra.metrics import get_metrics
 from sentio_tpu.runtime.paged import ContinuousBatchingEngine, PagedResult
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["PagedGenerationService", "GenerationTimeout"]
+__all__ = [
+    "PagedGenerationService",
+    "GenerationTimeout",
+    "ServiceOverloaded",
+    "DeadlineExceededError",
+]
 
 
 class GenerationTimeout(Exception):
@@ -56,13 +81,24 @@ class _Ticket:
     temperature: float
     event: threading.Event = field(default_factory=threading.Event)
     result: Optional[PagedResult] = None
+    # terminal typed failure (deadline expiry, shed) — raised to the caller
+    # instead of a result; exactly one of result/error is set at event time
+    error: Optional[Exception] = None
     # streaming callers: the pump pushes ("toks", [ids...]) deltas after each
-    # tick and ("done", result) at retirement; None for plain generate()
+    # tick, ("done", result) at retirement, and ("err", exc) on a typed
+    # failure; None for plain generate()
     stream_q: Optional[_queue.Queue] = None
     sent_tokens: int = 0  # how many emitted tokens were already pushed
     # caller abandoned (timeout / disconnected stream): the pump cancels the
     # engine request instead of decoding to max_new for nobody
     cancelled: bool = False
+    # absolute time.perf_counter() deadline: expired tickets are dropped
+    # before admission and cancelled mid-decode (None = no deadline)
+    deadline_ts: Optional[float] = None
+    # crash-containment budget: how many more times this ticket may be
+    # requeued after a failed tick (with a successful engine reset) before
+    # it gets the error result instead
+    retries_left: int = 0
     # flight-recorder trace id (the serving layer's query_id) — None for
     # untraced callers; telemetry is still recorded to /metrics either way
     request_id: Optional[str] = None
@@ -87,9 +123,25 @@ class PagedGenerationService:
         self,
         engine: ContinuousBatchingEngine,
         default_timeout_s: float = 600.0,
+        max_queue: Optional[int] = None,
+        default_deadline_s: Optional[float] = None,
+        retry_budget: int = 1,
     ) -> None:
         self.engine = engine
         self.default_timeout_s = default_timeout_s
+        # admission bound on waiting work (inbox + admitted, not yet done);
+        # a submit past it sheds with 429 instead of queueing unboundedly.
+        # The default is deliberately deep (8x slot depth): shedding is tail
+        # protection against pathological pileups, not routine backpressure
+        self.max_queue = (
+            int(max_queue) if max_queue is not None
+            else max(8 * engine.max_slots, 64)
+        )
+        # deadline applied to requests that carry none of their own
+        # (None = requests without a deadline never expire)
+        self.default_deadline_s = default_deadline_s
+        # crash containment: requeues granted per ticket across failed ticks
+        self.retry_budget = max(int(retry_budget), 0)
         # inbox + bookkeeping ONLY, never device work
         self._mutex = make_lock("PagedGenerationService._mutex")
         self._inbox: list[_Ticket] = []  # guarded-by: _mutex
@@ -98,6 +150,18 @@ class PagedGenerationService:
         self._pump_running = False  # guarded-by: _mutex
         self._closed = False  # guarded-by: _mutex
         self._broken = False  # guarded-by: _mutex
+        self._draining = False  # guarded-by: _mutex
+        # overload/robustness telemetry (lifetime totals; /metrics publishes
+        # them via stats() and the pump stamps them onto tick events)
+        self._shed = 0  # guarded-by: _mutex
+        self._expired = 0  # guarded-by: _mutex
+        self._cancelled = 0  # guarded-by: _mutex
+        self._requeued = 0  # guarded-by: _mutex
+        self._tick_failures = 0  # guarded-by: _mutex
+        self._pump_leaked = 0  # guarded-by: _mutex
+        # EMA of recent TTFT seconds, updated by the pump — the projected-
+        # wait estimate admission control weighs against a deadline
+        self._ttft_ema = 0.0  # guarded-by: _mutex
         # occupancy telemetry (the serving-path answer to BatcherStats):
         # ticks with >1 active slot are decode steps shared across requests
         self._ticks = 0  # guarded-by: _mutex
@@ -114,23 +178,30 @@ class PagedGenerationService:
         temperature: float = 0.0,
         timeout_s: Optional[float] = None,
         request_id: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+        deadline_ts: Optional[float] = None,
     ) -> PagedResult:
         """Submit one request and block until its tokens are done. Safe to
         call from any number of threads concurrently — that concurrency IS
         the batch. A ``request_id`` ties this generation into the flight
-        recorder's per-request trace (TTFT/TPOT + its decode-tick window)."""
+        recorder's per-request trace (TTFT/TPOT + its decode-tick window).
+
+        ``deadline_ts`` (absolute ``time.perf_counter()``) or ``deadline_s``
+        (relative) bound how long the caller will wait: admission sheds when
+        the deadline is unmeetable, and the pump cancels the request the
+        tick its deadline passes. Raises :class:`ServiceOverloaded` (shed),
+        :class:`DeadlineExceededError` (expired), or
+        :class:`GenerationTimeout` (no deadline, plain timeout)."""
+        deadline_ts = self._resolve_deadline(deadline_s, deadline_ts)
         ticket = _Ticket(prompt, max_new_tokens, temperature,
-                         request_id=request_id, t_submit=time.perf_counter())
+                         request_id=request_id, t_submit=time.perf_counter(),
+                         deadline_ts=deadline_ts,
+                         retries_left=self.retry_budget)
         if request_id:
             get_flight_recorder().note_engine_submit(request_id)
         try:
             with self._mutex:
-                if self._closed:
-                    raise RuntimeError("generation service is closed")
-                if self._broken:
-                    raise RuntimeError("paged decode engine is down (reset failed)")
-                self._inbox.append(ticket)
-                self._ensure_pump()
+                self._admit_ticket_locked(ticket)
         except Exception:
             # note_engine_submit already opened the tick window — close it,
             # or the record absorbs every unrelated future tick
@@ -138,12 +209,31 @@ class PagedGenerationService:
                 get_flight_recorder().finish_engine(
                     request_id, finish_reason="rejected")
             raise
-        if not ticket.event.wait(timeout_s or self.default_timeout_s):
-            ticket.cancelled = True  # pump frees the slot on its next loop
-            raise GenerationTimeout(
-                f"generation did not finish within "
-                f"{timeout_s or self.default_timeout_s:.0f}s"
-            )
+        wait_s = self._wait_budget(timeout_s, deadline_ts)
+        if not ticket.event.wait(wait_s):
+            # completion happens under _mutex, so deciding under the same
+            # mutex is race-free: an event set between wait()'s timeout and
+            # this check means the work FINISHED — return it instead of
+            # raising a timeout that cancels completed work
+            expired = (deadline_ts is not None
+                       and time.perf_counter() >= deadline_ts)
+            with self._mutex:
+                finished = ticket.event.is_set()
+                # an expired ticket is left for the pump's deadline sweep
+                # (which cancels it AND counts it as expired); marking it
+                # cancelled here would misfile it under caller-abandoned
+                if not finished and not expired:
+                    ticket.cancelled = True  # pump frees the slot next loop
+            if not finished:
+                if expired:
+                    raise DeadlineExceededError(
+                        "deadline expired before the result was ready"
+                    )
+                raise GenerationTimeout(
+                    f"generation did not finish within {wait_s:.0f}s"
+                )
+        if ticket.error is not None:
+            raise ticket.error
         assert ticket.result is not None
         return ticket.result
 
@@ -154,24 +244,26 @@ class PagedGenerationService:
         temperature: float = 0.0,
         timeout_s: Optional[float] = None,
         request_id: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+        deadline_ts: Optional[float] = None,
     ) -> Iterator[str]:
         """Streaming variant: yields decoded text increments as the shared
         decode batch produces them (chunks of up to steps_per_tick tokens —
         the streaming request STAYS in the continuous batch instead of
         monopolizing a contiguous-cache engine). UTF-8 safe: bytes buffer
-        until they decode cleanly."""
+        until they decode cleanly. Deadline semantics match
+        :meth:`generate`; a deadline that passes mid-stream raises
+        :class:`DeadlineExceededError` from the iterator."""
+        deadline_ts = self._resolve_deadline(deadline_s, deadline_ts)
         ticket = _Ticket(prompt, max_new_tokens, temperature, stream_q=_queue.Queue(),
-                         request_id=request_id, t_submit=time.perf_counter())
+                         request_id=request_id, t_submit=time.perf_counter(),
+                         deadline_ts=deadline_ts,
+                         retries_left=self.retry_budget)
         if request_id:
             get_flight_recorder().note_engine_submit(request_id)
         try:
             with self._mutex:
-                if self._closed:
-                    raise RuntimeError("generation service is closed")
-                if self._broken:
-                    raise RuntimeError("paged decode engine is down (reset failed)")
-                self._inbox.append(ticket)
-                self._ensure_pump()
+                self._admit_ticket_locked(ticket)
         except Exception:
             if request_id:
                 get_flight_recorder().finish_engine(
@@ -179,7 +271,7 @@ class PagedGenerationService:
             raise
 
         tokenizer = self.engine.tokenizer
-        deadline = timeout_s or self.default_timeout_s
+        deadline = self._wait_budget(timeout_s, deadline_ts)
         emitted: list[int] = []
         flushed = ""
         try:
@@ -187,9 +279,19 @@ class PagedGenerationService:
                 try:
                     kind, payload = ticket.stream_q.get(timeout=deadline)
                 except _queue.Empty:
+                    if (ticket.deadline_ts is not None
+                            and time.perf_counter() >= ticket.deadline_ts):
+                        raise DeadlineExceededError(
+                            "deadline expired before the stream produced "
+                            "anything"
+                        ) from None
                     raise GenerationTimeout(
                         f"stream produced nothing for {deadline:.0f}s"
                     ) from None
+                if kind == "err":
+                    # typed terminal failure (deadline expiry, shed at
+                    # requeue time) — surface it as the iterator's exception
+                    raise payload
                 if kind == "toks":
                     emitted.extend(payload)
                 else:  # "done"
@@ -215,17 +317,157 @@ class PagedGenerationService:
                     flushed = safe
         finally:
             # abandoned mid-decode (timeout, consumer disconnect → generator
-            # close): tell the pump to cancel instead of decoding for nobody
-            if ticket.result is None:
+            # close): tell the pump to cancel instead of decoding for nobody.
+            # An EXPIRED stream is left for the pump's deadline sweep, which
+            # counts it as expired — marking it cancelled here would misfile
+            # a deadline miss under caller-abandoned (same rule as generate)
+            if ticket.result is None and ticket.error is None and not (
+                ticket.deadline_ts is not None
+                and time.perf_counter() >= ticket.deadline_ts
+            ):
                 ticket.cancelled = True
+
+    # ------------------------------------------------------------ admission
+
+    def _resolve_deadline(
+        self, deadline_s: Optional[float], deadline_ts: Optional[float]
+    ) -> Optional[float]:
+        """Absolute perf_counter deadline from the caller's absolute or
+        relative form, falling back to the service default (None = none)."""
+        if deadline_ts is not None:
+            return deadline_ts
+        rel = deadline_s if deadline_s is not None else self.default_deadline_s
+        if rel is None or rel <= 0:
+            return None
+        return time.perf_counter() + rel
+
+    def _wait_budget(
+        self, timeout_s: Optional[float], deadline_ts: Optional[float]
+    ) -> float:
+        """How long the caller blocks: its timeout, capped near the deadline
+        (+ grace for the pump to deliver the typed deadline error rather
+        than a generic timeout racing it)."""
+        wait = timeout_s or self.default_timeout_s
+        if deadline_ts is not None:
+            wait = min(wait, max(deadline_ts - time.perf_counter(), 0.0) + 5.0)
+        return wait
+
+    def check_admission(self, deadline_ts: Optional[float] = None) -> None:
+        """Raise the shed error a submit right now would raise, WITHOUT
+        enqueuing. The SSE path calls this before committing a 200 status
+        line — after ``response.prepare`` a shed can only degrade, not 429."""
+        with self._mutex:
+            if self._closed:
+                raise RuntimeError("generation service is closed")
+            if self._broken:
+                raise RuntimeError("paged decode engine is down (reset failed)")
+            self._check_admission_locked(deadline_ts)
+
+    def _admit_ticket_locked(self, ticket: _Ticket) -> None:  # lock-held: _mutex
+        assert_held(self._mutex)
+        if self._closed:
+            raise RuntimeError("generation service is closed")
+        if self._broken:
+            raise RuntimeError("paged decode engine is down (reset failed)")
+        self._check_admission_locked(ticket.deadline_ts)
+        self._inbox.append(ticket)
+        self._ensure_pump()
+
+    def _check_admission_locked(
+        self, deadline_ts: Optional[float]
+    ) -> None:  # lock-held: _mutex
+        """Admission control: shed (typed, fast) instead of queueing work
+        the service cannot finish. Counts every rejection."""
+        assert_held(self._mutex)
+        now = time.perf_counter()
+        if self._draining:
+            self._shed += 1
+            get_metrics().record_shed("draining")
+            raise ServiceOverloaded(
+                "generation service is draining", status=503,
+                retry_after_s=5.0,
+            )
+        pending = len(self._inbox) + len(self._tickets)
+        if pending >= self.max_queue:
+            self._shed += 1
+            get_metrics().record_shed("queue_full")
+            raise ServiceOverloaded(
+                f"decode queue full ({pending}/{self.max_queue} waiting)",
+                status=429,
+                retry_after_s=max(self._projected_wait_locked(pending) or 0.0, 1.0),
+            )
+        if deadline_ts is not None:
+            remaining = deadline_ts - now
+            if remaining <= 0:
+                self._shed += 1
+                get_metrics().record_shed("deadline")
+                raise DeadlineExceededError("deadline expired before submit")
+            projected = self._projected_wait_locked(pending)
+            if projected is not None and projected > remaining:
+                self._shed += 1
+                get_metrics().record_shed("deadline")
+                raise ServiceOverloaded(
+                    f"projected wait {projected:.2f}s exceeds remaining "
+                    f"deadline budget {remaining:.2f}s",
+                    status=503, retry_after_s=1.0,
+                )
+
+    def _projected_wait_locked(
+        self, pending: int
+    ) -> Optional[float]:  # lock-held: _mutex
+        """Crude first-token wait estimate: recent TTFT (EMA, pump-updated)
+        scaled by backlog depth relative to the slot count. None until the
+        first completion — a cold service never sheds on projection."""
+        assert_held(self._mutex)
+        if self._ttft_ema <= 0.0:
+            return None
+        return self._ttft_ema * (1.0 + pending / max(self.engine.max_slots, 1))
+
+    # ------------------------------------------------------------ lifecycle
+
+    def drain(self, deadline_s: float = 30.0) -> dict:
+        """Graceful shutdown: stop admitting (new submits shed with 503),
+        let in-flight and queued work finish for up to ``deadline_s``, then
+        close. Waiters still pending at the deadline get the closed-service
+        error result from the exiting pump. Returns what happened."""
+        with self._mutex:
+            self._draining = True
+        t_end = time.perf_counter() + max(deadline_s, 0.0)
+        pending = 0
+        while True:
+            with self._mutex:
+                pending = len(self._inbox) + len(self._tickets)
+            if pending == 0 or time.perf_counter() >= t_end:
+                break
+            time.sleep(0.02)
+        self.close()
+        return {"drained": pending == 0, "abandoned": pending}
 
     def close(self) -> None:
         with self._mutex:
             self._closed = True
-            pump, self._pump = self._pump, None
+            pump = self._pump
         # join OUTSIDE the mutex: the exiting pump needs it to fail waiters
-        if pump is not None:
-            pump.join(timeout=10.0)
+        if pump is None:
+            return
+        pump.join(timeout=10.0)
+        if pump.is_alive():
+            # a pump that won't die is a leaked thread pinning the engine —
+            # surface it (stats()['pump_leaked']) instead of silently
+            # dropping the reference like the join's return value invites
+            logger.warning(
+                "paged decode pump %r did not exit within 10s "
+                "(alive=%s, daemon=%s); thread leaked — see stats()",
+                pump.name, pump.is_alive(), pump.daemon,
+            )
+            with self._mutex:
+                self._pump_leaked += 1
+        # drop the ref either way: close() is called twice on shutdown
+        # (drain, then container cleanup) — re-joining a leaked pump would
+        # stall another 10s and double-count the same leak
+        with self._mutex:
+            if self._pump is pump:
+                self._pump = None
 
     def stats(self) -> dict:
         # engine fields are read without a lock: the pump owns the engine,
@@ -241,6 +483,15 @@ class PagedGenerationService:
                     round(self._active_sum / self._ticks, 3) if self._ticks else 0.0
                 ),
                 "max_active_slots": self._max_active,
+                # overload / robustness surface
+                "max_queue": self.max_queue,
+                "draining": int(self._draining),
+                "shed": self._shed,
+                "expired": self._expired,
+                "cancelled": self._cancelled,
+                "requeued": self._requeued,
+                "tick_failures": self._tick_failures,
+                "pump_leaked": self._pump_leaked,
             }
 
     def warmup(self, max_new_tokens: int = 4) -> dict:
@@ -283,8 +534,11 @@ class PagedGenerationService:
 
         def run(text: str) -> None:
             nonlocal prompts
+            # deadline_s=0 opts OUT of the service default deadline: warmup
+            # generations include multi-second cold compiles, and expiring
+            # them would abort startup (and leave fence variants uncompiled)
             self.generate(text, max_new_tokens=max_new_tokens,
-                          temperature=0.0)
+                          temperature=0.0, deadline_s=0)
             prompts += 1
 
         # ByteTokenizer: 1 char = 1 token, +1 for BOS — a (w - 1)-char
@@ -337,7 +591,7 @@ class PagedGenerationService:
             threading.Thread(
                 target=self.generate, args=("b" * n_short,),
                 kwargs={"max_new_tokens": max_new_tokens,
-                        "temperature": 0.0},
+                        "temperature": 0.0, "deadline_s": 0},
                 daemon=True,
             )
             for _ in range(burst_n)
@@ -347,6 +601,11 @@ class PagedGenerationService:
         for t in threads:
             t.join()
         prompts += len(threads)
+        with self._mutex:
+            # warmup TTFTs are compile-dominated — seeding the admission
+            # EMA with them would shed the first real deadline-carrying
+            # requests on a wildly inflated projected wait
+            self._ttft_ema = 0.0
         return {"prompts": prompts,
                 "xla_compiles": fence.compiles_total() - before}
 
@@ -398,36 +657,54 @@ class PagedGenerationService:
         last_hit_toks = self.engine.prefix_hit_tokens_total
         last_miss_toks = self.engine.prefix_miss_tokens_total
         while True:
+            now = time.perf_counter()
             with self._mutex:
                 for ticket in self._inbox:
                     if ticket.cancelled:
-                        # abandoned before admission: close the tick window
-                        # note_engine_submit opened, same as the admitted-
-                        # cancel path below
-                        if ticket.request_id:
-                            recorder.finish_engine(
-                                ticket.request_id, finish_reason="cancelled"
-                            )
+                        # abandoned before admission
+                        self._close_cancelled_locked(ticket)
+                        continue
+                    if (ticket.deadline_ts is not None
+                            and now >= ticket.deadline_ts):
+                        # expired before admission: never pay prefill for a
+                        # caller that already gave up
+                        self._expired += 1
+                        metrics.record_shed("expired")
+                        self._finish_error_locked(
+                            ticket,
+                            DeadlineExceededError(
+                                "deadline expired before admission"),
+                            "expired",
+                        )
                         continue
                     rid = self.engine.submit(
                         ticket.prompt,
                         max_new_tokens=ticket.max_new_tokens,
                         temperature=ticket.temperature,
+                        deadline_ts=ticket.deadline_ts,
                     )
                     self._tickets[rid] = ticket
                 self._inbox.clear()
-                # abandoned callers: stop decoding for nobody, free the slot
+                # abandoned or expired callers: stop decoding for nobody,
+                # free the slot for live traffic
                 for rid, ticket in list(self._tickets.items()):
                     if ticket.cancelled:
                         self.engine.cancel(rid)
                         self._tickets.pop(rid, None)
-                        if ticket.request_id:
-                            # pin tick_last NOW — an open engine section
-                            # would keep absorbing unrelated future ticks
-                            # into this request's /debug/flight window
-                            recorder.finish_engine(
-                                ticket.request_id, finish_reason="cancelled"
-                            )
+                        self._close_cancelled_locked(ticket)
+                    elif (ticket.deadline_ts is not None
+                          and now >= ticket.deadline_ts):
+                        self.engine.cancel(rid)
+                        self._tickets.pop(rid, None)
+                        self._expired += 1
+                        metrics.record_shed("expired")
+                        self._finish_error_locked(
+                            ticket,
+                            DeadlineExceededError(
+                                "deadline expired mid-decode; request "
+                                "cancelled"),
+                            "expired",
+                        )
                 if self._closed or not self.engine.has_work:
                     # flag flips inside the mutex: a racing submit either
                     # lands in the inbox before this check (we continue) or
@@ -443,12 +720,13 @@ class PagedGenerationService:
                 finished = self.engine.step()
                 tick_dur_s = time.perf_counter() - t_tick
             except Exception:
-                logger.exception("paged decode tick failed; failing waiters")
+                logger.exception(
+                    "paged decode tick failed; attempting crash containment")
                 # the failed dispatch may have consumed the donated pool
                 # buffers and left slots half-admitted — rebuild the decode
                 # state so the NEXT request gets a working engine instead of
                 # a permanently poisoned one. Reset runs BEFORE waiters are
-                # failed and before _pump_running flips: this pump still
+                # touched and before _pump_running flips: this pump still
                 # exclusively owns the engine, so a retrying caller cannot
                 # start a new pump that races the reset.
                 reset_ok = True
@@ -457,11 +735,70 @@ class PagedGenerationService:
                 except Exception:
                     logger.exception("paged engine reset failed; paged path disabled")
                     reset_ok = False
+                casualties: list[_Ticket] = []
                 with self._mutex:
-                    self._pump_running = False
-                    self._broken = self._broken or not reset_ok
-                    self._fail_all_locked("decode tick failed")
-                return
+                    self._tick_failures += 1
+                    if not reset_ok:
+                        self._pump_running = False
+                        self._broken = True
+                        self._fail_all_locked(
+                            "decode tick failed; engine reset failed")
+                        return
+                    # crash containment: the reset brought the engine back —
+                    # requeue innocent waiters instead of failing every one
+                    # of them. ADMITTED tickets were part of the failed tick
+                    # and burn one retry; inbox tickets never dispatched, so
+                    # they requeue for free (charging them would let a
+                    # request exhaust its budget with zero execution
+                    # attempts). Only exhausted-budget tickets — or streams
+                    # that already delivered tokens, which cannot restart
+                    # without duplicating output — get the error result.
+                    survivors: list[_Ticket] = []
+                    requeued = 0
+                    for ticket in self._tickets.values():
+                        if ticket.event.is_set():
+                            continue
+                        if ticket.cancelled:
+                            # abandoned caller swept up in the crash
+                            self._close_cancelled_locked(ticket)
+                            continue
+                        resumable = (
+                            ticket.stream_q is None or ticket.sent_tokens == 0
+                        )
+                        if resumable and ticket.retries_left > 0:
+                            ticket.retries_left -= 1
+                            requeued += 1
+                            survivors.append(ticket)
+                        else:
+                            casualties.append(ticket)
+                    for ticket in self._inbox:
+                        if ticket.event.is_set():
+                            continue
+                        if ticket.cancelled:
+                            self._close_cancelled_locked(ticket)
+                            continue
+                        survivors.append(ticket)  # free: never dispatched
+                    self._tickets.clear()
+                    self._inbox.clear()
+                    self._inbox.extend(survivors)
+                    self._requeued += requeued
+                    for ticket in casualties:
+                        self._fail_ticket_locked(ticket, "decode tick failed")
+                    if casualties:
+                        # counted BEFORE the early returns below, or pump
+                        # exits (no survivors / closed) would drop exactly
+                        # the sheds where waiters actually failed
+                        metrics.record_shed("crash", len(casualties))
+                    if self._closed:
+                        self._pump_running = False
+                        self._fail_all_locked("service closed")
+                        return
+                    if not self._inbox:
+                        self._pump_running = False
+                        return
+                # requeued tickets resubmit at the top of the loop; THIS
+                # pump keeps engine ownership across the reset (no handoff)
+                continue
             # in-tick occupancy from the engine: rows that shared the fused
             # decode dispatch (post-tick slot counts would miss requests that
             # retired inside the tick)
@@ -516,6 +853,11 @@ class PagedGenerationService:
                     prefix_cache_pages=(radix.pages_held if radix else 0),
                     free_pages=free,
                     used_pages=engine.allocator.num_pages - 1 - free,
+                    # overload counters (lifetime totals — diffs between
+                    # consecutive ticks attribute sheds to a tick window)
+                    shed_total=self._shed,  # lint: allow(lock-discipline) — GIL-atomic total
+                    expired_total=self._expired,  # lint: allow(lock-discipline) — GIL-atomic total
+                    cancelled_total=self._cancelled,  # lint: allow(lock-discipline) — GIL-atomic total
                 )
                 last_prefill = engine.prefill_tokens_total
                 last_decode = engine.decode_tokens_total
@@ -547,6 +889,7 @@ class PagedGenerationService:
                         ticket.tokens_first = len(slot.emitted)
                         metrics.record_ttft(now - ticket.t_submit,
                                             path=ticket.path)
+                        self._note_ttft_locked(now - ticket.t_submit)
                     if ticket.stream_q is None:
                         continue
                     if len(slot.emitted) > ticket.sent_tokens:
@@ -555,14 +898,41 @@ class PagedGenerationService:
                         )
                         ticket.sent_tokens = len(slot.emitted)
                 for result in finished:
-                    self._completed += 1
                     ticket = self._tickets.pop(result.request_id, None)
-                    if ticket is not None:
-                        self._note_finished(ticket, result, now, metrics, recorder)
-                        ticket.result = result
-                        if ticket.stream_q is not None:
-                            ticket.stream_q.put(("done", result))
-                        ticket.event.set()
+                    if ticket is None:
+                        continue
+                    if result.finish_reason == "expired":
+                        # the ENGINE dropped it (deadline passed while in
+                        # its queue) — same typed error as a pump-side drop
+                        self._expired += 1
+                        metrics.record_shed("expired")
+                        self._finish_error_locked(
+                            ticket,
+                            DeadlineExceededError(
+                                "deadline expired while queued for a slot"),
+                            "expired",
+                        )
+                        continue
+                    self._completed += 1
+                    if ticket.t_first == 0.0:
+                        # finished inside its first tick: _note_finished will
+                        # stamp TTFT=now − submit; fold the same sample into
+                        # the admission-control EMA here (mutex held)
+                        self._note_ttft_locked(now - ticket.t_submit)
+                    self._note_finished(ticket, result, now, metrics, recorder)
+                    ticket.result = result
+                    if ticket.stream_q is not None:
+                        ticket.stream_q.put(("done", result))
+                    ticket.event.set()
+
+    def _note_ttft_locked(self, ttft_s: float) -> None:  # lock-held: _mutex
+        """Fold one observed TTFT into the EMA admission control projects
+        queue wait from (alpha 0.2: smooth, still tracks load shifts)."""
+        assert_held(self._mutex)
+        if self._ttft_ema <= 0.0:
+            self._ttft_ema = ttft_s
+        else:
+            self._ttft_ema = 0.8 * self._ttft_ema + 0.2 * ttft_s
 
     @staticmethod
     def _note_finished(ticket: _Ticket, result: PagedResult, now: float,
@@ -599,21 +969,58 @@ class PagedGenerationService:
         except Exception:  # noqa: BLE001
             logger.debug("completion telemetry failed", exc_info=True)
 
+    def _close_cancelled_locked(self, ticket: _Ticket) -> None:  # lock-held: _mutex
+        """Account one abandoned (caller-cancelled) ticket and pin the end
+        of its flight-record tick window — an open engine section would keep
+        absorbing unrelated future ticks into the request's /debug/flight
+        view. ONE implementation for the inbox sweep, the admitted sweep,
+        and both crash-containment paths."""
+        assert_held(self._mutex)
+        self._cancelled += 1
+        if ticket.request_id:
+            get_flight_recorder().finish_engine(
+                ticket.request_id, finish_reason="cancelled"
+            )
+
+    def _finish_error_locked(
+        self, ticket: _Ticket, exc: Exception, finish_reason: str
+    ) -> None:  # lock-held: _mutex
+        """Terminate a ticket with a TYPED error the caller re-raises
+        (deadline expiry, shed-at-requeue) instead of a result."""
+        assert_held(self._mutex)
+        if ticket.event.is_set():
+            return
+        ticket.error = exc
+        if ticket.request_id:
+            get_flight_recorder().finish_engine(
+                ticket.request_id, finish_reason=finish_reason, error=str(exc)
+            )
+        if ticket.stream_q is not None:
+            ticket.stream_q.put(("err", exc))
+        ticket.event.set()
+
+    def _fail_ticket_locked(self, ticket: _Ticket, reason: str) -> None:  # lock-held: _mutex
+        """Terminate a ticket with the finish_reason='error' result (the
+        legacy decode-failure surface callers already handle)."""
+        assert_held(self._mutex)
+        if ticket.event.is_set():
+            return
+        ticket.result = PagedResult(
+            request_id=-1, text="", tokens=[],
+            prompt_tokens=0, finish_reason="error",
+        )
+        if ticket.request_id:
+            get_flight_recorder().finish_engine(
+                ticket.request_id, finish_reason="error", error=reason
+            )
+        if ticket.stream_q is not None:
+            ticket.stream_q.put(("done", ticket.result))
+        ticket.event.set()
+
     def _fail_all_locked(self, reason: str) -> None:  # lock-held: _mutex
         """A dying pump must not leave callers hanging forever."""
         assert_held(self._mutex)
         for ticket in list(self._tickets.values()) + self._inbox:
-            if not ticket.event.is_set():
-                ticket.result = PagedResult(
-                    request_id=-1, text="", tokens=[],
-                    prompt_tokens=0, finish_reason="error",
-                )
-                if ticket.request_id:
-                    get_flight_recorder().finish_engine(
-                        ticket.request_id, finish_reason="error", error=reason
-                    )
-                if ticket.stream_q is not None:
-                    ticket.stream_q.put(("done", ticket.result))
-                ticket.event.set()
+            self._fail_ticket_locked(ticket, reason)
         self._tickets.clear()
         self._inbox.clear()

@@ -33,7 +33,12 @@ from sentio_tpu.infra.exceptions import ErrorHandler, RateLimitError, SentioErro
 from sentio_tpu.infra.metrics import get_metrics
 from sentio_tpu.infra.security import SECURITY_HEADERS, setup_log_sanitization
 from sentio_tpu.serve.dependencies import DependencyContainer, get_container, set_container
-from sentio_tpu.serve.schemas import SchemaError, parse_chat_request, parse_embed_request
+from sentio_tpu.serve.schemas import (
+    MAX_DEADLINE_MS,
+    SchemaError,
+    parse_chat_request,
+    parse_embed_request,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -164,14 +169,15 @@ async def error_middleware(request: web.Request, handler):
         return await handler(request)
     except SchemaError as exc:
         return web.json_response({"error": "validation_error", "details": exc.errors}, status=422)
-    except RateLimitError as exc:
+    except SentioError as exc:
         resp = web.json_response(exc.to_dict(), status=exc.status)
+        # rate limits AND load sheds (ServiceOverloaded → 429/503) carry a
+        # retry hint; one mapping so every shed response tells the caller
+        # when coming back is worthwhile
         retry = exc.details.get("retry_after_s")
         if retry:
-            resp.headers["Retry-After"] = str(int(retry))
+            resp.headers["Retry-After"] = str(max(int(retry), 1))
         return resp
-    except SentioError as exc:
-        return web.json_response(exc.to_dict(), status=exc.status)
     except web.HTTPException as exc:
         # an HTTPException IS a response — returning it (rather than
         # re-raising) lets the outer security-header middleware stamp it
@@ -280,23 +286,60 @@ async def ui_page(request: web.Request) -> web.Response:
     )
 
 
+def _resolve_deadline_ts(request: web.Request, req, serve_cfg) -> Optional[float]:
+    """Absolute perf_counter deadline for this request: body ``deadline_ms``
+    beats the ``X-Deadline-Ms`` header beats the serve default (0 = none).
+    A malformed header is ignored rather than 422'd — proxies inject headers
+    the caller never wrote."""
+    deadline_ms = req.deadline_ms
+    if deadline_ms is None:
+        raw = request.headers.get("X-Deadline-Ms", "")
+        if raw:
+            try:
+                value = float(raw)
+                if 0 < value <= MAX_DEADLINE_MS:
+                    deadline_ms = value
+            except ValueError:
+                pass
+    if deadline_ms is None and serve_cfg.default_deadline_ms > 0:
+        deadline_ms = serve_cfg.default_deadline_ms
+    if deadline_ms is None:
+        return None
+    return time.perf_counter() + deadline_ms / 1e3
+
+
 async def chat(request: web.Request) -> web.Response:
     container: DependencyContainer = request.app["container"]
     body = await _json_body(request)
     req = parse_chat_request(body, container.settings.serve)
+    deadline_ts = _resolve_deadline_ts(request, req, container.settings.serve)
     if req.stream:
-        return await _chat_stream(request, container, req)
+        # shed BEFORE response.prepare commits the 200 status line: an SSE
+        # stream can only degrade after that, never 429/503
+        service = container.peek("generation_service")
+        if service is not None and hasattr(service, "check_admission"):
+            try:
+                service.check_admission(deadline_ts)
+            except SentioError:
+                raise  # typed shed/deadline → 429/503/504 with Retry-After
+            except Exception:  # noqa: BLE001 — closed/broken paged path
+                # the provider still has its contiguous-engine escape hatch;
+                # pre-blocking here would 500 a servable stream
+                logger.debug("stream admission pre-check skipped", exc_info=True)
+        return await _chat_stream(request, container, req, deadline_ts)
     result = await container.chat_handler.process_chat_request(
         question=req.question,
         top_k=req.top_k,
         temperature=req.temperature,
         mode=req.mode,
         thread_id=req.thread_id,
+        deadline_ts=deadline_ts,
     )
     return web.json_response(result)
 
 
-async def _chat_stream(request: web.Request, container: DependencyContainer, req) -> web.StreamResponse:
+async def _chat_stream(request: web.Request, container: DependencyContainer, req,
+                       deadline_ts: Optional[float] = None) -> web.StreamResponse:
     """SSE token streaming (reference generator.py:298-333 / openai SSE).
     Retrieval + selection run first (blocking stage on a thread), then the
     generator's token iterator is pumped from a worker thread into the
@@ -357,6 +400,7 @@ async def _chat_stream(request: web.Request, container: DependencyContainer, req
             temperature=req.temperature,
             mode=req.mode,
             request_id=request_id,
+            deadline_ts=deadline_ts,
         ):
             if not put((kind, payload)):
                 return
@@ -590,6 +634,8 @@ def _publish_serving_gauges(container: DependencyContainer):
         # from cached KV, and the pages the cache currently holds — the
         # two numbers that say whether prefix caching is paying for itself
         "prefix_hit_token_ratio", "prefix_cache_pages", "prefix_cache_nodes",
+        # overload posture: admission bound and whether a drain is underway
+        "max_queue", "draining",
     ):
         if key in stats:
             m.set_serving_stat(key, float(stats[key]))
@@ -599,7 +645,11 @@ def _publish_serving_gauges(container: DependencyContainer):
                   # raw counters so Prometheus can compute a WINDOWED
                   # tokens-per-verify (the lifetime-average gauge above
                   # flattens draft-quality regressions on long uptimes)
-                  "spec_verifies", "spec_emitted"):
+                  "spec_verifies", "spec_emitted",
+                  # overload & crash-containment outcomes (lifetime totals;
+                  # sentio_tpu_shed_total{reason} carries the fine labels)
+                  "shed", "expired", "cancelled", "requeued",
+                  "tick_failures", "pump_leaked"):
         if event in stats:
             m.bump_serving_total(event, float(stats[event]))
     return stats
@@ -734,6 +784,23 @@ def create_app(
             await asyncio.to_thread(_warm_and_arm)
 
     async def on_cleanup(app: web.Application) -> None:
+        # graceful drain BEFORE teardown: stop admitting (new submits shed
+        # 503), give in-flight decodes the configured window to finish, then
+        # close — callers mid-generation get answers, not connection resets
+        service = container.peek("generation_service")
+        if service is not None and hasattr(service, "drain"):
+            try:
+                outcome = await asyncio.to_thread(
+                    service.drain, container.settings.serve.drain_deadline_s
+                )
+                if not outcome.get("drained", True):
+                    logger.warning(
+                        "shutdown drain abandoned %d in-flight request(s) "
+                        "after %.1fs", outcome.get("abandoned", 0),
+                        container.settings.serve.drain_deadline_s,
+                    )
+            except Exception:  # noqa: BLE001 — shutdown is best-effort
+                logger.warning("shutdown drain failed", exc_info=True)
         container.cleanup()
         set_container(None)
 

@@ -312,7 +312,16 @@ class DependencyContainer:
                         "prefix cache warmed: %d tokens of the /chat "
                         "template head", shared,
                     )
-            return PagedGenerationService(paged)
+            serve = self.settings.serve
+            return PagedGenerationService(
+                paged,
+                max_queue=serve.admission_max_queue or None,
+                default_deadline_s=(
+                    serve.default_deadline_ms / 1e3
+                    if serve.default_deadline_ms > 0 else None
+                ),
+                retry_budget=serve.crash_retry_budget,
+            )
 
         return self._get("generation_service", build)
 

@@ -50,6 +50,7 @@ class ChatHandler:
         temperature: Optional[float] = None,
         mode: str = "balanced",
         thread_id: Optional[str] = None,
+        deadline_ts: Optional[float] = None,
     ) -> dict[str, Any]:
         t0 = time.perf_counter()
         query_id = thread_id or uuid.uuid4().hex[:12]
@@ -58,6 +59,10 @@ class ChatHandler:
             metadata["user_top_k"] = top_k
         if temperature is not None:
             metadata["temperature"] = temperature
+        if deadline_ts is not None:
+            # absolute perf_counter deadline rides metadata into the graph's
+            # generate node and down into the decode-service ticket
+            metadata["deadline_ts"] = deadline_ts
         # flight record opens HERE — the query_id in metadata is the trace
         # context every downstream layer (graph executor, generator provider,
         # decode-engine pump) attaches its telemetry to
@@ -65,7 +70,9 @@ class ChatHandler:
 
         recorder = get_flight_recorder()
         recorder.start_request(
-            query_id, endpoint="/chat", mode=mode, question_chars=len(question)
+            query_id, endpoint="/chat", mode=mode, question_chars=len(question),
+            **({"deadline_ms": round((deadline_ts - t0) * 1e3, 1)}
+               if deadline_ts is not None else {}),
         )
 
         cache = self.container.cache_manager
@@ -77,11 +84,16 @@ class ChatHandler:
             answer = state.get("response", "")
             if not answer:
                 raise RuntimeError("pipeline produced an empty response")
+            # deadline_ts is a process-local perf_counter value — meaningless
+            # (and misleading) outside this server; never serialize it to
+            # clients or persist it into the query cache
+            meta_out = {k: v for k, v in state.get("metadata", {}).items()
+                        if k != "deadline_ts"}
             result = {
                 "answer": answer,
                 "sources": self._serialize_sources(state),
                 "metadata": {
-                    **state.get("metadata", {}),
+                    **meta_out,
                     "query_id": query_id,
                     "latency_ms": round((time.perf_counter() - t0) * 1000.0, 1),
                     "degraded": False,
@@ -98,6 +110,15 @@ class ChatHandler:
             )
             return result
         except Exception as exc:  # noqa: BLE001 — ladder, never a 500
+            if getattr(exc, "soft_fail_exempt", False):
+                # typed shed / deadline errors skip the ladder: the caller
+                # gets an honest 429/503/504 + Retry-After (mapped by the
+                # serve error middleware) instead of a degraded 200
+                recorder.finish_request(
+                    query_id, status="shed", error=str(exc),
+                    latency_ms=round((time.perf_counter() - t0) * 1000.0, 1),
+                )
+                raise
             logger.warning("chat pipeline failed (%s); degrading", exc)
             recorder.finish_request(
                 query_id, status="degraded", error=str(exc),
@@ -154,6 +175,7 @@ class ChatHandler:
         temperature: Optional[float] = None,
         mode: str = "balanced",
         request_id: Optional[str] = None,
+        deadline_ts: Optional[float] = None,
     ):
         """Typed-event generator for SSE, with FULL graph-stage parity
         (reference factory.py:191-208 — streaming traverses the same graph):
@@ -172,6 +194,8 @@ class ChatHandler:
             recorder.start_request(
                 request_id, endpoint="/chat?stream", mode=mode,
                 question_chars=len(question),
+                **({"deadline_ms": round((deadline_ts - t0) * 1e3, 1)}
+                   if deadline_ts is not None else {}),
             )
         timings: dict[str, float] = {}
         try:
@@ -200,16 +224,23 @@ class ChatHandler:
             t = time.perf_counter()
             for piece in self.container.generator.stream(
                 question, selected, mode=mode, temperature=temperature,
-                request_id=request_id,
+                request_id=request_id, deadline_ts=deadline_ts,
             ):
                 chunks.append(piece)
                 yield ("token", piece)
             timings["generate"] = round((time.perf_counter() - t) * 1e3, 3)
             verifier = self.container.verifier
             answer = "".join(chunks)
-            if verifier is not None and answer:
+            # same deadline discipline as the graph verify node: skip the
+            # optional audit when the budget is spent, and bound its decode
+            # with the caller's deadline so the pump can cancel it
+            deadline_ok = (deadline_ts is None
+                           or time.perf_counter() < deadline_ts)
+            if verifier is not None and answer and deadline_ok:
                 t = time.perf_counter()
-                result = verifier.verify(question, answer, selected)
+                result = verifier.verify(question, answer, selected,
+                                         request_id=request_id,
+                                         deadline_ts=deadline_ts)
                 timings["verify"] = round((time.perf_counter() - t) * 1e3, 3)
                 yield ("verdict", result.to_dict())
             if request_id:
@@ -231,6 +262,25 @@ class ChatHandler:
                 )
             raise
         except Exception as exc:  # noqa: BLE001 — ladder, never a raw error
+            if getattr(exc, "soft_fail_exempt", False):
+                # shed / expired mid-stream: the SSE status is already on
+                # the wire, so no 429/503 — but appending an apology after
+                # real tokens would corrupt the answer, and ending with a
+                # bare [DONE] would be indistinguishable from a successful
+                # empty answer. Emit a typed error event, then end.
+                if request_id:
+                    recorder.add_node_timings(request_id, timings)
+                    recorder.finish_request(
+                        request_id, status="shed", error=str(exc),
+                        latency_ms=round((time.perf_counter() - t0) * 1e3, 1),
+                    )
+                code = getattr(exc, "code", None)
+                yield ("error", {
+                    "code": getattr(code, "value", "OVERLOADED"),
+                    "message": str(exc),
+                    "retryable": bool(getattr(exc, "retryable", True)),
+                })
+                return
             logger.warning("stream pipeline failed (%s); degrading", exc)
             if request_id:
                 recorder.add_node_timings(request_id, timings)

@@ -13,7 +13,14 @@ from typing import Any, Optional
 
 from sentio_tpu.config import ServeConfig
 
-__all__ = ["SchemaError", "ChatRequest", "EmbedRequest", "parse_chat_request", "parse_embed_request"]
+__all__ = [
+    "SchemaError", "ChatRequest", "EmbedRequest",
+    "parse_chat_request", "parse_embed_request", "MAX_DEADLINE_MS",
+]
+
+# upper bound on a caller-supplied deadline (1 hour) — shared by the body
+# field validation below and the X-Deadline-Ms header parse in serve/app.py
+MAX_DEADLINE_MS = 3_600_000
 
 
 class SchemaError(ValueError):
@@ -32,6 +39,10 @@ class ChatRequest:
     mode: str = "balanced"
     thread_id: Optional[str] = None
     stream: bool = False
+    # caller's total latency budget in ms (body field; the X-Deadline-Ms
+    # header and the serve default fill it when absent) — the decode service
+    # sheds/cancels work that cannot finish inside it
+    deadline_ms: Optional[float] = None
 
 
 @dataclass
@@ -85,6 +96,19 @@ def parse_chat_request(body: Any, limits: ServeConfig) -> ChatRequest:
         errors.append({"field": "thread_id", "error": "must be a string"})
         thread_id = None
 
+    deadline_ms = body.get("deadline_ms")
+    if deadline_ms is not None:
+        if not isinstance(deadline_ms, (int, float)) or isinstance(deadline_ms, bool) or not (
+            0 < float(deadline_ms) <= MAX_DEADLINE_MS
+        ):
+            errors.append({
+                "field": "deadline_ms",
+                "error": f"must be a number in (0, {MAX_DEADLINE_MS}]",
+            })
+            deadline_ms = None
+        else:
+            deadline_ms = float(deadline_ms)
+
     if errors:
         raise SchemaError(errors)
     return ChatRequest(
@@ -94,6 +118,7 @@ def parse_chat_request(body: Any, limits: ServeConfig) -> ChatRequest:
         mode=mode,
         thread_id=thread_id,
         stream=bool(body.get("stream", False)),
+        deadline_ms=deadline_ms,
     )
 
 

@@ -35,6 +35,7 @@ from sentio_tpu.config import Settings, set_settings  # noqa: E402
 # refcounts. A regression in those invariants fails HERE, on the tick that
 # introduced it, instead of as a pool-exhaustion heisenbug later.
 _SANITIZED_MODULES = {
+    "test_chaos",
     "test_paged",
     "test_paged_sched",
     "test_paged_spec",

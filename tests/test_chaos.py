@@ -1,0 +1,271 @@
+"""Chaos drill: the paged serving path under faults-plus-load (tier 1).
+
+SURVEY §5 prescribes fault-injection-driven resilience; this is the drill
+that exercises it end to end: probabilistic decode-tick faults armed while
+concurrent generate/stream callers hammer the service. The contract under
+chaos (the contract vLLM-class systems must keep, Kwon et al., SOSP '23):
+
+* every caller reaches a TERMINAL outcome — a result, a typed shed/deadline
+  error, or a budgeted error result; nobody hangs;
+* a tick failure with a successful ``engine.reset()`` requeues innocent
+  waiters (per-ticket retry budget) instead of failing all of them;
+* page-pool conservation holds throughout (conftest arms SENTIO_SANITIZE=1
+  for this module, so every tick self-checks);
+* no pump or waiter threads leak.
+
+Engines here are tiny (default LlamaConfig.tiny) so the drill runs in the
+quick tier — the point is scheduler/recovery logic, not model quality.
+"""
+
+import threading
+import time
+
+import pytest
+
+from sentio_tpu.infra import faults
+from sentio_tpu.infra.exceptions import DeadlineExceededError, ServiceOverloaded
+from sentio_tpu.runtime.paged import ContinuousBatchingEngine, PagedResult
+from sentio_tpu.runtime.service import PagedGenerationService
+
+
+@pytest.fixture(scope="module")
+def engine():
+    # ONE engine for the module: each engine instance owns fresh jit
+    # wrappers, so more engines = more XLA compiles in the quick tier
+    return ContinuousBatchingEngine(
+        max_slots=4, page_size=8, max_pages_per_seq=4, steps_per_tick=2,
+    )
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.reset()
+
+
+def _assert_pages_conserved(svc):
+    s = svc.stats()
+    assert s["free_pages"] + s.get("prefix_cache_pages", 0) \
+        == s["total_pages"] - 1, s
+
+
+def _assert_no_pump_threads(timeout_s: float = 15.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        pumps = [t for t in threading.enumerate()
+                 if t.name == "paged-decode-pump" and t.is_alive()]
+        if not pumps:
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"leaked pump threads: {pumps}")
+
+
+class TestChaosDrill:
+    def test_mixed_load_under_probabilistic_tick_faults(self, engine):
+        """≥8 concurrent mixed generate/stream callers while every decode
+        tick fails with probability 0.25: all callers terminate, the pool
+        conserves, the service still works afterwards, nothing leaks."""
+        svc = PagedGenerationService(engine, retry_budget=2)
+        outcomes: dict[str, object] = {}
+
+        def call_generate(i):
+            try:
+                outcomes[f"g{i}"] = svc.generate(
+                    f"chaos generate load {i}", max_new_tokens=6,
+                    temperature=0.0, timeout_s=120,
+                )
+            except Exception as exc:  # noqa: BLE001 — any typed error is terminal
+                outcomes[f"g{i}"] = exc
+
+        def call_stream(i):
+            try:
+                outcomes[f"s{i}"] = "".join(svc.generate_stream(
+                    f"chaos stream load {i}", max_new_tokens=6,
+                    temperature=0.0, timeout_s=120,
+                ))
+            except Exception as exc:  # noqa: BLE001
+                outcomes[f"s{i}"] = exc
+
+        with faults.inject("paged.step", error=RuntimeError("chaos tick"),
+                           probability=0.25, seed=1234) as rule:
+            threads = (
+                [threading.Thread(target=call_generate, args=(i,)) for i in range(5)]
+                + [threading.Thread(target=call_stream, args=(i,)) for i in range(4)]
+            )
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180)
+            assert not any(t.is_alive() for t in threads), (
+                "caller thread hung under chaos"
+            )
+        assert rule.fired >= 1, "drill never actually injected a fault"
+        # EVERY caller reached a terminal outcome
+        assert len(outcomes) == 9
+        for name, out in outcomes.items():
+            assert isinstance(out, (PagedResult, str, Exception)), (name, out)
+            if isinstance(out, PagedResult):
+                assert out.finish_reason in ("stop", "length", "error"), (name, out)
+        # the service survived: a post-chaos request works end to end
+        ok = svc.generate("post chaos sanity", max_new_tokens=4, timeout_s=120)
+        assert ok.finish_reason in ("stop", "length")
+        _assert_pages_conserved(svc)
+        svc.close()
+        _assert_no_pump_threads()
+
+    def test_tick_failure_requeues_innocent_waiters(self, engine):
+        """One failed tick + successful reset: BOTH in-flight waiters are
+        requeued and complete normally — the pre-fix behavior failed every
+        waiter via _fail_all_locked even after a clean reset."""
+        svc = PagedGenerationService(engine, retry_budget=1)
+        results = {}
+
+        def call(i):
+            results[i] = svc.generate(
+                f"innocent waiter number {i} with padding", max_new_tokens=6,
+                temperature=0.0, timeout_s=120,
+            )
+
+        with faults.inject("paged.step", error=RuntimeError("one bad tick"),
+                           times=1) as rule:
+            threads = [threading.Thread(target=call, args=(i,)) for i in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+        assert rule.fired == 1
+        assert len(results) == 2
+        for i, res in results.items():
+            assert res.finish_reason in ("stop", "length"), (i, res)
+        stats = svc.stats()
+        assert stats["requeued"] >= 1, stats
+        assert stats["tick_failures"] == 1, stats
+        _assert_pages_conserved(svc)
+        svc.close()
+
+    def test_exhausted_budget_fails_only_that_ticket(self, engine):
+        """A stream that already delivered tokens cannot be resubmitted
+        (restart would duplicate output) — after a tick failure it gets the
+        error, while a queued generate is requeued and succeeds.
+
+        Determinism: phase 1 arms a delay-only rule (every tick sleeps, so
+        the short stream cannot outrun the test), phase 2 swaps in the
+        one-shot error once BOTH requests are observably in flight."""
+        svc = PagedGenerationService(engine, retry_budget=1)
+        stream_err: list = []
+        stream_text: list[str] = []
+        faults.arm("paged.step", faults.FaultRule(delay_s=0.1))
+
+        def consume():
+            try:
+                for piece in svc.generate_stream(
+                    "s",  # short prompt: maximum decode room in the window
+                    max_new_tokens=200, temperature=0.0, timeout_s=120,
+                ):
+                    stream_text.append(piece)
+            except Exception as exc:  # noqa: BLE001
+                stream_err.append(exc)
+
+        streamer = threading.Thread(target=consume)
+        streamer.start()
+        # wait until real tokens flowed to the stream consumer
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not stream_text:
+            time.sleep(0.005)
+        assert stream_text, "stream produced nothing to be mid-flight with"
+        gen_result: dict = {}
+
+        def call():
+            gen_result["r"] = svc.generate(
+                "innocent generate behind the doomed stream",
+                max_new_tokens=4, temperature=0.0, timeout_s=120,
+            )
+
+        t = threading.Thread(target=call)
+        t.start()
+        # both requests visible to the service before the fault arms
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            s = svc.stats()
+            if s["active_slots"] + s["queued"] + s["queued_inbox"] >= 2:
+                break
+            time.sleep(0.005)
+        faults.arm("paged.step", faults.FaultRule(
+            error=RuntimeError("boom"), times=1))
+        t.join(timeout=120)
+        streamer.join(timeout=120)
+        faults.disarm("paged.step")
+        assert not streamer.is_alive()
+        # the delivered-tokens stream is the casualty...
+        assert stream_err, "mid-flight stream should have been failed"
+        # ...while the resubmittable generate survived the same tick failure
+        assert gen_result["r"].finish_reason in ("stop", "length")
+        _assert_pages_conserved(svc)
+        svc.close()
+
+    def test_admission_shed_and_deadline_at_submit(self, engine):
+        """Typed sheds: a full queue answers 429-style ServiceOverloaded
+        with a retry hint; an already-expired deadline is a typed
+        DeadlineExceededError. Neither touches the engine."""
+        svc = PagedGenerationService(engine, max_queue=0)
+        with pytest.raises(ServiceOverloaded) as exc_info:
+            svc.generate("cannot even queue", max_new_tokens=2)
+        assert exc_info.value.status == 429
+        assert exc_info.value.details["retry_after_s"] >= 0
+        with pytest.raises(ServiceOverloaded):
+            svc.check_admission()  # pre-commit probe sheds identically
+        svc2 = PagedGenerationService(engine)
+        with pytest.raises(DeadlineExceededError):
+            svc2.generate("expired before submit", max_new_tokens=2,
+                          deadline_ts=time.perf_counter() - 0.5)
+        stats = svc2.stats()
+        assert stats["shed"] >= 1
+        svc.close()
+        svc2.close()
+
+    def test_drain_sheds_new_work_and_finishes_in_flight(self, engine):
+        """drain(): in-flight decode completes, concurrent submits shed with
+        503/draining, and the service ends closed."""
+        svc = PagedGenerationService(engine)
+        result: dict = {}
+
+        def call():
+            result["r"] = svc.generate(
+                "long generation that must finish during drain",
+                max_new_tokens=150, temperature=0.0, timeout_s=120,
+            )
+
+        t = threading.Thread(target=call)
+        t.start()
+        # let the pump admit it before draining
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and svc.stats()["active_slots"] == 0:
+            time.sleep(0.01)
+        drain_out: dict = {}
+
+        def drain():
+            drain_out.update(svc.drain(deadline_s=60.0))
+
+        d = threading.Thread(target=drain)
+        d.start()
+        # shed while draining: a submit racing the drain gets a typed 503
+        shed = None
+        probe_deadline = time.monotonic() + 60
+        while time.monotonic() < probe_deadline:
+            try:
+                svc.generate("late arrival", max_new_tokens=2, timeout_s=30)
+            except ServiceOverloaded as exc:
+                shed = exc
+                break
+            except RuntimeError:
+                break  # drain already closed the service — also a shed
+            time.sleep(0.005)
+        t.join(timeout=120)
+        d.join(timeout=120)
+        assert result["r"].finish_reason in ("stop", "length")
+        assert drain_out.get("drained") is True, drain_out
+        if shed is not None:
+            assert shed.status == 503
+        with pytest.raises(RuntimeError, match="closed"):
+            svc.generate("after drain-close")
+        _assert_no_pump_threads()

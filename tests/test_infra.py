@@ -104,6 +104,21 @@ class TestRetry:
         with pytest.raises(ValueError, match="always"):
             policy.run(lambda: (_ for _ in ()).throw(ValueError("always")))
 
+    def test_nonpositive_max_attempts_raises_value_error(self):
+        # used to fall off the loop and `raise None` (an opaque TypeError)
+        policy = RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError, match="max_attempts"):
+            policy.run(lambda: "never")
+        with pytest.raises(ValueError, match="max_attempts"):
+            asyncio.run(RetryPolicy(max_attempts=-1).arun(None))
+
+    def test_injected_rng_makes_jitter_deterministic(self):
+        import random as _random
+
+        a = RetryPolicy(base_delay_s=0.1, rng=_random.Random(42))
+        b = RetryPolicy(base_delay_s=0.1, rng=_random.Random(42))
+        assert [a.delay(i) for i in range(4)] == [b.delay(i) for i in range(4)]
+
     def test_resilient_call_timeout(self):
         async def run():
             rc = ResilientCall("slow", timeout_s=0.02,
@@ -134,6 +149,43 @@ class TestFallbacks:
         cache.put("q", "a")
         time.sleep(0.02)
         assert cache.get("q") is None
+
+    def test_expired_deletion_persists_to_disk(self, tmp_path):
+        cache = FallbackResponseCache(cache_dir=str(tmp_path), ttl_s=0.01)
+        cache.put("q", "a")
+        time.sleep(0.02)
+        assert cache.get("q") is None
+        # a fresh instance loads from disk: the expired entry must NOT
+        # resurrect (pre-fix, deletion only ever happened in memory)
+        fresh = FallbackResponseCache(cache_dir=str(tmp_path), ttl_s=1e9)
+        assert fresh.get("q") is None
+
+    def test_max_entries_lru_cap(self, tmp_path):
+        cache = FallbackResponseCache(cache_dir=str(tmp_path), ttl_s=0,
+                                      max_entries=3)
+        for i in range(6):
+            cache.put(f"question {i}", f"answer {i}")
+            time.sleep(0.002)  # distinct write stamps for eviction order
+        # only the newest 3 survive, in memory AND on disk
+        assert cache.get("question 0") is None
+        assert cache.get("question 5") == "answer 5"
+        fresh = FallbackResponseCache(cache_dir=str(tmp_path), ttl_s=0)
+        assert len(fresh._store) <= 3
+        assert fresh.get("question 5") == "answer 5"
+
+    def test_eviction_is_recency_based_not_fifo(self, tmp_path):
+        cache = FallbackResponseCache(cache_dir=str(tmp_path), ttl_s=0,
+                                      max_entries=3)
+        for i in range(3):
+            cache.put(f"q{i}", f"a{i}")
+            time.sleep(0.002)
+        # touch the OLDEST-written entry, then overflow: the least recently
+        # USED entry (q1) must go, and the hot q0 must survive
+        assert cache.get("q0") == "a0"
+        time.sleep(0.002)
+        cache.put("q3", "a3")
+        assert cache.get("q1") is None
+        assert cache.get("q0") == "a0"
 
     def test_llm_fallback_templates(self):
         fb = LLMFallback(prompts_dir="prompts")

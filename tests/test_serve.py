@@ -469,6 +469,128 @@ class TestPagedStreamingService:
             svc_b.close()
 
 
+class TestOverloadMapping:
+    """Typed shed/deadline errors → HTTP 429/503/504 + Retry-After — the
+    overload story's wire contract (ServiceOverloaded must NEVER be eaten
+    by the degradation ladder into a 200 apology)."""
+
+    def test_shed_maps_to_429_with_retry_after(self):
+        from sentio_tpu.infra.exceptions import ServiceOverloaded
+
+        class SheddingGraph:
+            def invoke(self, *a, **k):
+                raise ServiceOverloaded(
+                    "decode queue full", status=429, retry_after_s=7.0)
+
+        async def body(client, container):
+            container.override("graph", SheddingGraph())
+            resp = await client.post("/chat", json={"question": "any"})
+            assert resp.status == 429
+            assert resp.headers.get("Retry-After") == "7"
+            data = await resp.json()
+            assert data["error"]["code"] == "OVERLOADED"
+            assert data["error"]["retryable"] is True
+
+        run(with_client(fast_settings(), body))
+
+    def test_draining_maps_to_503(self):
+        from sentio_tpu.infra.exceptions import ServiceOverloaded
+
+        class DrainingGraph:
+            def invoke(self, *a, **k):
+                raise ServiceOverloaded("service is draining", status=503,
+                                        retry_after_s=5.0)
+
+        async def body(client, container):
+            container.override("graph", DrainingGraph())
+            resp = await client.post("/chat", json={"question": "any"})
+            assert resp.status == 503
+            assert resp.headers.get("Retry-After") == "5"
+
+        run(with_client(fast_settings(), body))
+
+    def test_deadline_exceeded_maps_to_504(self):
+        from sentio_tpu.infra.exceptions import DeadlineExceededError
+
+        class ExpiredGraph:
+            def invoke(self, *a, **k):
+                raise DeadlineExceededError("deadline expired mid-decode")
+
+        async def body(client, container):
+            container.override("graph", ExpiredGraph())
+            resp = await client.post("/chat", json={"question": "any"})
+            assert resp.status == 504
+            data = await resp.json()
+            assert data["error"]["code"] == "DEADLINE_EXCEEDED"
+
+        run(with_client(fast_settings(), body))
+
+    def test_ladder_still_catches_plain_failures(self):
+        """Regression guard: ONLY typed shed errors skip the ladder — a
+        plain pipeline crash still degrades to 200."""
+
+        class Boom:
+            def invoke(self, *a, **k):
+                raise RuntimeError("device on fire")
+
+        async def body(client, container):
+            container.override("graph", Boom())
+            resp = await client.post("/chat", json={"question": "any"})
+            assert resp.status == 200
+            assert (await resp.json())["metadata"]["degraded"] is True
+
+        run(with_client(fast_settings(), body))
+
+    def test_stream_precheck_sheds_before_sse(self):
+        """stream=True is shed with a REAL 429 before the SSE 200 status
+        line commits (after prepare the only option is degrading)."""
+        from sentio_tpu.infra.exceptions import ServiceOverloaded
+
+        class FakeService:
+            def check_admission(self, deadline_ts=None):
+                raise ServiceOverloaded("decode queue full", status=429,
+                                        retry_after_s=3.0)
+
+        async def body(client, container):
+            container.override("generation_service", FakeService())
+            resp = await client.post(
+                "/chat", json={"question": "stream me", "stream": True})
+            assert resp.status == 429
+            assert resp.headers.get("Retry-After") == "3"
+
+        run(with_client(fast_settings(), body))
+
+    def test_deadline_ms_validation(self):
+        async def body(client, container):
+            for bad in (0, -5, "fast", True, 3_600_001):
+                resp = await client.post(
+                    "/chat", json={"question": "ok", "deadline_ms": bad})
+                assert resp.status == 422, bad
+                data = await resp.json()
+                assert any(e["field"] == "deadline_ms" for e in data["details"])
+
+        run(with_client(fast_settings(), body))
+
+    def test_deadline_header_rides_metadata_to_flight_record(self):
+        """X-Deadline-Ms lands in the flight record and in state.metadata
+        (the echo provider ignores it, so the request still succeeds)."""
+        from sentio_tpu.infra.flight import get_flight_recorder
+
+        async def body(client, container):
+            await seed(client, ["deadline plumbing document"])
+            resp = await client.post(
+                "/chat",
+                json={"question": "deadline plumbing?", "thread_id": "dl-test"},
+                headers={"X-Deadline-Ms": "30000"},
+            )
+            assert resp.status == 200
+            record = get_flight_recorder().get("dl-test")
+            assert record is not None
+            assert 0 < record["deadline_ms"] <= 30000
+
+        run(with_client(fast_settings(), body))
+
+
 class TestUpload:
     """Multipart binary-document ingest (/upload) — the browser file path
     the reference serves via Streamlit (streamlit_app.py:27-318 there)."""

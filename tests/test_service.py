@@ -18,7 +18,7 @@ from sentio_tpu.models.llama import LlamaConfig
 from sentio_tpu.parallel.batcher import BatcherClosed, ThreadBatcher
 from sentio_tpu.runtime.engine import GeneratorEngine
 from sentio_tpu.runtime.paged import ContinuousBatchingEngine
-from sentio_tpu.runtime.service import PagedGenerationService
+from sentio_tpu.runtime.service import GenerationTimeout, PagedGenerationService
 
 pytestmark = pytest.mark.slow
 
@@ -199,6 +199,148 @@ class TestPagedGenerationService:
         svc.close()
         with pytest.raises(RuntimeError, match="closed"):
             svc.generate("x")
+
+
+class TestRobustness:
+    """Deadline propagation, crash-requeue budget, and drain ordering —
+    the request-lifecycle robustness surface over the paged pump."""
+
+    def _engine(self, contiguous, **kw):
+        kw.setdefault("max_slots", 2)
+        kw.setdefault("page_size", 16)
+        kw.setdefault("max_pages_per_seq", 8)
+        kw.setdefault("steps_per_tick", 1)
+        return ContinuousBatchingEngine(
+            model_config=contiguous.model_config, params=contiguous.params,
+            tokenizer=contiguous.tokenizer, **kw,
+        )
+
+    def test_deadline_cancels_mid_decode(self, contiguous):
+        from sentio_tpu.infra.exceptions import DeadlineExceededError
+
+        svc = PagedGenerationService(self._engine(contiguous))
+        try:
+            with pytest.raises(DeadlineExceededError):
+                svc.generate("expire me mid decode", max_new_tokens=400,
+                             deadline_s=0.3)
+            # the cancelled slot's pages are reclaimed, not stranded
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                s = svc.stats()
+                if s["active_slots"] == 0 and s["free_pages"] \
+                        + s.get("prefix_cache_pages", 0) == s["total_pages"] - 1:
+                    break
+                time.sleep(0.05)
+            s = svc.stats()
+            assert s["active_slots"] == 0, s
+            assert s["expired"] >= 1, s
+        finally:
+            svc.close()
+
+    def test_timeout_completion_race_returns_result(self, contiguous):
+        """event.wait timing out while the pump completes the very same
+        ticket must return the finished result, not raise + cancel it."""
+        svc = PagedGenerationService(self._engine(contiguous))
+        try:
+            # warm so the next generate is fast relative to the timeout
+            svc.generate("warm the compile path", max_new_tokens=2)
+            # a timeout the generation usually BEATS: across repetitions the
+            # wait/complete race window is crossed both ways; either way the
+            # caller must never see a timeout for work that finished
+            for i in range(5):
+                try:
+                    out = svc.generate(f"race window probe {i}",
+                                       max_new_tokens=2, timeout_s=0.05)
+                    assert out.finish_reason in ("stop", "length")
+                except GenerationTimeout:
+                    pass  # genuinely unfinished: acceptable, just not both
+        finally:
+            svc.close()
+
+    def test_crash_requeue_budget_recovers_single_failure(self, contiguous):
+        engine = self._engine(contiguous)
+        svc = PagedGenerationService(engine, retry_budget=1)
+        original_step = engine.step
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient device fault")
+            return original_step()
+
+        engine.step = flaky
+        try:
+            out = svc.generate("survives one bad tick", max_new_tokens=4)
+            assert out.finish_reason in ("stop", "length")
+            stats = svc.stats()
+            assert stats["requeued"] == 1, stats
+            assert stats["tick_failures"] == 1, stats
+        finally:
+            engine.step = original_step
+            svc.close()
+
+    def test_queue_full_sheds_with_retry_after(self, contiguous):
+        from sentio_tpu.infra.exceptions import ServiceOverloaded
+
+        svc = PagedGenerationService(self._engine(contiguous), max_queue=0)
+        try:
+            with pytest.raises(ServiceOverloaded) as exc_info:
+                svc.generate("no room at the inn", max_new_tokens=2)
+            assert exc_info.value.status == 429
+            assert "retry_after_s" in exc_info.value.details
+            assert svc.stats()["shed"] == 1
+        finally:
+            svc.close()
+
+    def test_drain_then_close_ordering(self, contiguous):
+        """drain() must (1) flip to draining, (2) wait out in-flight work,
+        (3) close — a submit observed after drain returns must fail closed,
+        and the drained flag must be visible in stats while draining."""
+        from sentio_tpu.infra.exceptions import ServiceOverloaded
+
+        svc = PagedGenerationService(self._engine(contiguous))
+        result = {}
+
+        def call():
+            result["r"] = svc.generate("drain waits for me", max_new_tokens=100,
+                                       temperature=0.0, timeout_s=120)
+
+        t = threading.Thread(target=call)
+        t.start()
+        deadline = time.time() + 30
+        while time.time() < deadline and svc.stats()["active_slots"] == 0:
+            time.sleep(0.01)
+        out = svc.drain(deadline_s=60.0)
+        t.join(timeout=120)
+        assert out["drained"] is True
+        assert result["r"].finish_reason in ("stop", "length")
+        with pytest.raises((RuntimeError, ServiceOverloaded)):
+            svc.generate("too late")
+
+    def test_leaked_pump_surfaces_in_stats(self, contiguous):
+        """A pump that outlives close()'s join shows up as pump_leaked
+        instead of being silently dropped."""
+        svc = PagedGenerationService(self._engine(contiguous))
+        release = threading.Event()
+        started = threading.Event()
+
+        class StuckPump:
+            name = "paged-decode-pump"
+            daemon = True
+
+            def join(self, timeout=None):
+                started.set()
+
+            def is_alive(self):
+                return not release.is_set()
+
+        with svc._mutex:
+            svc._pump = StuckPump()
+        svc.close()
+        assert started.is_set()
+        assert svc.stats()["pump_leaked"] == 1
+        release.set()
 
 
 class TestEmbedderCoalescing:
