@@ -327,6 +327,10 @@ def phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
         "max_active_slots": stats["max_active_slots"],
         "ingest_docs_per_s": round(docs_per_s, 1),
         "xla_compiles": xla_compiles,
+        # footprint next to latency: the int8-vs-bf16 claim rides the
+        # artifact as measurement (BENCH_KV_QUANT_SWEEP runs both)
+        "kv_quant": kv_quant,
+        "pool_hbm_bytes": paged.pool.hbm_bytes,
     }
     # radix prefix cache: fraction of admitted prompt tokens served
     # read-only from cached KV over the TIMED window (the before/after
@@ -489,6 +493,8 @@ def phase_c_scale(kind: str, new_tokens: int, concurrency: int,
         "hbm_util_pct": round(steps_s * weight_bytes / (PEAK_HBM_GBS * 1e9) * 100, 1),
         "concurrency": concurrency,
         "max_active_slots": stats["max_active_slots"],
+        "kv_quant": kv_quant,
+        "pool_hbm_bytes": engine.pool.hbm_bytes,
     }
     log(f"phase C: {out['tokens_per_s']} tok/s on {out['params_b']}B params "
         f"(MFU {out['mfu_pct']}%, HBM {out['hbm_util_pct']}%) over {wall:.1f}s")
@@ -748,6 +754,9 @@ def main() -> None:
     scale_tokens = int(os.environ.get("BENCH_SCALE_TOKENS", "64"))
     # int8 KV pages in BOTH paged engines (phase A serving + phase C scale)
     kv_quant = os.environ.get("BENCH_KV_QUANT") or os.environ.get("KV_QUANT", "none")
+    # sweep knob: run phase A at bf16 AND int8 on the same corpus/queries so
+    # the footprint-vs-TPOT tradeoff lands in one artifact as measurement
+    kv_sweep = os.environ.get("BENCH_KV_QUANT_SWEEP") == "1"
 
     import jax
 
@@ -792,6 +801,14 @@ def main() -> None:
 
     rag = phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
                       new_tokens, concurrency, kv_quant=kv_quant)
+    rag_int8 = None
+    if kv_sweep and kv_quant == "none":
+        rag_int8 = phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries,
+                               n_queries, new_tokens, concurrency,
+                               kv_quant="int8")
+    elif kv_sweep:
+        log(f"BENCH_KV_QUANT_SWEEP ignored: KV_QUANT={kv_quant!r} already "
+            f"pins the repr — unset it so the sweep can run bf16 AND int8")
     baseline = phase_b_baseline(docs, queries, n_queries, dim=enc_cfg.dim)
     baseline_wan = None if fast else phase_b_baseline(
         docs, queries, n_queries, dim=enc_cfg.dim,
@@ -822,6 +839,17 @@ def main() -> None:
         **rtt,
         **({"device_fallback": fallback_reason} if fallback_reason else {}),
         "rag": rag,
+        **({"rag_int8": rag_int8} if rag_int8 else {}),
+        **({"kv_quant_sweep": {
+            "bf16_pool_hbm_bytes": rag["pool_hbm_bytes"],
+            "int8_pool_hbm_bytes": rag_int8["pool_hbm_bytes"],
+            "pool_ratio": round(
+                rag_int8["pool_hbm_bytes"] / max(rag["pool_hbm_bytes"], 1), 4),
+            "p50_ms_bf16": rag["p50_ms"],
+            "p50_ms_int8": rag_int8["p50_ms"],
+            "tpot_ms_bf16": rag.get("tpot_ms"),
+            "tpot_ms_int8": rag_int8.get("tpot_ms"),
+        }} if rag_int8 else {}),
         "baseline": baseline,
         **({"baseline_wan": baseline_wan} if baseline_wan else {}),
         **({"serve_scale": scale} if scale else {}),

@@ -58,7 +58,8 @@ def _variant_key(desc: dict) -> str:
 # ------------------------------------------------------------------- engines
 
 
-def _paged_engine(prefill_chunk: Optional[int] = 8, draft: bool = False):
+def _paged_engine(prefill_chunk: Optional[int] = 8, draft: bool = False,
+                  kv_quant: str = "none"):
     import jax
 
     from sentio_tpu.models.llama import init_llama
@@ -76,7 +77,7 @@ def _paged_engine(prefill_chunk: Optional[int] = 8, draft: bool = False):
     return ContinuousBatchingEngine(
         model_config=_micro_cfg(), max_slots=2, page_size=8,
         max_pages_per_seq=4, steps_per_tick=4, max_tick_steps=8,
-        use_pallas=False, **kwargs,
+        use_pallas=False, kv_quant=kv_quant, **kwargs,
     )
 
 
@@ -125,7 +126,7 @@ def _paged_args(eng, family: str, desc: dict):
             (eng.params, np.zeros(S, np.int32), np.zeros(S, np.int32),
              np.zeros(S, bool), eng._page_table.copy(), eng.pool.k,
              eng.pool.v, eng._rng, np.zeros(S, np.float32),
-             np.zeros(S, np.int32)),
+             np.zeros(S, np.int32), np.zeros(S, np.int32)),
             {"steps": desc["steps"]},
         )
     if family == "paged.merge_admitted":
@@ -141,7 +142,7 @@ def _paged_args(eng, family: str, desc: dict):
             desc["rows"], desc["width"])
         return (
             (eng.params, ids, positions, lens, eng._rng, temps, scat,
-             eng.pool.k, eng.pool.v),
+             eng.pool.k, eng.pool.v, np.zeros(desc["rows"], np.int32)),
             {},
         )
     if family == "paged.prior_prefill_scatter":
@@ -152,7 +153,8 @@ def _paged_args(eng, family: str, desc: dict):
         n_prior = np.zeros(rows, np.int32)
         return (
             (eng.params, ids, positions, lens, eng._rng, temps, scat,
-             eng.pool.k, eng.pool.v, prior, n_prior),
+             eng.pool.k, eng.pool.v, prior, n_prior,
+             np.zeros(rows, np.int32)),
             {"do_sample": desc["do_sample"]},
         )
     if family == "paged.draft_prefill":
@@ -321,6 +323,43 @@ def build_audit_report(include_mesh: bool = True) -> dict:
             name, _paged_fn(plain, name), plain_space[name],
             lambda desc, _n=name: _paged_args(plain, _n, desc),
         )
+
+    # kv_quant="int8": the SAME jit families lower over the {"q","s"} pool
+    # pytree — audited as separate manifest entries (name@int8) so the
+    # quantized variant space, its donation aliasing (the dict pool still
+    # updates in place) and its static footprint are each gated on their
+    # own. merge_admitted never touches the pool and needs no second entry.
+    quant = _paged_engine(prefill_chunk=None, kv_quant="int8")
+    quant_space = quant.compile_variant_space()
+    for name in ("paged.step_n", "paged.prefill_scatter",
+                 "paged.prior_prefill_scatter"):
+        report["families"][name + "@int8"] = _audit_family(
+            name, _paged_fn(quant, name), quant_space[name],
+            lambda desc, _n=name: _paged_args(quant, _n, desc),
+        )
+
+    # the committed footprint claim: int8 pages + f16 per-vector scales vs
+    # bf16 pages at identical pool geometry. Measured at a SERVING head_dim
+    # (64 — the llama/GQA families this engine serves), not the dim-16
+    # lowering micro-config: per-vector scale overhead is 2/head_dim bytes,
+    # so head_dim 8 would overstate it 8x. tests/test_audit.py gates the
+    # <= 0.6x ratio against both this report and the committed manifest.
+    from sentio_tpu.models.llama import LlamaConfig
+    from sentio_tpu.runtime.paged import init_pool
+
+    pool_cfg = LlamaConfig(
+        vocab_size=MICRO_VOCAB, dim=512, n_layers=2, n_heads=8,
+        n_kv_heads=2, mlp_dim=64, max_len=64, rope_theta=10_000.0,
+    )
+    bf16_pool = init_pool(pool_cfg, num_pages=64, page_size=16)
+    int8_pool = init_pool(pool_cfg, num_pages=64, page_size=16,
+                          quantized=True)
+    report["pools"] = {
+        "head_dim": pool_cfg.head_dim,
+        "bf16_pool_bytes": bf16_pool.hbm_bytes,
+        "int8_pool_bytes": int8_pool.hbm_bytes,
+        "ratio": round(int8_pool.hbm_bytes / bf16_pool.hbm_bytes, 4),
+    }
 
     spec = _paged_engine(draft=True)
     spec_space = spec.compile_variant_space()

@@ -166,6 +166,47 @@ def _radix_nodes(radix):
         yield node
 
 
+def _check_pool_repr(engine) -> None:
+    """KV-pool representation consistency: the quantized pool is a
+    ``{"q": int8, "s": f16}`` pytree whose scale tree mirrors the payload
+    shape minus the vector axis; the unquantized pool is a plain array.
+    Pure host-side metadata checks (shape/dtype/type), no device sync —
+    a repr drift (e.g. a refactor materializing a dense copy into the
+    pool slot, or dropping the scale tree) fails the tick that did it."""
+    pool = getattr(engine, "pool", None)
+    if pool is None:
+        return
+    want_quant = getattr(engine, "kv_quant", "none") == "int8"
+    if bool(getattr(pool, "quantized", False)) != want_quant:
+        raise SanitizerError(
+            f"pool.quantized={getattr(pool, 'quantized', None)} but engine "
+            f"kv_quant={getattr(engine, 'kv_quant', None)!r}"
+        )
+    for name, side in (("k", pool.k), ("v", pool.v)):
+        if not want_quant:
+            if isinstance(side, dict):
+                raise SanitizerError(
+                    f"pool.{name} is a dict pytree on an unquantized engine"
+                )
+            continue
+        if not isinstance(side, dict) or set(side) != {"q", "s"}:
+            raise SanitizerError(
+                f"quantized pool.{name} must be a {{'q','s'}} pytree, got "
+                f"{sorted(side) if isinstance(side, dict) else type(side).__name__}"
+            )
+        q, s = side["q"], side["s"]
+        if str(q.dtype) != "int8" or str(s.dtype) != "float16":
+            raise SanitizerError(
+                f"quantized pool.{name} dtypes drifted: q={q.dtype} "
+                f"(want int8), s={s.dtype} (want float16)"
+            )
+        if tuple(q.shape[:-1]) != tuple(s.shape):
+            raise SanitizerError(
+                f"quantized pool.{name} scale shape {tuple(s.shape)} does "
+                f"not mirror payload {tuple(q.shape)} minus the vector axis"
+            )
+
+
 def check_engine_invariants(engine) -> None:
     """Page-pool conservation + radix refcount consistency. Called by the
     engine at the end of every tick under the sanitizer.
@@ -174,7 +215,11 @@ def check_engine_invariants(engine) -> None:
     is owned by exactly one of (a) the allocator free list, (b) an active
     slot's ``pages`` minus the span it donated to the radix cache, (c) the
     radix tree. Refcounts: each active slot pins the chain from its
-    ``prefix_node`` to the root, contributing exactly 1 per node."""
+    ``prefix_node`` to the root, contributing exactly 1 per node. The pool
+    representation check (:func:`_check_pool_repr`) runs first so the
+    quantized ``{"q","s"}`` pool is held to the same per-tick standard as
+    plain arrays."""
+    _check_pool_repr(engine)
     alloc = engine.allocator
     free = list(alloc._free)
     free_set = set(free)

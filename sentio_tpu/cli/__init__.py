@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 __all__ = ["main"]
@@ -157,6 +158,7 @@ def _cmd_eval(args: argparse.Namespace) -> int:
         configs={c.strip() for c in args.configs.split(",") if c.strip()} or None
         if args.configs else None,
         encoder_checkpoint=args.encoder_checkpoint,
+        kv_quant=args.kv_quant,
     )
     text = json.dumps(payload, indent=2)
     if args.out:
@@ -340,6 +342,10 @@ def main(argv: list[str] | None = None) -> int:
     p_eval.add_argument("--configs", default="",
                         help="comma list: sparse_api,dense,hybrid_rerank,full_paged,batched")
     p_eval.add_argument("--out", default="", help="also write the JSON here")
+    p_eval.add_argument("--kv-quant", default=os.environ.get("KV_QUANT", "none"),
+                        choices=["none", "int8"],
+                        help="KV page quantization for the paged configs "
+                             "(the quality-gate measurement knob)")
     p_eval.add_argument("--encoder-checkpoint", default="",
                         help="trained bi-encoder checkpoint for the dense leg "
                              "(see `train-encoder`)")

@@ -67,6 +67,7 @@ def run_eval(
     skip_baseline: bool = False,
     configs: Optional[set] = None,
     encoder_checkpoint: str = "",
+    kv_quant: str = "none",
 ) -> dict:
     """Run the eval matrix; returns the EVAL.json payload (pure dict)."""
     import jax
@@ -225,6 +226,9 @@ def run_eval(
                 steps_per_tick=16,
                 max_tick_steps=64,
                 pipeline_depth=2,
+                # int8 KV pages: the quality-gate run (tests/test_eval.py)
+                # measures this config's recall/answers against bf16
+                kv_quant=kv_quant,
                 # random-init weights greedy-sample EOS almost immediately;
                 # fixed-length generation keeps configs 4/5 measuring the
                 # full decode+verify cost real tuned models pay
@@ -241,20 +245,38 @@ def run_eval(
                 config=GraphConfig(settings=settings),
             )
 
+            # answer metric for the quantization quality gate: mean emitted
+            # answer length (chars) — a degenerate int8 decode (empty /
+            # collapsed answers) moves this even when retrieval recall
+            # cannot see it. list.append is atomic under the GIL, so the
+            # concurrent "batched" config needs no extra lock.
+            answer_chars: list[int] = []
+
             def full(question: str):
                 state = graph.invoke(create_initial_state(question, metadata={"mode": "fast"}))
                 docs = state.get("reranked_documents") or state.get("retrieved_documents") or []
-                return docs, state.get("response", "")
+                answer = state.get("response", "") or ""
+                answer_chars.append(len(answer))
+                return docs, answer
 
             if "full_paged" in want:
                 _log("eval: [4/5] full_paged ...")
-                rows.append(run_queries("4-full-graph-paged", full, queries).row())
+                answer_chars.clear()
+                res4 = run_queries("4-full-graph-paged", full, queries)
+                if answer_chars:
+                    res4.extras["answer_chars_mean"] = round(
+                        sum(answer_chars) / len(answer_chars), 1)
+                rows.append(res4.row())
             if "batched" in want:
                 _log(f"eval: [5/5] batched x{concurrency} ...")
                 before = service.stats()  # stats are service-lifetime
+                answer_chars.clear()
                 result = run_queries(
                     "5-batched-dp", full, queries, concurrent=concurrency
                 )
+                if answer_chars:
+                    result.extras["answer_chars_mean"] = round(
+                        sum(answer_chars) / len(answer_chars), 1)
                 stats = service.stats()
                 ticks = stats["ticks"] - before["ticks"]
                 active = (
@@ -299,6 +321,7 @@ def run_eval(
         "baseline": baseline_row,
         "rtt_ms": rtt_ms,
         "wall_s": round(time.perf_counter() - t_start, 1),
+        **({"kv_quant": kv_quant} if kv_quant != "none" else {}),
         **extras,
     }
 

@@ -19,6 +19,18 @@ the page table directly:
   current page masks per-position (key pos ≤ len — the new token's KV was
   scattered at index ``len`` before the call).
 
+Two kernel variants share the grid/recurrence:
+
+* **bf16 pages** (``paged_attention``) — K/V page blocks DMA as-is;
+* **int8 pages** (``paged_attention_quant``) — the BlockSpecs DMA int8
+  page blocks PLUS their fp16 per-vector scales through the same
+  scalar-prefetch index_map, and dequantization happens in-register in
+  VMEM: q·(s·K) folds as (q·K)·s on the kv-head-batched score dot, and
+  p·(s·V) as (p·s)·V on the value dot, so quantized pages never
+  round-trip through a dense bf16 gather in HBM. Page reads shrink to
+  ~half the bytes of bf16 — the point of quantizing a bandwidth-bound
+  decode.
+
 Runs in interpret mode on CPU (tests); on TPU it is the decode fast path
 once windows are long enough to beat the fused XLA gather.
 """
@@ -35,7 +47,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float(np.finfo(np.float32).min)
 
-__all__ = ["paged_attention", "make_paged_attn_impl"]
+# jax renamed TPUCompilerParams -> CompilerParams across the versions this
+# repo spans (CPU test env vs the axon TPU image); accept either
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
+__all__ = ["paged_attention", "paged_attention_quant", "make_paged_attn_impl"]
 
 
 def _paged_kernel(
@@ -139,7 +156,7 @@ def paged_attention(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, rep, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -147,17 +164,160 @@ def paged_attention(
     return out.reshape(b, h, d)
 
 
+def _paged_kernel_quant(
+    pt_ref,    # [B, NB] int32 scalar-prefetch — page table
+    lens_ref,  # [B] int32 scalar-prefetch — current token index per row
+    q_ref,     # [Hkv, rep, D]
+    kq_ref,    # [page, Hkv, D] int8 — the physical page chosen by index_map
+    ks_ref,    # [page, Hkv] f16 — per-vector absmax scales for that page
+    vq_ref,    # [page, Hkv, D] int8
+    vs_ref,    # [page, Hkv] f16
+    o_ref,     # [Hkv, rep, D]
+    m_ref,     # [Hkv, rep, 1] fp32 scratch
+    l_ref,     # [Hkv, rep, 1] fp32 scratch
+    acc_ref,   # [Hkv, rep, D] fp32 scratch
+    *,
+    page: int,
+    sm_scale: float,
+):
+    """Online-softmax over int8 pages, dequantized in-register.
+
+    The scale never expands to [page, D]: q·(s_p·K_p) == (q·K_p)·s_p per key
+    vector, so the score dot runs on the raw int8 block (cast to f32 on the
+    VPU) and the scalar scale multiplies the [Hkv, rep, page] score tile.
+    Same fold on the value side: p·(s·V) == (p·s)·V."""
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    cur = lens_ref[b]  # the new token sits at absolute index ``cur``
+
+    @pl.when(i * page <= cur)
+    def _block():
+        q = q_ref[:].astype(jnp.float32)  # [Hkv, rep, D]
+        # [page, Hkv, ...] → [Hkv, page, ...]: batch dims of both matmul
+        # operands must sit at the SAME index (see _paged_kernel)
+        k = kq_ref[:].swapaxes(0, 1).astype(jnp.float32)   # [Hkv, page, D]
+        ks = ks_ref[:].swapaxes(0, 1).astype(jnp.float32)  # [Hkv, page]
+        # s[g, r, p] = (q[g, r, :] · kq[g, p, :]) * ks[g, p] — the (q·K)·s
+        # fold: one scalar multiply per score instead of page*D dequants
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        ) * ks[:, None, :] * sm_scale  # [Hkv, rep, page]
+
+        pos = i * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(pos <= cur, s, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        p = jnp.exp(jnp.where(m_new > NEG_INF / 2, s - m_new, NEG_INF))
+        alpha = jnp.exp(jnp.where(m_new > NEG_INF / 2, m_prev - m_new, 0.0))
+
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=2, keepdims=True)
+        v = vq_ref[:].swapaxes(0, 1).astype(jnp.float32)   # [Hkv, page, D]
+        vs = vs_ref[:].swapaxes(0, 1).astype(jnp.float32)  # [Hkv, page]
+        # acc[g, r, :] += (p[g, r, :] * vs[g, :]) @ vq[g, :, :] — the (p·s)·V
+        # fold on the value dot
+        pv = p * vs[:, None, :]
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            pv, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_new
+
+    @pl.when(i == pl.num_programs(1) - 1)
+    def _finalize():
+        l = l_ref[:]
+        o_ref[:] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_attention_quant(
+    q: jax.Array,           # [B, H, D] — one decode token per row
+    k_pages_q: jax.Array,   # [P, page, Hkv, D] int8 — one layer's page pool
+    k_scales: jax.Array,    # [P, page, Hkv] f16 per-vector absmax scales
+    v_pages_q: jax.Array,   # [P, page, Hkv, D] int8
+    v_scales: jax.Array,    # [P, page, Hkv] f16
+    page_table: jax.Array,  # [B, NB] int32 physical page ids
+    lens: jax.Array,        # [B] int32 — index of the current token
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Decode attention over the int8-quantized paged pool → [B, H, D].
+
+    Same grid/scalar-prefetch walk as :func:`paged_attention`; the int8
+    payload and its scale pages DMA per grid step and dequantize in VMEM.
+    """
+    b, h, d = q.shape
+    _, page, hkv, _ = k_pages_q.shape
+    rep = h // hkv
+    nb = page_table.shape[1]
+    sm_scale = 1.0 / float(np.sqrt(d))
+
+    q4 = q.reshape(b, hkv, rep, d)
+
+    kernel = functools.partial(_paged_kernel_quant, page=page, sm_scale=sm_scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nb),
+        in_specs=[
+            pl.BlockSpec((None, hkv, rep, d), lambda bb, i, pt, ln: (bb, 0, 0, 0)),
+            pl.BlockSpec((None, page, hkv, d), lambda bb, i, pt, ln: (pt[bb, i], 0, 0, 0)),
+            pl.BlockSpec((None, page, hkv), lambda bb, i, pt, ln: (pt[bb, i], 0, 0)),
+            pl.BlockSpec((None, page, hkv, d), lambda bb, i, pt, ln: (pt[bb, i], 0, 0, 0)),
+            pl.BlockSpec((None, page, hkv), lambda bb, i, pt, ln: (pt[bb, i], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, hkv, rep, d), lambda bb, i, pt, ln: (bb, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, rep, 1), jnp.float32),
+            pltpu.VMEM((hkv, rep, 1), jnp.float32),
+            pltpu.VMEM((hkv, rep, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rep, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        page_table.astype(jnp.int32), lens.astype(jnp.int32),
+        q4, k_pages_q, k_scales, v_pages_q, v_scales,
+    )
+    return out.reshape(b, h, d)
+
+
 def make_paged_attn_impl(interpret: bool | None = None):
     """Adapter with the ``paged_decode_forward(attn_impl=...)`` signature:
     (q [B,1,H,D], k_pages_l, v_pages_l, page_table, lens, n_rep) → [B,1,H,D].
+
+    Representation-aware: a plain array routes to the bf16 kernel, a
+    ``{"q", "s"}`` pytree (the ``kv_quant="int8"`` pool layer from
+    ``runtime.paged._layer_pages``) routes to the int8 kernel — so one
+    engine attn seam serves both pool representations.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
     def impl(q, k_pages_l, v_pages_l, page_table, lens, n_rep):
-        out = paged_attention(
-            q[:, 0], k_pages_l, v_pages_l, page_table, lens, interpret=interpret
-        )
+        if isinstance(k_pages_l, dict):
+            out = paged_attention_quant(
+                q[:, 0], k_pages_l["q"], k_pages_l["s"],
+                v_pages_l["q"], v_pages_l["s"],
+                page_table, lens, interpret=interpret,
+            )
+        else:
+            out = paged_attention(
+                q[:, 0], k_pages_l, v_pages_l, page_table, lens,
+                interpret=interpret,
+            )
         return out[:, None]
 
     return impl

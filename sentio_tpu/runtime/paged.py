@@ -85,6 +85,18 @@ class PagedPool:
     def num_pages(self) -> int:
         return (self.k["q"] if self.quantized else self.k).shape[1]
 
+    @property
+    def hbm_bytes(self) -> int:
+        """Static device footprint of the k+v page pools (payload + scales
+        for the quantized repr) — the number the footprint claims are
+        audited by (bench phase A/C, the compile-manifest pools section)."""
+        import jax
+
+        return sum(
+            int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree_util.tree_leaves((self.k, self.v))
+        )
+
 
 def quantize_kv(x):
     """[..., D] float → (int8 [..., D], f16 scale [...]). Symmetric absmax
@@ -346,6 +358,7 @@ class _Slot:
     prompt_tokens: int = 0
     max_new: int = 0
     temperature: float = 0.0
+    top_k: int = 0
     emitted: list[int] = field(default_factory=list)
     active: bool = False
     # first sampled token still on device (admission defers its fetch; the
@@ -381,6 +394,11 @@ class _Request:
     prompt: str
     max_new: int
     temperature: float
+    # per-request top-k (0 = off). Rides every sampling dispatch as TRACED
+    # int32 data — one compiled program for any k (PR 4's top_k fix), so
+    # sampling stays fused inside the decode scan rather than becoming a
+    # second logits-then-sample dispatch per tick.
+    top_k: int = 0
     submit_t: float = 0.0
     # absolute time.perf_counter() deadline (None = no deadline). The queue
     # drops an expired request BEFORE admission — prefilling for a caller
@@ -638,20 +656,16 @@ class ContinuousBatchingEngine:
         self._page_table = np.zeros((max_slots, max_pages_per_seq), np.int32)
         self._lens = np.zeros(max_slots, np.int32)
         self._temps = np.zeros(max_slots, np.float32)
+        self._top_ks = np.zeros(max_slots, np.int32)
         self._last_tok = np.zeros(max_slots, np.int32)
         # Pallas paged-attention kernel walks page tables in VMEM on TPU;
-        # the XLA gather path is the universal fallback (and CPU test path)
+        # the XLA gather path is the universal fallback (and CPU test path).
+        # The kernel is representation-aware: int8 pools route to the quant
+        # variant (int8 pages + f16 scales DMA'd per block, dequantized
+        # in-register), so kv_quant="int8" keeps the fast path
         if use_pallas is None:
             use_pallas = jax.default_backend() == "tpu"
         self._attn_impl = None
-        if self.kv_quant == "int8" and use_pallas:
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "kv_quant=int8 forces the XLA gather-dequant attention path; "
-                "the Pallas paged kernel reads bf16 pages only"
-            )
-            use_pallas = False
         if use_pallas:
             from sentio_tpu.kernels.paged_attention import make_paged_attn_impl
 
@@ -674,7 +688,7 @@ class ContinuousBatchingEngine:
         @jit_family("paged.step_n", static_argnames=("steps",),
                     donate_argnums=(5, 6))
         def step_n(params, tok, lens, halted, page_table, k_pages, v_pages,
-                   rng, temps, budgets, steps):
+                   rng, temps, top_ks, budgets, steps):
             """``steps`` decode sub-steps fused into one dispatch (lax.scan).
 
             Per-row ``budgets`` bound how far each row may advance (token
@@ -697,7 +711,10 @@ class ContinuousBatchingEngine:
                     attn_impl=attn_impl, write_mask=active,
                 )
                 rng, sub = jax.random.split(rng)
-                nxt = sample_tokens(logits, sub, temps)
+                # temperature AND top-k sample INSIDE the scan body — the
+                # tick is one dispatch, never logits-then-sample. top_ks is
+                # traced [B] int32; k<=0 rows keep the full distribution.
+                nxt = sample_tokens(logits, sub, temps, top_k=top_ks)
                 tok = jnp.where(active, nxt, tok)
                 lens = jnp.where(active, lens + 1, lens)
                 if not ignore_eos:
@@ -735,7 +752,7 @@ class ContinuousBatchingEngine:
 
         @jit_family("paged.prefill_scatter", donate_argnums=(7, 8))
         def prefill_scatter(params, ids, positions, lens, rng, temps, scat,
-                            k_pages, v_pages):
+                            k_pages, v_pages, top_ks):
             """Batched admission in ONE dispatch: contiguous prefill forward,
             cache scatter into each row's pages, first-token sample from each
             row's last prompt logit. Pad rows scatter to scratch page 0."""
@@ -756,7 +773,7 @@ class ContinuousBatchingEngine:
             )
             last = jnp.take_along_axis(logits, (lens - 1)[:, None, None], axis=1)[:, 0]
             rng, sub = jax.random.split(rng)
-            first = sample_tokens(last, sub, temps)
+            first = sample_tokens(last, sub, temps, top_k=top_ks)
             return first, k_pages, v_pages, rng
 
         self._prefill_scatter = prefill_scatter
@@ -767,7 +784,7 @@ class ContinuousBatchingEngine:
                     static_argnames=("do_sample",), donate_argnums=(7, 8))
         def prior_prefill_scatter(params, ids, positions, lens, rng, temps,
                                   scat, k_pages, v_pages, prior_table,
-                                  n_prior, do_sample):
+                                  n_prior, top_ks, do_sample):
             """Prefill a batch of suffixes against per-row prior KV already
             in the pool — ONE compiled family for both radix-cache admission
             (prior = the matched shared-prefix pages) and chunked-prefill
@@ -830,7 +847,7 @@ class ContinuousBatchingEngine:
                 last = jnp.take_along_axis(
                     logits, (lens - 1)[:, None, None], axis=1)[:, 0]
                 rng, sub = jax.random.split(rng)
-                first = sample_tokens(last, sub, temps)
+                first = sample_tokens(last, sub, temps, top_k=top_ks)
             else:
                 first = jnp.zeros((b,), jnp.int32)
             return first, k_pages, v_pages, rng
@@ -887,15 +904,23 @@ class ContinuousBatchingEngine:
     # --------------------------------------------------------------- public
 
     def submit(self, prompt: str, max_new_tokens: int = 64, temperature: float = 0.0,
-               deadline_ts: Optional[float] = None) -> int:
+               deadline_ts: Optional[float] = None, top_k: int = 0) -> int:
         """``deadline_ts`` is an absolute ``time.perf_counter()`` deadline:
         the queue drops the request (finish_reason="expired") if it is still
-        waiting for a slot when the deadline passes."""
+        waiting for a slot when the deadline passes. ``top_k`` (0 = off)
+        rides the fused decode dispatch as traced per-row data — any value
+        shares the one compiled tick program."""
         if self._san is not None:
             self._san.enter("submit")
+        top_k = int(top_k)
+        if top_k > 0 and self._spec_tick is not None:
+            raise ValueError(
+                "top_k sampling is not supported with paged speculation "
+                "(the spec tick's accept/correct rule is temperature-only)"
+            )
         rid = next(self._next_id)
         self._queue.append(_Request(
-            rid, prompt, max_new_tokens, temperature,
+            rid, prompt, max_new_tokens, temperature, top_k=max(top_k, 0),
             submit_t=time.perf_counter(), deadline_ts=deadline_ts,
         ))
         return rid
@@ -935,13 +960,13 @@ class ContinuousBatchingEngine:
         # (already-cached blocks scatter to scratch page 0 and are dropped);
         # the sampled token is discarded — this dispatch only fills pages
         width = self._prefill_width(full)
-        ids, lens, temps, scat, positions = self._assemble_prefill(
-            [(toks[:full], 0.0, [0] * (matched // self.page_size) + pages)],
+        ids, lens, temps, top_ks, scat, positions = self._assemble_prefill(
+            [(toks[:full], 0.0, 0, [0] * (matched // self.page_size) + pages)],
             width,
         )
         _first, self.pool.k, self.pool.v, self._rng = self._prefill_scatter(
             self.params, ids, positions, lens, self._rng, temps, scat,
-            self.pool.k, self.pool.v,
+            self.pool.k, self.pool.v, top_ks,
         )
         _node, donated = self._radix.insert(toks[:full], matched, pages)
         leftover = set(pages) - set(donated)
@@ -1002,6 +1027,7 @@ class ContinuousBatchingEngine:
         self._page_table[:] = 0
         self._lens[:] = 0
         self._temps[:] = 0.0
+        self._top_ks[:] = 0
         self._last_tok[:] = 0
         self._rng = jax.random.PRNGKey(int(np.random.default_rng().integers(2**31)))
 
@@ -1279,6 +1305,7 @@ class ContinuousBatchingEngine:
             slot.length = len(tok_ids)
             slot.max_new = req.max_new
             slot.temperature = req.temperature
+            slot.top_k = req.top_k
             slot.emitted = []
             slot.inflight_steps = 0
             slot.shared_tokens = shared
@@ -1297,6 +1324,7 @@ class ContinuousBatchingEngine:
             self._page_table[slot_idx] = row
             self._lens[slot_idx] = len(tok_ids)
             self._temps[slot_idx] = req.temperature
+            self._top_ks[slot_idx] = req.top_k
 
         if not batch:
             return
@@ -1361,9 +1389,9 @@ class ContinuousBatchingEngine:
 
     def _assemble_prefill(self, rows_data, width: int, pos_offset: int = 0):
         """Build the padded admission arrays ONE way for every prefill
-        flavor. rows_data: [(token_ids, temperature, pages)]. Pad rows and
-        unused scatter blocks point at scratch page 0; args stay host numpy
-        (a jit call ships them asynchronously, while an explicit
+        flavor. rows_data: [(token_ids, temperature, top_k, pages)]. Pad
+        rows and unused scatter blocks point at scratch page 0; args stay
+        host numpy (a jit call ships them asynchronously, while an explicit
         jnp.asarray is a SYNCHRONOUS upload — ~RTT each on remote-attached
         devices)."""
         rows = bucket_size(len(rows_data), self.ADMIT_BUCKETS)
@@ -1371,11 +1399,13 @@ class ContinuousBatchingEngine:
         ids = np.full((rows, width), self.tokenizer.pad_id, np.int32)
         lens = np.ones(rows, np.int32)
         temps = np.zeros(rows, np.float32)
+        top_ks = np.zeros(rows, np.int32)
         scat = np.zeros((rows, nb), np.int32)
-        for r, (tok_ids, temp, pages) in enumerate(rows_data):
+        for r, (tok_ids, temp, top_k, pages) in enumerate(rows_data):
             ids[r, : len(tok_ids)] = tok_ids
             lens[r] = len(tok_ids)
             temps[r] = temp
+            top_ks[r] = top_k
             used = (len(tok_ids) + self.page_size - 1) // self.page_size
             scat[r, :used] = pages[:used]
         positions = (
@@ -1384,7 +1414,7 @@ class ContinuousBatchingEngine:
                 np.arange(width, dtype=np.int32)[None, :], (rows, width)
             )
         ).astype(np.int32)
-        return ids, lens, temps, scat, positions
+        return ids, lens, temps, top_ks, scat, positions
 
     def _prefill_chunk(
         self, width: int, chunk: list[tuple[int, _Request, list[int]]]
@@ -1392,14 +1422,14 @@ class ContinuousBatchingEngine:
         """One prefill+scatter+sample dispatch for up to max(ADMIT_BUCKETS)
         same-width-bucket rows (rows pad up to a batch bucket)."""
         faults.hit("paged.admit_scatter")
-        ids, lens, temps, scat, positions = self._assemble_prefill(
-            [(tok_ids, req.temperature, self.slots[slot_idx].pages)
+        ids, lens, temps, top_ks, scat, positions = self._assemble_prefill(
+            [(tok_ids, req.temperature, req.top_k, self.slots[slot_idx].pages)
              for slot_idx, req, tok_ids in chunk],
             width,
         )
         first, self.pool.k, self.pool.v, self._rng = self._prefill_scatter(
             self.params, ids, positions, lens, self._rng, temps, scat,
-            self.pool.k, self.pool.v,
+            self.pool.k, self.pool.v, top_ks,
         )
         self.prefill_tokens_total += sum(len(t) for _i, _r, t in chunk)
         slot_idxs = [slot_idx for slot_idx, _req, _ids in chunk]
@@ -1422,7 +1452,8 @@ class ContinuousBatchingEngine:
         n_prior = []
         for slot_idx, req, tok_ids, shared in chunk:
             rows_data.append(
-                (tok_ids[shared:], req.temperature, self.slots[slot_idx].pages)
+                (tok_ids[shared:], req.temperature, req.top_k,
+                 self.slots[slot_idx].pages)
             )
             n_prior.append(shared)
         rows = bucket_size(len(chunk), self.ADMIT_BUCKETS)
@@ -1431,12 +1462,13 @@ class ContinuousBatchingEngine:
         for r, (slot_idx, _req, _t, shared) in enumerate(chunk):
             sb = shared // self.page_size
             prior_tables[r, :sb] = self._page_table[slot_idx, :sb]
-        ids, lens, temps, scat, positions = self._assemble_prefill(
+        ids, lens, temps, top_ks, scat, positions = self._assemble_prefill(
             rows_data, width, pos_offset=n_prior[:, None],
         )
         first, self.pool.k, self.pool.v, self._rng = self._prior_prefill_scatter(
             self.params, ids, positions, lens, self._rng, temps, scat,
-            self.pool.k, self.pool.v, prior_tables, n_prior, do_sample=True,
+            self.pool.k, self.pool.v, prior_tables, n_prior, top_ks,
+            do_sample=True,
         )
         self.prefill_tokens_total += sum(len(t) - s for _i, _r, t, s in chunk)
         slot_idxs = [slot_idx for slot_idx, _req, _ids, _sh in chunk]
@@ -1470,8 +1502,8 @@ class ContinuousBatchingEngine:
             nb = (len(seg) + self.page_size - 1) // self.page_size
             seg_pages = self._page_table[i, pb : pb + nb].tolist()
             n_prior = np.asarray([prior], np.int32)
-            ids, lens, temps, scat, positions = self._assemble_prefill(
-                [(seg, slot.temperature, seg_pages)], width,
+            ids, lens, temps, top_ks, scat, positions = self._assemble_prefill(
+                [(seg, slot.temperature, slot.top_k, seg_pages)], width,
                 pos_offset=n_prior[:, None],
             )
             # prior-table width buckets to a power-of-two page count (padded
@@ -1484,7 +1516,7 @@ class ContinuousBatchingEngine:
                 self._prior_prefill_scatter(
                     self.params, ids, positions, lens, self._rng, temps,
                     scat, self.pool.k, self.pool.v, prior_table,
-                    n_prior, do_sample=is_last,
+                    n_prior, top_ks, do_sample=is_last,
                 )
             self.prefill_tokens_total += len(seg)
             if is_last:
@@ -1632,6 +1664,7 @@ class ContinuousBatchingEngine:
                     self.pool.v,
                     self._rng,
                     self._temps.copy(),
+                    self._top_ks.copy(),
                     budgets,
                     steps=steps,
                 )
@@ -1758,6 +1791,7 @@ class ContinuousBatchingEngine:
         self._page_table[i] = 0
         self._lens[i] = 0
         self._temps[i] = 0.0
+        self._top_ks[i] = 0
         self._last_tok[i] = 0
         return result
 
@@ -1772,6 +1806,8 @@ class ContinuousBatchingEngine:
             "free_pages": self.allocator.free_pages,
             "total_pages": self.allocator.num_pages,
             "page_size": self.page_size,
+            "kv_quant": self.kv_quant,
+            "pool_hbm_bytes": self.pool.hbm_bytes,
             "head_skips": self._head_skips,
             "ttft_count": self.ttft_count,
             "prefill_tokens": self.prefill_tokens_total,

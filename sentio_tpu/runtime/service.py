@@ -79,6 +79,9 @@ class _Ticket:
     prompt: str
     max_new_tokens: int
     temperature: float
+    # per-request top-k (0 = off) — traced data on the fused decode
+    # dispatch, so any k shares the engine's one compiled tick program
+    top_k: int = 0
     event: threading.Event = field(default_factory=threading.Event)
     result: Optional[PagedResult] = None
     # terminal typed failure (deadline expiry, shed) — raised to the caller
@@ -180,6 +183,7 @@ class PagedGenerationService:
         request_id: Optional[str] = None,
         deadline_s: Optional[float] = None,
         deadline_ts: Optional[float] = None,
+        top_k: int = 0,
     ) -> PagedResult:
         """Submit one request and block until its tokens are done. Safe to
         call from any number of threads concurrently — that concurrency IS
@@ -192,8 +196,9 @@ class PagedGenerationService:
         tick its deadline passes. Raises :class:`ServiceOverloaded` (shed),
         :class:`DeadlineExceededError` (expired), or
         :class:`GenerationTimeout` (no deadline, plain timeout)."""
+        self._check_top_k(top_k)
         deadline_ts = self._resolve_deadline(deadline_s, deadline_ts)
-        ticket = _Ticket(prompt, max_new_tokens, temperature,
+        ticket = _Ticket(prompt, max_new_tokens, temperature, top_k=top_k,
                          request_id=request_id, t_submit=time.perf_counter(),
                          deadline_ts=deadline_ts,
                          retries_left=self.retry_budget)
@@ -246,6 +251,7 @@ class PagedGenerationService:
         request_id: Optional[str] = None,
         deadline_s: Optional[float] = None,
         deadline_ts: Optional[float] = None,
+        top_k: int = 0,
     ) -> Iterator[str]:
         """Streaming variant: yields decoded text increments as the shared
         decode batch produces them (chunks of up to steps_per_tick tokens —
@@ -254,8 +260,32 @@ class PagedGenerationService:
         until they decode cleanly. Deadline semantics match
         :meth:`generate`; a deadline that passes mid-stream raises
         :class:`DeadlineExceededError` from the iterator."""
+        # validated HERE, not in the generator body: a generator function
+        # defers its body to the first next(), which would surface this
+        # after an SSE handler already committed its 200
+        self._check_top_k(top_k)
+        return self._generate_stream_impl(
+            prompt, max_new_tokens, temperature, timeout_s, request_id,
+            deadline_s, deadline_ts, top_k,
+        )
+
+    def _generate_stream_impl(
+        self,
+        prompt: str,
+        max_new_tokens: int,
+        temperature: float,
+        timeout_s: Optional[float],
+        request_id: Optional[str],
+        deadline_s: Optional[float],
+        deadline_ts: Optional[float],
+        top_k: int,
+    ) -> Iterator[str]:
+        # NB: admission below is still deferred to the first next() (the
+        # long-standing stream contract — SSE handlers pre-check via
+        # check_admission before committing their 200)
         deadline_ts = self._resolve_deadline(deadline_s, deadline_ts)
-        ticket = _Ticket(prompt, max_new_tokens, temperature, stream_q=_queue.Queue(),
+        ticket = _Ticket(prompt, max_new_tokens, temperature, top_k=top_k,
+                         stream_q=_queue.Queue(),
                          request_id=request_id, t_submit=time.perf_counter(),
                          deadline_ts=deadline_ts,
                          retries_left=self.retry_budget)
@@ -328,6 +358,16 @@ class PagedGenerationService:
                 ticket.cancelled = True
 
     # ------------------------------------------------------------ admission
+
+    def _check_top_k(self, top_k: int) -> None:
+        """Mirror of the engine's submit-time rule (same ``top_k > 0``
+        condition — k <= 0 means off everywhere), raised at the service API
+        instead of inside the pump loop."""
+        if top_k > 0 and getattr(self.engine, "_spec_tick", None) is not None:
+            raise ValueError(
+                "top_k sampling is not supported with paged speculation "
+                "(the spec tick's accept/correct rule is temperature-only)"
+            )
 
     def _resolve_deadline(
         self, deadline_s: Optional[float], deadline_ts: Optional[float]
@@ -682,6 +722,7 @@ class PagedGenerationService:
                         max_new_tokens=ticket.max_new_tokens,
                         temperature=ticket.temperature,
                         deadline_ts=ticket.deadline_ts,
+                        top_k=ticket.top_k,
                     )
                     self._tickets[rid] = ticket
                 self._inbox.clear()

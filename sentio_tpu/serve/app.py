@@ -636,6 +636,8 @@ def _publish_serving_gauges(container: DependencyContainer):
         "prefix_hit_token_ratio", "prefix_cache_pages", "prefix_cache_nodes",
         # overload posture: admission bound and whether a drain is underway
         "max_queue", "draining",
+        # static KV page-pool footprint (bytes) — halves under KV_QUANT=int8
+        "pool_hbm_bytes",
     ):
         if key in stats:
             m.set_serving_stat(key, float(stats[key]))

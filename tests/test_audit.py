@@ -50,6 +50,12 @@ DONATING_FAMILIES = (
     "paged.prior_prefill_scatter",
     "paged.draft_prefill",
     "paged_spec.spec_tick",
+    # kv_quant="int8": the same families lowered over the {"q","s"} pool —
+    # FOUR donated leaves per pool pair (payload + scales, k and v) must
+    # all alias or the quantized pool silently starts copying per tick
+    "paged.step_n@int8",
+    "paged.prefill_scatter@int8",
+    "paged.prior_prefill_scatter@int8",
 )
 
 
@@ -76,6 +82,30 @@ class TestCommittedManifestGate:
         assert any("steps=" in k for k in fams["paged.step_n"]["variants"])
         assert any("pnb=" in k
                    for k in fams["paged.prior_prefill_scatter"]["variants"])
+
+    def test_quantized_families_audited_and_bounded(self, audit_result):
+        """kv_quant=int8 lowers through its own manifest entries with the
+        same declared tick ladder — the quantized compile space is bounded
+        by exactly the helpers the bf16 space is."""
+        fams = audit_result.report["families"]
+        for name in ("paged.step_n@int8", "paged.prefill_scatter@int8",
+                     "paged.prior_prefill_scatter@int8"):
+            assert name in fams, name
+            assert fams[name]["variant_count"] > 0
+        assert set(fams["paged.step_n@int8"]["variants"]) \
+            == set(fams["paged.step_n"]["variants"])
+
+    def test_quantized_pool_footprint_at_most_0_6x(self, audit_result,
+                                                   manifest):
+        """The footprint claim, gated twice: the fresh report AND the
+        committed manifest must both show the int8 pool at <= 0.6x the
+        bf16 pool's static HBM bytes (>= 40% saved) at serving head_dim."""
+        for source, where in ((audit_result.report, "report"),
+                              (manifest, "manifest")):
+            pools = source.get("pools")
+            assert pools, f"{where} has no pools section"
+            assert pools["int8_pool_bytes"] <= 0.6 * pools["bf16_pool_bytes"], (
+                where, pools)
 
 
 class TestSeededRegressions:

@@ -181,3 +181,45 @@ class TestRunEval:
         assert result.n_queries == 4
         assert result.p50_ms > 0
         assert result.extras["http_calls"]["chat"] >= 4
+
+
+class TestQuantQualityGate:
+    """KV_QUANT=int8 quality gate: the int8 full-graph eval is measured
+    against a bf16 run over the same bundle in the same process, and the
+    delta is gated by the COMMITTED tolerances in eval/quant_gate.json —
+    a quantization quality regression fails tier-1 here instead of being
+    suspected in production."""
+
+    GATE_ARGS = dict(
+        scale="tiny", n_docs=48, n_queries=4, concurrency=2,
+        new_tokens=8, verifier_tokens=4, skip_baseline=True,
+        configs={"full_paged"},
+    )
+
+    def test_int8_recall_and_answers_within_committed_tolerance(self):
+        import json
+        from pathlib import Path
+
+        gate_path = (Path(__file__).resolve().parents[1] / "sentio_tpu"
+                     / "eval" / "quant_gate.json")
+        gate = json.loads(gate_path.read_text())
+
+        bf16 = run_eval(**self.GATE_ARGS)
+        int8 = run_eval(**self.GATE_ARGS, kv_quant="int8")
+        (bf_row,) = bf16["rows"]
+        (i8_row,) = int8["rows"]
+        assert int8["kv_quant"] == "int8"
+
+        assert i8_row.get("errors", 0) <= gate["errors_max"], i8_row
+        drop = bf_row["recall@10"] - i8_row["recall@10"]
+        assert drop <= gate["recall_at_10_max_drop"], (
+            f"int8 recall@10 dropped {drop:.3f} vs bf16 "
+            f"(gate {gate['recall_at_10_max_drop']}): {bf_row} vs {i8_row}")
+        # collapsed/empty int8 decodes move the answer-length metric even
+        # when retrieval recall cannot see them
+        bf_chars = bf_row.get("answer_chars_mean", 0.0)
+        i8_chars = i8_row.get("answer_chars_mean", 0.0)
+        assert bf_chars > 0, bf_row
+        assert i8_chars >= gate["answer_chars_min_ratio"] * bf_chars, (
+            f"int8 mean answer length {i8_chars} vs bf16 {bf_chars} "
+            f"(gate ratio {gate['answer_chars_min_ratio']})")

@@ -169,6 +169,29 @@ class TestPagedAttentionKernel:
         got = eng.run_all([prompt], max_new_tokens=8, temperature=0.0)[0]
         assert got.tokens == ref.tokens
 
+    def test_int8_engine_with_kernel_churn_conserves_pages(self, cfg, contiguous):
+        """KV_QUANT=int8 + the quantization-native Pallas kernel (interpret
+        on CPU) through an admission-churn workload, with the sanitizer
+        (armed for this module) checking pool conservation on the dict-repr
+        pool every tick."""
+        eng = ContinuousBatchingEngine(
+            model_config=cfg, params=contiguous.params,
+            tokenizer=contiguous.tokenizer, max_slots=2, page_size=16,
+            max_pages_per_seq=4, use_pallas=True, kv_quant="int8",
+        )
+        before = eng.allocator.free_pages + (
+            eng._radix.pages_held if eng._radix is not None else 0)
+        results = eng.run_all(
+            [f"churn request {i} padding to cross pages" for i in range(5)],
+            max_new_tokens=6, temperature=0.0,
+        )
+        assert len(results) == 5
+        assert all(r.finish_reason in ("stop", "length") for r in results)
+        after = eng.allocator.free_pages + (
+            eng._radix.pages_held if eng._radix is not None else 0)
+        assert after == before
+        assert all(not s.active for s in eng.slots)
+
 
 class TestBudgets:
     def test_length_budget_respected(self, paged):

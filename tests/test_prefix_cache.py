@@ -225,6 +225,25 @@ class TestPrefixServing:
         assert eng.prefix_hits == 2
         assert eng.prefix_misses == 0
 
+    def test_int8_pool_radix_reuse_matches_cold(self):
+        """KV_QUANT=int8 parametrization of the radix path under the
+        sanitizer (conftest arms it for this module): the quantized
+        dict-repr pool serves shared prefix pages read-only exactly like
+        plain arrays — same tokens as a cache-disabled int8 engine, with
+        conservation/refcounts checked every tick."""
+        prompts = [
+            HEADER + "What is a systolic array?",
+            HEADER + "Explain BM25 briefly.",
+        ]
+        cold = make_engine(prefix_cache=False, kv_quant="int8").run_all(
+            prompts, max_new_tokens=8, temperature=0.0)
+        eng = make_engine(kv_quant="int8")
+        warm = [eng.run_all([p], max_new_tokens=8, temperature=0.0)[0]
+                for p in prompts]
+        assert [r.tokens for r in warm] == [r.tokens for r in cold]
+        assert warm[1].prefix_hit_tokens > 0
+        assert eng.stats()["prefix_hit_token_ratio"] > 0.0
+
     def test_non_matching_prompt_unaffected(self):
         prompts = ["totally different prompt with no shared head at all"]
         plain = make_engine(prefix_cache=False).run_all(

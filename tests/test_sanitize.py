@@ -131,14 +131,19 @@ class TestThreadGuard:
 
 
 class TestEngineInvariants:
-    def test_clean_run_passes(self):
-        eng = _engine()
+    # the conservation/refcount checks are representation-blind, but the
+    # quantized dict pool must ride through the same per-tick verification
+    # — every injected-corruption scenario runs at both pool reprs
+    @pytest.mark.parametrize("kv_quant", ["none", "int8"])
+    def test_clean_run_passes(self, kv_quant):
+        eng = _engine(kv_quant=kv_quant)
         results = eng.run_all([PROMPT, "short one"], max_new_tokens=6)
         assert len(results) == 2
         check_engine_invariants(eng)  # idle state is also conserved
 
-    def test_injected_page_leak_caught(self):
-        eng = _engine()
+    @pytest.mark.parametrize("kv_quant", ["none", "int8"])
+    def test_injected_page_leak_caught(self, kv_quant):
+        eng = _engine(kv_quant=kv_quant)
         eng.run_all([PROMPT], max_new_tokens=4)
         # simulate a lost page: it vanishes from the free list without any
         # owner — the very next tick must fail loudly
@@ -162,8 +167,9 @@ class TestEngineInvariants:
             while eng.has_work:
                 eng.step()
 
-    def test_injected_refcount_leak_caught(self):
-        eng = _engine()
+    @pytest.mark.parametrize("kv_quant", ["none", "int8"])
+    def test_injected_refcount_leak_caught(self, kv_quant):
+        eng = _engine(kv_quant=kv_quant)
         eng.run_all([PROMPT], max_new_tokens=4)
         radix = eng._radix
         assert radix is not None and not radix.empty
@@ -187,6 +193,53 @@ class TestEngineInvariants:
         eng.submit("short one", max_new_tokens=2)
         while eng.has_work:
             eng.step()
+
+
+class TestQuantPoolRepr:
+    """The sanitizer's pool-representation half: the ``{"q","s"}`` dict
+    pool is held to per-tick metadata invariants (int8 payload, f16 scales
+    mirroring the payload shape), so a refactor that silently densifies or
+    drops the scale tree fails the tick that did it."""
+
+    def test_clean_int8_tick_passes(self):
+        eng = _engine(kv_quant="int8")
+        eng.run_all([PROMPT], max_new_tokens=4)
+        check_engine_invariants(eng)
+
+    def test_densified_pool_caught(self):
+        eng = _engine(kv_quant="int8")
+        eng.run_all([PROMPT], max_new_tokens=2)
+        eng.pool.k = eng.pool.k["q"]  # the dense-copy regression
+        with pytest.raises(SanitizerError, match="pytree"):
+            check_engine_invariants(eng)
+
+    def test_scale_dtype_drift_caught(self):
+        import jax.numpy as jnp
+
+        eng = _engine(kv_quant="int8")
+        eng.run_all([PROMPT], max_new_tokens=2)
+        eng.pool.k = dict(eng.pool.k)
+        eng.pool.k["s"] = eng.pool.k["s"].astype(jnp.float32)
+        with pytest.raises(SanitizerError, match="dtypes"):
+            check_engine_invariants(eng)
+
+    def test_scale_shape_mismatch_caught(self):
+        eng = _engine(kv_quant="int8")
+        eng.run_all([PROMPT], max_new_tokens=2)
+        eng.pool.v = dict(eng.pool.v)
+        eng.pool.v["s"] = eng.pool.v["s"][:, :-1]
+        with pytest.raises(SanitizerError, match="scale shape"):
+            check_engine_invariants(eng)
+
+    def test_dict_pool_on_unquantized_engine_caught(self):
+        eng = _engine()
+        eng.run_all([PROMPT], max_new_tokens=2)
+        from sentio_tpu.runtime.paged import quantize_kv
+
+        q, s = quantize_kv(eng.pool.k)
+        eng.pool.k = {"q": q, "s": s}
+        with pytest.raises(SanitizerError, match="unquantized"):
+            check_engine_invariants(eng)
 
 
 class TestServiceUnderSanitizer:

@@ -105,6 +105,38 @@ class TestPagedGenerationService:
         assert got.tokens == want.tokens
         assert got.finish_reason in ("stop", "length")
 
+    def test_int8_engine_service_roundtrip_with_top_k(self, contiguous):
+        """KV_QUANT=int8 parametrization of the service path under the
+        sanitizer: the pump drives a quantized dict-repr pool through
+        admit/decode/retire, and per-request top_k rides the ticket into
+        the fused tick (traced — no per-k recompile)."""
+        engine = ContinuousBatchingEngine(
+            model_config=contiguous.model_config,
+            params=contiguous.params,
+            tokenizer=contiguous.tokenizer,
+            max_slots=4,
+            page_size=16,
+            max_pages_per_seq=8,
+            kv_quant="int8",
+        )
+        svc = PagedGenerationService(engine)
+        try:
+            want = contiguous.generate(
+                ["int8 service check"], max_new_tokens=8, temperature=0.0)[0]
+            got = svc.generate("int8 service check", max_new_tokens=8,
+                               temperature=0.0)
+            # greedy int8 usually tracks bf16 on the tiny model; require a
+            # valid completion plus first-token agreement (least noise)
+            assert got.finish_reason in ("stop", "length")
+            if want.tokens and got.tokens:
+                assert got.tokens[0] == want.tokens[0]
+            hot = svc.generate("sampled int8 request", max_new_tokens=6,
+                               temperature=0.8, top_k=4)
+            assert hot.finish_reason in ("stop", "length")
+            assert engine.stats()["kv_quant"] == "int8"
+        finally:
+            svc.close()
+
     def test_staggered_requests_share_decode_ticks(self, service):
         """Request B arrives while A is mid-decode; continuous batching must
         run them in the same fused step (max_active_slots >= 2) and both
