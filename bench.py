@@ -627,6 +627,262 @@ def phase_f_longctx(new_tokens: int = 32):
     return out
 
 
+def phase_load(llm_cfg, new_tokens):
+    """Open-loop load harness (BENCH_LOAD=1): a Poisson arrival stream of
+    concurrent generate ("/chat"-shaped) + streaming ("SSE"-shaped) requests
+    against the multi-replica serving tier, swept over an offered-QPS ladder
+    and over replica counts. Open-loop means arrivals do NOT wait for
+    completions — in-flight requests pile past any fixed client count, which
+    is the regime the n=32/c=8 closed-loop phases can never reach. Reports
+    per-level SLO attainment (p50/p95/p99 e2e, stream TTFT/TPOT), shed and
+    expired rates, the highest offered QPS sustained at a shed-rate SLO,
+    per-replica ``prefix_hit_token_ratio`` (requests carry session heads, so
+    radix-affinity routing is exercised and measured), and a two-turn
+    session affinity probe whose second request must report
+    ``prefix_hit_tokens > 0`` on the routed replica.
+
+    Env knobs: BENCH_LOAD_REPLICAS ("1,2"), BENCH_LOAD_QPS ladder
+    ("2,4,8,16,32"), BENCH_LOAD_SECONDS per level (8), BENCH_LOAD_SLOTS
+    per-replica decode slots (8), BENCH_LOAD_SHED_SLO (0.05),
+    BENCH_LOAD_SEED (1234)."""
+    import random
+    import threading
+
+    from sentio_tpu.infra.exceptions import (
+        DeadlineExceededError,
+        ServiceOverloaded,
+    )
+    from sentio_tpu.infra.flight import get_flight_recorder
+    from sentio_tpu.infra.metrics import MetricsCollector, set_metrics
+    from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+    from sentio_tpu.runtime.replica import ReplicaSet
+    from sentio_tpu.runtime.service import PagedGenerationService
+
+    replica_counts = sorted({
+        int(x) for x in os.environ.get("BENCH_LOAD_REPLICAS", "1,2").split(",")
+        if x.strip()
+    })
+    qps_ladder = [float(x)
+                  for x in os.environ.get("BENCH_LOAD_QPS",
+                                          "2,4,8,16,32").split(",")
+                  if x.strip()]
+    level_s = float(os.environ.get("BENCH_LOAD_SECONDS", "8"))
+    shed_slo = float(os.environ.get("BENCH_LOAD_SHED_SLO", "0.05"))
+    max_slots = int(os.environ.get("BENCH_LOAD_SLOTS", "8"))
+    seed = int(os.environ.get("BENCH_LOAD_SEED", "1234"))
+    gen_tokens = min(new_tokens, 16)
+    stream_frac = 0.3
+
+    # engines are reused across replica counts (compile once); reset()
+    # clears pool/radix so every run starts cold
+    engines: list = []
+
+    def get_engines(n: int) -> list:
+        while len(engines) < n:
+            engines.append(ContinuousBatchingEngine(
+                model_config=llm_cfg,
+                params=engines[0].params if engines else None,
+                tokenizer=engines[0].tokenizer if engines else None,
+                max_slots=max_slots, page_size=16, max_pages_per_seq=8,
+                steps_per_tick=8, max_tick_steps=8, pipeline_depth=2,
+                ignore_eos=True,
+            ))
+        for eng in engines[:n]:
+            eng.reset()
+        return engines[:n]
+
+    # 8 distinct session heads: follow-ups within one session share a
+    # prefix, so affinity routing has something real to route on
+    sessions = [
+        f"session {s:02d} shared conversational context head kept identical "
+        f"across this session's turns for prefix reuse measurement"
+        for s in range(8)
+    ]
+
+    def run_level(rs, qps: float, rng: random.Random) -> dict:
+        stats = {"arrivals": 0, "ok": 0, "shed": 0, "expired": 0, "error": 0}
+        e2e: list[float] = []
+        ttft: list[float] = []
+        tpot: list[float] = []
+        lock = threading.Lock()
+
+        def gen_worker(prompt: str) -> None:
+            t0 = time.perf_counter()
+            try:
+                r = rs.generate(prompt, max_new_tokens=gen_tokens,
+                                temperature=0.0, timeout_s=180)
+                dt_ms = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    if r.finish_reason == "error":
+                        stats["error"] += 1
+                    else:
+                        stats["ok"] += 1
+                        e2e.append(dt_ms)
+            except ServiceOverloaded:
+                with lock:
+                    stats["shed"] += 1
+            except DeadlineExceededError:
+                with lock:
+                    stats["expired"] += 1
+            except Exception:  # noqa: BLE001 — harness: count, don't die
+                with lock:
+                    stats["error"] += 1
+
+        def stream_worker(prompt: str) -> None:
+            t0 = time.perf_counter()
+            t_first = first_chars = chars = 0.0
+            try:
+                for piece in rs.generate_stream(
+                    prompt, max_new_tokens=gen_tokens, temperature=0.0,
+                    timeout_s=180,
+                ):
+                    if not t_first:
+                        t_first = time.perf_counter() - t0
+                        first_chars = len(piece)
+                    chars += len(piece)
+                dt = time.perf_counter() - t0
+                with lock:
+                    stats["ok"] += 1
+                    e2e.append(dt * 1e3)
+                    if t_first:
+                        ttft.append(t_first * 1e3)
+                        tail = chars - first_chars
+                        if tail > 0 and dt > t_first:
+                            # byte tokenizer: chars == tokens exactly; for
+                            # BPE this is an upper bound on token count
+                            tpot.append((dt - t_first) / tail * 1e3)
+            except ServiceOverloaded:
+                with lock:
+                    stats["shed"] += 1
+            except DeadlineExceededError:
+                with lock:
+                    stats["expired"] += 1
+            except Exception:  # noqa: BLE001
+                with lock:
+                    stats["error"] += 1
+
+        threads: list[threading.Thread] = []
+        t_start = time.perf_counter()
+        stop_t = t_start + level_s
+        seq = 0
+        while time.perf_counter() < stop_t:
+            session = rng.choice(sessions)
+            prompt = f"{session} turn {seq}"
+            worker = stream_worker if rng.random() < stream_frac else gen_worker
+            t = threading.Thread(target=worker, args=(prompt,), daemon=True)
+            t.start()
+            threads.append(t)
+            stats["arrivals"] += 1
+            seq += 1
+            time.sleep(rng.expovariate(qps))
+        for t in threads:
+            t.join(timeout=240)
+        wall = time.perf_counter() - t_start
+        hung = sum(t.is_alive() for t in threads)
+        out = {
+            "offered_qps": qps,
+            "arrivals": stats["arrivals"],
+            "completed": stats["ok"],
+            "achieved_qps": round(stats["ok"] / max(wall, 1e-9), 2),
+            "shed": stats["shed"],
+            "expired": stats["expired"],
+            "errors": stats["error"] + hung,
+            "shed_rate": round(stats["shed"] / max(stats["arrivals"], 1), 4),
+            "wall_s": round(wall, 2),
+        }
+        for label, vals in (("e2e_ms", e2e), ("ttft_ms", ttft),
+                            ("tpot_ms", tpot)):
+            if vals:
+                out[label] = {
+                    "p50": round(_percentile(vals, 0.50), 2),
+                    "p95": round(_percentile(vals, 0.95), 2),
+                    "p99": round(_percentile(vals, 0.99), 2),
+                    "n": len(vals),
+                }
+        return out
+
+    result: dict = {
+        "knobs": {
+            "replica_counts": replica_counts, "qps_ladder": qps_ladder,
+            "level_s": level_s, "slots_per_replica": max_slots,
+            "gen_tokens": gen_tokens, "stream_frac": stream_frac,
+            "shed_slo": shed_slo, "seed": seed,
+        },
+        "by_replicas": {},
+    }
+    sustained: dict[int, float] = {}
+    for n in replica_counts:
+        log(f"phase LOAD: building {n}-replica set ...")
+        engs = get_engines(n)
+        rs = ReplicaSet([PagedGenerationService(eng) for eng in engs])
+        log(f"phase LOAD: warmup ({n} replicas) ...")
+        t0 = time.perf_counter()
+        warm = rs.warmup(max_new_tokens=gen_tokens)
+        log(f"  warmup: {warm['prompts']} prompts, "
+            f"{warm['xla_compiles']} compiles in "
+            f"{time.perf_counter() - t0:.1f}s")
+        get_flight_recorder().clear()
+        set_metrics(MetricsCollector())  # per-count isolation
+        curve = []
+        sustained_n = 0.0
+        for qps in qps_ladder:
+            level = run_level(rs, qps, random.Random(seed))
+            curve.append(level)
+            log(f"phase LOAD: replicas={n} offered={qps} "
+                f"achieved={level['achieved_qps']} "
+                f"shed_rate={level['shed_rate']} "
+                f"e2e_p50={level.get('e2e_ms', {}).get('p50')}ms")
+            if level["shed_rate"] <= shed_slo and level["errors"] == 0:
+                sustained_n = max(sustained_n, level["achieved_qps"])
+        # two-turn session probe: affinity measured END TO END — the second
+        # turn must land on the replica holding turn one's KV and actually
+        # reuse it
+        probe_head = ("affinity probe session head long enough to span "
+                      "multiple sixteen token cache pages comfortably")
+        rs.generate(probe_head + " turn one", max_new_tokens=4,
+                    temperature=0.0, timeout_s=180)
+        hits_before = [s.get("prefix_hit_tokens", 0)
+                       for s in rs.stats()["replicas"]]
+        second = rs.generate(probe_head + " turn two", max_new_tokens=4,
+                             temperature=0.0, timeout_s=180)
+        set_stats = rs.stats()
+        # the replica whose hit counter MOVED between the probe's turns is
+        # the one that actually served turn two (cumulative argmax would
+        # attribute the probe to whichever replica served the most
+        # load-phase session follow-ups)
+        probe_deltas = [
+            s.get("prefix_hit_tokens", 0) - hits_before[i]
+            for i, s in enumerate(set_stats["replicas"])
+        ]
+        result["by_replicas"][str(n)] = {
+            "levels": curve,
+            "sustained_qps_at_slo": sustained_n,
+            "routing": set_stats["routing"],
+            "per_replica_prefix_hit_token_ratio": [
+                s.get("prefix_hit_token_ratio", 0.0)
+                for s in set_stats["replicas"]
+            ],
+            "affinity_probe": {
+                "second_turn_prefix_hit_tokens": second.prefix_hit_tokens,
+                "routed_replica": max(range(n),
+                                      key=lambda i: probe_deltas[i]),
+            },
+        }
+        sustained[n] = sustained_n
+        rs.close()
+    if len(sustained) > 1:
+        lo, hi = min(sustained), max(sustained)
+        if sustained[lo] > 0:
+            result["throughput_ratio"] = {
+                "replicas": [lo, hi],
+                "sustained_qps": [sustained[lo], sustained[hi]],
+                "ratio": round(sustained[hi] / sustained[lo], 3),
+            }
+    set_metrics(MetricsCollector())  # leave a clean collector behind
+    log(f"phase LOAD: sustained {sustained}")
+    return result
+
+
 def phase_d_kernels():
     """Kernel-vs-XLA timings on the real chip: flash attention (prefill
     shape) and the paged decode kernel (page-table walk vs gather). Each
@@ -824,6 +1080,10 @@ def main() -> None:
         if os.environ.get("BENCH_SPECULATIVE") == "1" and not skip_scale
         else None
     )
+    # open-loop multi-replica load harness: LAST, so its collector swaps
+    # cannot disturb the phases above
+    load = phase_load(llm_cfg, new_tokens) \
+        if os.environ.get("BENCH_LOAD") == "1" else None
 
     total_s = time.perf_counter() - t_start
     log(f"bench wall {total_s:.0f}s")
@@ -857,6 +1117,7 @@ def main() -> None:
         **({"kernels": kernels} if kernels else {}),
         **({"longctx": longctx} if longctx else {}),
         **({"speculative": speculative} if speculative else {}),
+        **({"load": load} if load else {}),
         "wall_s": round(total_s, 1),
     }
     print(json.dumps(payload))

@@ -370,6 +370,32 @@ class ServeConfig:
     # graceful-shutdown drain window: in-flight requests get this long to
     # finish after the server stops admitting
     drain_deadline_s: float = 10.0
+    # ---- multi-replica serving tier (runtime/replica.py) ----
+    # number of independent engine+service replicas behind the router;
+    # 1 = today's single-engine behavior. On a mesh, replicas map onto
+    # slices of the dp axis (REPLICAS must divide MESH_DP).
+    replicas: int = 1
+    # radix-affinity stickiness: a prefix-hit replica keeps the request
+    # while its backlog <= stickiness x its slot count; 0 = pure
+    # least-loaded routing
+    affinity_stickiness: float = 4.0
+    # prompt-head tokens the router matches against each replica's radix
+    # cache (longer prefixes still fully reuse inside the replica)
+    route_prefix_tokens: int = 512
+    # per-tenant WFQ: "tenantA:4,tenantB:1" weight overrides; unlisted
+    # tenants get the default weight
+    tenant_weights: str = ""
+    tenant_default_weight: float = 1.0
+    # token-weighted deficit counters: refill rate per unit weight
+    # (0 = quota-only fairness, the deterministic default) and burst cap
+    tenant_refill_tokens_per_s: float = 0.0
+    tenant_burst_tokens: int = 8192
+    # queue slots no single tenant's quota may consume (landing room for
+    # new tenants); <0 = derive max(1, capacity // 8)
+    tenant_headroom: int = -1
+    # batch-priority tier sheds once total pending crosses this fraction
+    # of the set's capacity (interactive may use the full capacity)
+    batch_shed_fraction: float = 0.8
 
     @classmethod
     def from_env(cls) -> "ServeConfig":
@@ -394,7 +420,32 @@ class ServeConfig:
             admission_max_queue=_env_int(["ADMISSION_MAX_QUEUE"], 0),
             crash_retry_budget=_env_int(["CRASH_RETRY_BUDGET"], 1),
             drain_deadline_s=_env_float(["DRAIN_DEADLINE_S"], 10.0),
+            replicas=_env_int(["REPLICAS", "SENTIO_REPLICAS"], 1),
+            affinity_stickiness=_env_float(["AFFINITY_STICKINESS"], 4.0),
+            route_prefix_tokens=_env_int(["ROUTE_PREFIX_TOKENS"], 512),
+            tenant_weights=_env_str(["TENANT_WEIGHTS"], ""),
+            tenant_default_weight=_env_float(["TENANT_DEFAULT_WEIGHT"], 1.0),
+            tenant_refill_tokens_per_s=_env_float(
+                ["TENANT_REFILL_TOKENS_PER_S"], 0.0
+            ),
+            tenant_burst_tokens=_env_int(["TENANT_BURST_TOKENS"], 8192),
+            tenant_headroom=_env_int(["TENANT_HEADROOM"], -1),
+            batch_shed_fraction=_env_float(["BATCH_SHED_FRACTION"], 0.8),
         )
+
+    def parsed_tenant_weights(self) -> dict[str, float]:
+        """``"a:4,b:1"`` → {"a": 4.0, "b": 1.0}; malformed entries skipped."""
+        out: dict[str, float] = {}
+        for part in self.tenant_weights.split(","):
+            part = part.strip()
+            if not part or ":" not in part:
+                continue
+            name, _, raw = part.partition(":")
+            try:
+                out[name.strip()] = float(raw)
+            except ValueError:
+                continue
+        return out
 
 
 @dataclass

@@ -88,6 +88,7 @@ def run_eval(
     from sentio_tpu.ops.verifier import AnswerVerifier
     from sentio_tpu.runtime.engine import GeneratorEngine
     from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+    from sentio_tpu.runtime.replica import ReplicaSet
     from sentio_tpu.runtime.service import PagedGenerationService
 
     t_start = time.perf_counter()
@@ -234,7 +235,10 @@ def run_eval(
                 # full decode+verify cost real tuned models pay
                 ignore_eos=True,
             )
-            service = PagedGenerationService(paged)
+            # the serving tier's front-end, N=1: eval measures the same
+            # routed path production serves (a degenerate single-replica
+            # route is a pass-through, so config outputs stay pinned)
+            service = ReplicaSet([PagedGenerationService(paged)])
             generator = LLMGenerator(
                 provider=TpuProvider(engine=engine, service=service),
                 config=settings.generator,

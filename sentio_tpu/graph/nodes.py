@@ -149,6 +149,10 @@ def create_generator_node(generator, settings: Optional[Settings] = None):
         # caller deadline: rides metadata from the HTTP layer down into the
         # decode service's ticket, so an expired caller's decode is cancelled
         deadline = deadline_ts(state)
+        # WFQ tenant key + priority tier (multi-replica tier): the decode
+        # admission is charged against this tenant's fair-share quota
+        tenant = meta.get("tenant")
+        priority = meta.get("priority")
         t0 = time.perf_counter()
         try:
             # device generation is the longest stage — keep it off the event
@@ -160,6 +164,8 @@ def create_generator_node(generator, settings: Optional[Settings] = None):
                     temperature=temperature if temperature is None else float(temperature),
                     request_id=str(request_id) if request_id else None,
                     deadline_ts=deadline,
+                    tenant=str(tenant) if tenant else None,
+                    priority=str(priority) if priority else None,
                 ),
             )
         except Exception as exc:  # noqa: BLE001

@@ -106,14 +106,20 @@ class FlightRecorder:
             if graph_path:
                 record["graph_path"] = list(graph_path)
 
-    def note_engine_submit(self, request_id: str) -> None:
+    def note_engine_submit(self, request_id: str, **fields: Any) -> None:
         """Mark where this request enters the decode engine: its tick window
-        starts at the NEXT tick the pump records."""
+        starts at the NEXT tick the pump records. Extra fields (e.g. the
+        ``replica_id`` that admission routed to) merge into the engine
+        section; the first admission's values win — the verify node's later
+        admission under the same trace id must not overwrite which replica
+        served the user-facing generation."""
         if not request_id:
             return
         with self._lock:
             engine = self._ensure_locked(request_id).setdefault("engine", {})
             engine.setdefault("tick_first", self._tick_seq)
+            for key, value in fields.items():
+                engine.setdefault(key, value)
 
     def finish_engine(self, request_id: str, **fields: Any) -> None:
         """Close one engine admission for this request and pin the end of

@@ -189,6 +189,26 @@ class MetricsCollector:
             "inflight": Gauge(
                 "sentio_inflight_requests", "requests currently being served", [], registry=r
             ),
+            # multi-replica serving tier (runtime/replica.py): per-tenant
+            # weighted-fair-queueing outcomes and per-replica occupancy /
+            # queue / page-pool gauges — the labels that say WHICH tenant
+            # was shed and WHICH replica is hot. Tenant label cardinality
+            # is bounded by TenantFairQueue.MAX_TRACKED.
+            "tenant_admitted": Counter(
+                "sentio_tpu_tenant_admitted_total",
+                "requests admitted through weighted fair queueing",
+                ["tenant"], registry=r,
+            ),
+            "tenant_shed": Counter(
+                "sentio_tpu_tenant_shed_total",
+                "requests shed by weighted fair queueing",
+                ["tenant", "reason"], registry=r,
+            ),
+            "replica_stat": Gauge(
+                "sentio_tpu_replica_stat",
+                "per-replica decode service point-in-time stats",
+                ["replica", "stat"], registry=r,
+            ),
         }
 
     # ------------------------------------------------------------- recording
@@ -278,6 +298,31 @@ class MetricsCollector:
         self.memory.inc("shed", (reason,), n)
         if self._prom:
             self._prom["shed"].labels(reason).inc(n)
+
+    def record_tenant_admitted(self, tenant: str) -> None:
+        """One request admitted through WFQ for ``tenant``."""
+        if not self.enabled:
+            return
+        self.memory.inc("tenant_admitted", (tenant,))
+        if self._prom:
+            self._prom["tenant_admitted"].labels(tenant).inc()
+
+    def record_tenant_shed(self, tenant: str, reason: str) -> None:
+        """One request shed by WFQ (``reason``: tenant_quota |
+        priority_batch | tenant_deficit)."""
+        if not self.enabled:
+            return
+        self.memory.inc("tenant_shed", (tenant, reason))
+        if self._prom:
+            self._prom["tenant_shed"].labels(tenant, reason).inc()
+
+    def set_replica_stat(self, replica: int, key: str, value: float) -> None:
+        """Publish one point-in-time stat for one serving replica under the
+        replica-labeled gauge and the JSON snapshot."""
+        self.memory.set_gauge(f"replica_{replica}_{key}", (), value)
+        gauge = self._prom.get("replica_stat")
+        if gauge is not None:
+            gauge.labels(replica=str(replica), stat=key).set(value)
 
     def record_breaker(self, name: str, state: str) -> None:
         value = {"closed": 0.0, "half_open": 1.0, "open": 2.0}.get(state, 0.0)

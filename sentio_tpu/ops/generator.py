@@ -81,14 +81,30 @@ class TpuProvider:
     speculative: object = None
     name: str = "tpu"
 
+    def _tenant_kwargs(self, tenant: Optional[str],
+                       priority: Optional[str]) -> dict:
+        """Tenant/priority kwargs, only when the attached service is the
+        multi-replica tier (a bare PagedGenerationService takes neither)."""
+        if not getattr(self.service, "supports_tenants", False):
+            return {}
+        out: dict = {}
+        if tenant is not None:
+            out["tenant"] = tenant
+        if priority is not None:
+            out["priority"] = priority
+        return out
+
     def chat(self, prompt: str, max_new_tokens: int, temperature: float,
              request_id: Optional[str] = None,
-             deadline_ts: Optional[float] = None) -> str:
+             deadline_ts: Optional[float] = None,
+             tenant: Optional[str] = None,
+             priority: Optional[str] = None) -> str:
         if self.service is not None:
             try:
                 result = self.service.generate(
                     prompt, max_new_tokens=max_new_tokens, temperature=temperature,
                     request_id=request_id, deadline_ts=deadline_ts,
+                    **self._tenant_kwargs(tenant, priority),
                 )
                 if result.finish_reason != "error":
                     return result.text
@@ -116,13 +132,16 @@ class TpuProvider:
 
     def stream(self, prompt: str, max_new_tokens: int, temperature: float,
                request_id: Optional[str] = None,
-               deadline_ts: Optional[float] = None) -> Iterator[str]:
+               deadline_ts: Optional[float] = None,
+               tenant: Optional[str] = None,
+               priority: Optional[str] = None) -> Iterator[str]:
         if self.service is not None and hasattr(self.service, "generate_stream"):
             yielded_any = False
             try:
                 for piece in self.service.generate_stream(
                     prompt, max_new_tokens=max_new_tokens, temperature=temperature,
                     request_id=request_id, deadline_ts=deadline_ts,
+                    **self._tenant_kwargs(tenant, priority),
                 ):
                     yielded_any = True
                     yield piece
@@ -466,14 +485,21 @@ class LLMGenerator:
     def _trace_kwargs(
         self, method: str, request_id: Optional[str],
         deadline_ts: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
     ) -> dict:
         """The optional per-request context kwargs (trace id, absolute
-        deadline) the provider's method is able to receive."""
+        deadline, WFQ tenant key + priority tier) the provider's method is
+        able to receive."""
         out: dict = {}
         if request_id and self._method_accepts(method, "request_id"):
             out["request_id"] = request_id
         if deadline_ts is not None and self._method_accepts(method, "deadline_ts"):
             out["deadline_ts"] = deadline_ts
+        if tenant is not None and self._method_accepts(method, "tenant"):
+            out["tenant"] = tenant
+        if priority is not None and self._method_accepts(method, "priority"):
+            out["priority"] = priority
         return out
 
     def generate(
@@ -485,6 +511,8 @@ class LLMGenerator:
         max_new_tokens: Optional[int] = None,
         request_id: Optional[str] = None,
         deadline_ts: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
     ) -> str:
         prompt = self.build_prompt(query, documents)
         temp = temperature if temperature is not None else self.config.temperature(mode)
@@ -492,7 +520,8 @@ class LLMGenerator:
             prompt,
             max_new_tokens=max_new_tokens or self.config.max_new_tokens,
             temperature=temp,
-            **self._trace_kwargs("chat", request_id, deadline_ts),
+            **self._trace_kwargs("chat", request_id, deadline_ts,
+                                 tenant, priority),
         )
 
     def stream(
@@ -504,6 +533,8 @@ class LLMGenerator:
         max_new_tokens: Optional[int] = None,
         request_id: Optional[str] = None,
         deadline_ts: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
     ) -> Iterator[str]:
         prompt = self.build_prompt(query, documents)
         temp = temperature if temperature is not None else self.config.temperature(mode)
@@ -511,7 +542,8 @@ class LLMGenerator:
             prompt,
             max_new_tokens=max_new_tokens or self.config.max_new_tokens,
             temperature=temp,
-            **self._trace_kwargs("stream", request_id, deadline_ts),
+            **self._trace_kwargs("stream", request_id, deadline_ts,
+                                 tenant, priority),
         )
 
     def chat_raw(self, prompt: str, max_new_tokens: int, temperature: float,

@@ -142,6 +142,30 @@ def build_mesh(
     return mesh
 
 
+def split_mesh_dp(mesh: Mesh, n: int) -> list[Mesh]:
+    """Split ``mesh`` into ``n`` submeshes along the ``dp`` axis — one per
+    data-parallel serving replica (runtime/replica.py). Each submesh keeps
+    every other axis intact (tp/sp/pp/ep collectives stay inside a replica's
+    device slice; no collective ever crosses replicas), so a replica engine
+    built on a submesh shards its weights and KV pool exactly as it would on
+    a whole mesh of that geometry. ``dp`` must divide evenly — a ragged
+    split would give replicas different batch multiples and make routing
+    load math meaningless."""
+    if n <= 0:
+        raise MeshError(f"cannot split a mesh into {n} replicas")
+    if n == 1:
+        return [mesh]
+    dp = mesh.shape[AXIS_DP]
+    if dp % n != 0:
+        raise MeshError(
+            f"mesh dp={dp} not divisible by {n} replicas — set MESH_DP to a "
+            f"multiple of REPLICAS (or REPLICAS to a divisor of dp)"
+        )
+    axis = MESH_AXES.index(AXIS_DP)
+    return [Mesh(chunk, MESH_AXES)
+            for chunk in np.split(np.asarray(mesh.devices), n, axis=axis)]
+
+
 def data_axes(mesh: Mesh) -> tuple[str, ...]:
     """Axes a request batch is sharded over (all data-like axes)."""
     return tuple(a for a in (AXIS_DCN, AXIS_DP) if mesh.shape[a] > 1) or (AXIS_DP,)

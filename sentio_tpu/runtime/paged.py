@@ -974,6 +974,25 @@ class ContinuousBatchingEngine:
             self.allocator.free(list(leftover))
         return full
 
+    def peek_prefix(self, tok_ids: Sequence[int]) -> int:
+        """Read-only routing probe: how many leading tokens of ``tok_ids``
+        this engine's radix cache could serve from cached KV, clamped the
+        same way admission clamps a real match (at least one suffix token
+        must remain to prefill). Takes no refcounts, touches no LRU state,
+        and — alone among engine methods — is safe to call from a non-driver
+        thread: the result is an affinity HINT for the replica router, so a
+        stale read during a concurrent insert/evict merely routes one
+        request suboptimally. No ``_san.enter`` for the same reason: the
+        single-driver contract guards mutation, and this mutates nothing."""
+        if self._radix is None or not tok_ids:
+            return 0
+        try:
+            matched = self._radix.peek_prefix(tok_ids)
+        except Exception:  # noqa: BLE001 — torn concurrent read: no hint
+            return 0
+        max_shared = ((len(tok_ids) - 1) // self.page_size) * self.page_size
+        return max(min(matched, max_shared), 0)
+
     def cancel(self, request_id: int) -> bool:
         """Abandon a request: queued → dropped; decoding → slot retired and
         pages freed (the tokens so far are discarded). Must be called by the

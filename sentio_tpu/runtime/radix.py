@@ -120,6 +120,39 @@ class RadixPrefixCache:
             walk = walk.parent
         return pos, pages, (node if node is not self.root else None)
 
+    def peek_prefix(self, tokens: Sequence[int]) -> int:
+        """Length (in tokens) of the longest page-aligned prefix of
+        ``tokens`` this tree holds, WITHOUT taking refcounts or touching
+        LRU clocks — the read-only routing probe the multi-replica router
+        calls to pick the replica already holding a session's KV.
+
+        Unlike every other method, this one MAY be called from a thread
+        that is not the engine driver: it only reads (dict ``.get``, list
+        slices — each GIL-atomic), never mutates, and its result is an
+        advisory hint, not a correctness input. A concurrent insert/split/
+        evict on the driver thread can at worst make the count stale by a
+        few pages, which costs a slightly suboptimal routing choice."""
+        page = self.page_size
+        node = self.root
+        pos = 0
+        while pos + page <= len(tokens):
+            child = node.children.get(tuple(tokens[pos : pos + page]))
+            if child is None:
+                break
+            j = 1
+            edge_pages = len(child.pages)
+            while j < edge_pages:
+                lo = pos + j * page
+                if lo + page > len(tokens) or \
+                        child.tokens[j * page : (j + 1) * page] != list(tokens[lo : lo + page]):
+                    break
+                j += 1
+            pos += j * page
+            if j < edge_pages:
+                break
+            node = child
+        return pos
+
     # ----------------------------------------------------------------- writes
 
     def insert(self, tokens: Sequence[int], start: int, pages: Sequence[int],

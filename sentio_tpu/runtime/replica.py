@@ -1,0 +1,666 @@
+"""Multi-replica serving tier: radix-affinity routing + weighted fair queueing.
+
+One ``PagedGenerationService`` is the hard throughput ceiling no matter how
+fast a tick is — one pump thread, one engine, one page pool. This module
+scales the serving path out data-parallel, following the continuous-batching
+replica model of Orca (Yu et al., OSDI '22) and the prefix-affinity
+scheduling idea of SGLang's RadixAttention (Zheng et al., 2024):
+
+* a :class:`ReplicaSet` owns N fully independent engine+service replicas
+  (private page pool, radix tree, and pump thread each — replicas share
+  only the immutable weights and tokenizer). On real hardware each replica
+  maps onto a slice of the mesh's ``dp`` axis
+  (:func:`sentio_tpu.parallel.mesh.split_mesh_dp`); in-process CPU replicas
+  are the N=1-compatible first rung.
+* **two-stage routing** — (1) *radix-prefix affinity*: the router tokenizes
+  the prompt head and asks every replica's radix cache, via the read-only
+  ``peek_prefix`` probe, for its longest cached prefix; the best hit wins
+  unless that replica's backlog exceeds a stickiness bound, because a
+  session's follow-up landing on the replica that already holds its KV
+  turns a cross-replica cache miss into a suffix-only prefill. (2)
+  *least-loaded* by projected wait (each replica's TTFT-EMA scaled by its
+  backlog — the same estimate admission control uses against deadlines).
+* **weighted fair queueing** — in front of the replicas, the single global
+  FIFO admission bound generalizes to per-tenant fairness
+  (:class:`TenantFairQueue`): requests carry a tenant key (auth principal
+  or ``X-Tenant`` header; default one shared tenant), each tenant gets a
+  weight-proportional quota of the set's total queue capacity (with a
+  reserved headroom so a flooding tenant can never consume the last slots
+  a new tenant's first request needs), optional token-weighted deficit
+  counters rate-limit contended tenants DRR-style, and a ``batch``
+  priority tier sheds earlier than ``interactive`` under load. Overload
+  answers stay typed ``ServiceOverloaded`` → 429/503 + Retry-After, now
+  per tenant.
+
+The set exposes the same ``generate / generate_stream / check_admission /
+warmup / drain / stats / close`` surface as one service, so the serving
+container, graph nodes, and eval swap only the constructor. N=1 with the
+default single tenant degenerates to (almost) today's behavior — the one
+deliberate difference is the WFQ headroom, which sheds a lone flooding
+tenant slightly before the absolute queue bound so fairness is available
+the instant a second tenant shows up.
+
+Threading: routing probes (``peek_prefix``, ``backlog``, ``projected_wait``)
+are advisory reads against live replicas; all ReplicaSet/TenantFairQueue
+mutable state sits behind one mutex held only for quick bookkeeping — never
+across a generate call or a device tick.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Iterator, Optional, Sequence
+
+from sentio_tpu.analysis.sanitizer import assert_held, make_lock
+from sentio_tpu.infra.exceptions import ServiceOverloaded
+from sentio_tpu.infra.metrics import get_metrics
+from sentio_tpu.runtime.service import PagedGenerationService
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ReplicaSet",
+    "TenantFairQueue",
+    "DEFAULT_TENANT",
+    "PRIORITY_INTERACTIVE",
+    "PRIORITY_BATCH",
+]
+
+DEFAULT_TENANT = "shared"
+PRIORITY_INTERACTIVE = "interactive"
+PRIORITY_BATCH = "batch"
+
+
+@dataclass
+class _TenantState:
+    """Book-keeping for one tenant. All fields guarded by the queue's
+    mutex (the dataclass itself never escapes the lock)."""
+
+    weight: float = 1.0
+    pending: int = 0          # requests admitted and not yet released
+    deficit: float = 0.0      # DRR token credit (refill-rate mode only)
+    last_refill: float = 0.0  # perf_counter of the last deficit refill
+    admitted: int = 0
+    shed: int = 0
+    tokens: int = 0           # actual tokens consumed (prompt + generated)
+
+
+class TenantFairQueue:
+    """Weighted fair admission across tenants over a shared queue capacity.
+
+    Three independent rules, every rejection typed and counted per tenant:
+
+    * **quota** — tenant ``t`` may hold at most
+      ``max(min_quota, (capacity - headroom) * w_t / Σ w_active)`` pending
+      requests, where the active set is every tenant with pending work plus
+      the requester. With one active tenant the quota is the whole capacity
+      minus the reserved headroom — the slack that guarantees a second
+      tenant's FIRST request always finds room (without it, a flood fills
+      every replica inbox and fairness can never begin).
+    * **deficit** (off by default, ``refill_tokens_per_s > 0`` arms it) —
+      token-weighted deficit-round-robin: each tenant's credit refills at
+      ``rate x weight`` tokens/s (capped at ``burst x weight``), admission
+      under contention (other tenants have pending work) requires a
+      non-negative credit, and each admission debits its token cost
+      (corrected to actual consumption at release). A lone tenant is never
+      deficit-limited — idle capacity is not rationed.
+    * **priority tiers** — ``batch`` requests shed once total pending
+      crosses ``batch_shed_fraction x capacity``; ``interactive`` requests
+      may use the full capacity. Two tiers, shed-earlier semantics: batch
+      traffic yields headroom to interactive traffic under load.
+    """
+
+    # label-cardinality bound for /metrics: beyond this many distinct
+    # tenant keys, new ones share one overflow bucket (a client minting
+    # random tenant headers must not grow the metric space unboundedly)
+    MAX_TRACKED = 256
+    OVERFLOW_TENANT = "overflow"
+
+    def __init__(
+        self,
+        capacity: int,
+        weights: Optional[dict[str, float]] = None,
+        default_weight: float = 1.0,
+        refill_tokens_per_s: float = 0.0,
+        burst_tokens: int = 8192,
+        batch_shed_fraction: float = 0.8,
+        headroom: Optional[int] = None,
+        min_quota: int = 1,
+    ) -> None:
+        self.capacity = max(int(capacity), 1)
+        self.default_weight = max(float(default_weight), 1e-3)
+        self.refill_tokens_per_s = max(float(refill_tokens_per_s), 0.0)
+        self.burst_tokens = max(int(burst_tokens), 1)
+        self.batch_shed_fraction = min(max(float(batch_shed_fraction), 0.0), 1.0)
+        self.min_quota = max(int(min_quota), 1)
+        # reserved slack no single tenant's quota may consume: the landing
+        # room for a tenant the system has not seen yet
+        self.headroom = (
+            int(headroom) if headroom is not None
+            else max(1, self.capacity // 8)
+        )
+        self.headroom = min(self.headroom, self.capacity - 1)
+        self._weights = dict(weights or {})
+        self._mutex = make_lock("TenantFairQueue._mutex")
+        self._tenants: dict[str, _TenantState] = {}  # guarded-by: _mutex
+
+    # ------------------------------------------------------------- internal
+
+    def _state_locked(self, tenant: str) -> tuple[str, _TenantState]:  # lock-held: _mutex
+        assert_held(self._mutex)
+        if tenant not in self._tenants and len(self._tenants) >= self.MAX_TRACKED:
+            tenant = self.OVERFLOW_TENANT
+        state = self._tenants.get(tenant)
+        if state is None:
+            state = _TenantState(
+                weight=max(self._weights.get(tenant, self.default_weight), 1e-3),
+            )
+            if self.refill_tokens_per_s > 0:
+                state.deficit = self.burst_tokens * state.weight
+                state.last_refill = time.perf_counter()
+            self._tenants[tenant] = state
+        return tenant, state
+
+    def _refill_locked(self, state: _TenantState, now: float) -> None:  # lock-held: _mutex
+        assert_held(self._mutex)
+        if self.refill_tokens_per_s <= 0:
+            return
+        dt = max(now - state.last_refill, 0.0)
+        state.last_refill = now
+        state.deficit = min(
+            state.deficit + self.refill_tokens_per_s * state.weight * dt,
+            self.burst_tokens * state.weight,
+        )
+
+    def _quota_locked(self, tenant: str, state: _TenantState) -> int:  # lock-held: _mutex
+        assert_held(self._mutex)
+        active_weight = state.weight if state.pending == 0 else 0.0
+        for other in self._tenants.values():
+            if other.pending > 0:
+                active_weight += other.weight
+        share = (self.capacity - self.headroom) * state.weight \
+            / max(active_weight, state.weight)
+        return max(self.min_quota, int(share))
+
+    def _shed_locked(self, tenant: str, state: _TenantState, reason: str,
+                     message: str, status: int,
+                     retry_after_s: float) -> None:  # lock-held: _mutex
+        assert_held(self._mutex)
+        state.shed += 1
+        metrics = get_metrics()
+        metrics.record_shed(reason)
+        metrics.record_tenant_shed(tenant, reason)
+        raise ServiceOverloaded(
+            message, status=status, retry_after_s=retry_after_s,
+            details={"tenant": tenant, "shed_reason": reason},
+        )
+
+    # --------------------------------------------------------------- public
+
+    def admit(self, tenant: str, cost_tokens: int,
+              priority: str = PRIORITY_INTERACTIVE,
+              reserve: bool = True) -> str:
+        """Admit (or, with ``reserve=False``, merely test) one request for
+        ``tenant`` with an estimated token cost. Raises a typed
+        :class:`ServiceOverloaded` carrying the tenant and shed reason;
+        returns the (possibly overflow-bucketed) tenant key actually
+        charged, which MUST be passed back to :meth:`release`."""
+        now = time.perf_counter()
+        with self._mutex:
+            tenant, state = self._state_locked(tenant)
+            self._refill_locked(state, now)
+            total_pending = sum(s.pending for s in self._tenants.values())
+            quota = self._quota_locked(tenant, state)
+            if state.pending >= quota:
+                self._shed_locked(
+                    tenant, state, "tenant_quota",
+                    f"tenant {tenant!r} is at its fair-share quota "
+                    f"({state.pending}/{quota} of {self.capacity} total)",
+                    status=429, retry_after_s=1.0,
+                )
+            if priority == PRIORITY_BATCH and total_pending + 1 > \
+                    self.batch_shed_fraction * self.capacity:
+                self._shed_locked(
+                    tenant, state, "priority_batch",
+                    f"batch-tier request shed at {total_pending}/"
+                    f"{self.capacity} pending (batch yields to interactive)",
+                    status=503, retry_after_s=2.0,
+                )
+            contended = total_pending - state.pending > 0
+            if self.refill_tokens_per_s > 0 and contended and state.deficit < 0:
+                wait = -state.deficit / (
+                    self.refill_tokens_per_s * state.weight
+                )
+                self._shed_locked(
+                    tenant, state, "tenant_deficit",
+                    f"tenant {tenant!r} exhausted its token deficit "
+                    f"({state.deficit:.0f}); refilling at "
+                    f"{self.refill_tokens_per_s * state.weight:.0f} tok/s",
+                    status=429, retry_after_s=max(wait, 0.5),
+                )
+            if reserve:
+                state.pending += 1
+                state.admitted += 1
+                if self.refill_tokens_per_s > 0:
+                    state.deficit -= max(int(cost_tokens), 0)
+                get_metrics().record_tenant_admitted(tenant)
+            return tenant
+
+    def release(self, tenant: str, cost_tokens: int,
+                actual_tokens: Optional[int] = None) -> None:
+        """Return one admission. ``actual_tokens`` (when known) corrects the
+        estimated debit, so deficits track real consumption — a request that
+        stopped early gets its unspent credit back."""
+        with self._mutex:
+            state = self._tenants.get(tenant)
+            if state is None:
+                return
+            state.pending = max(state.pending - 1, 0)
+            if actual_tokens is not None:
+                state.tokens += int(actual_tokens)
+                if self.refill_tokens_per_s > 0:
+                    state.deficit += max(int(cost_tokens), 0) - max(
+                        int(actual_tokens), 0
+                    )
+
+    def stats(self) -> dict:
+        with self._mutex:
+            return {
+                "capacity": self.capacity,
+                "headroom": self.headroom,
+                "refill_tokens_per_s": self.refill_tokens_per_s,
+                "per_tenant": {
+                    name: {
+                        "weight": state.weight,
+                        "pending": state.pending,
+                        "admitted": state.admitted,
+                        "shed": state.shed,
+                        "tokens": state.tokens,
+                        **({"deficit": round(state.deficit, 1)}
+                           if self.refill_tokens_per_s > 0 else {}),
+                    }
+                    for name, state in self._tenants.items()
+                },
+            }
+
+
+class ReplicaSet:
+    """Front-end over N independent paged-decode replicas: WFQ admission →
+    radix-affinity / least-loaded routing → delegate to the chosen
+    replica's :class:`PagedGenerationService`. Same call surface as one
+    service; N=1 degenerates to a thin pass-through."""
+
+    # duck-typing flag callers use to decide whether tenant/priority kwargs
+    # are understood (a bare PagedGenerationService or a test fake is not)
+    supports_tenants = True
+
+    def __init__(
+        self,
+        services: Sequence[PagedGenerationService],
+        tenant_weights: Optional[dict[str, float]] = None,
+        tenant_default_weight: float = 1.0,
+        tenant_refill_tokens_per_s: float = 0.0,
+        tenant_burst_tokens: int = 8192,
+        tenant_headroom: Optional[int] = None,
+        batch_shed_fraction: float = 0.8,
+        affinity_stickiness: float = 4.0,
+        route_prefix_tokens: int = 512,
+    ) -> None:
+        services = list(services)
+        if not services:
+            raise ValueError("ReplicaSet needs at least one replica")
+        self._check_isolation(services)
+        self._services = services
+        for i, svc in enumerate(services):
+            svc.replica_id = i
+            guard = getattr(svc.engine, "_san", None)
+            if guard is not None:
+                # per-replica pump ownership: sanitizer errors must name
+                # WHICH replica's engine a stray thread touched
+                guard.name = f"ContinuousBatchingEngine[r{i}]"
+        self.tokenizer = services[0].engine.tokenizer
+        # route on at most this many prompt-head tokens: prefixes longer
+        # than this are indistinguishable to the router but not to the
+        # replica's radix cache, which still reuses the full match
+        self.route_prefix_tokens = max(int(route_prefix_tokens),
+                                       services[0].engine.page_size)
+        # a prefix-hit replica keeps the request only while its backlog is
+        # within stickiness x its slot count; past that, cache reuse costs
+        # more queueing delay than the suffix prefill it saves
+        self.affinity_stickiness = max(float(affinity_stickiness), 0.0)
+        self.tenants = TenantFairQueue(
+            capacity=sum(svc.max_queue for svc in services),
+            weights=tenant_weights,
+            default_weight=tenant_default_weight,
+            refill_tokens_per_s=tenant_refill_tokens_per_s,
+            burst_tokens=tenant_burst_tokens,
+            batch_shed_fraction=batch_shed_fraction,
+            headroom=tenant_headroom,
+        )
+        self._mutex = make_lock("ReplicaSet._mutex")
+        # routing outcome counters (telemetry only)
+        self._routed_affinity = 0  # guarded-by: _mutex
+        self._routed_load = 0  # guarded-by: _mutex
+        self._affinity_overflow = 0  # guarded-by: _mutex
+
+    @staticmethod
+    def _check_isolation(services: Sequence[PagedGenerationService]) -> None:
+        """Replicas must not share mutable decode state: a shared engine,
+        allocator, pool, or radix tree would be mutated by two pump threads
+        at once (immutable weights/tokenizer sharing is the point)."""
+        seen: dict[int, tuple[int, str]] = {}
+        for i, svc in enumerate(services):
+            eng = svc.engine
+            parts = {
+                "service": svc,
+                "engine": eng,
+                "allocator": getattr(eng, "allocator", None),
+                "pool": getattr(eng, "pool", None),
+                "radix": getattr(eng, "_radix", None),
+            }
+            for what, obj in parts.items():
+                if obj is None:
+                    continue
+                prior = seen.get(id(obj))
+                if prior is not None:
+                    raise ValueError(
+                        f"replica {i} shares its {what} with replica "
+                        f"{prior[0]}'s {prior[1]} — replicas must own "
+                        f"private decode state"
+                    )
+                seen[id(obj)] = (i, what)
+
+    # -------------------------------------------------------------- routing
+
+    @property
+    def replicas(self) -> int:
+        return len(self._services)
+
+    def _route_tokens(self, prompt: str) -> list[int]:
+        # chars bound the token count for every tokenizer in the tree (byte
+        # tokenizer is 1:1; BPE merges only shrink), so slicing chars first
+        # keeps the encode cost flat for very long prompts
+        head = prompt[: self.route_prefix_tokens * 4]
+        try:
+            toks = self.tokenizer.encode(head, add_bos=True)
+        except Exception:  # noqa: BLE001 — routing must never fail a request
+            return []
+        return list(toks[: self.route_prefix_tokens])
+
+    def _route(self, toks: Sequence[int], count: bool = True) -> tuple[int, int]:
+        """→ (replica index, predicted prefix-hit tokens). Stage 1: best
+        ``peek_prefix`` hit, sticky while that replica's backlog stays under
+        ``stickiness x max_slots``. Stage 2: least projected wait.
+        ``count=False`` for probes (check_admission): the SSE pre-check
+        routes the same request a second time and must not double-count the
+        routing-outcome telemetry."""
+        best_i, best_hit = -1, 0
+        if len(self._services) > 1 and toks:
+            for i, svc in enumerate(self._services):
+                hit = svc.engine.peek_prefix(toks)
+                if hit > best_hit:
+                    best_i, best_hit = i, hit
+        if best_hit > 0:
+            svc = self._services[best_i]
+            bound = self.affinity_stickiness * max(svc.engine.max_slots, 1)
+            if svc.backlog() <= bound:
+                if count:
+                    with self._mutex:
+                        self._routed_affinity += 1
+                return best_i, best_hit
+            if count:
+                with self._mutex:
+                    self._affinity_overflow += 1
+
+        def load_key(pair):
+            i, svc = pair
+            return (svc.projected_wait() or 0.0, svc.backlog(), i)
+
+        idx = min(enumerate(self._services), key=load_key)[0]
+        if count:
+            with self._mutex:
+                self._routed_load += 1
+        return idx, 0
+
+    # ------------------------------------------------------------------ api
+
+    def generate(
+        self,
+        prompt: str,
+        max_new_tokens: int = 64,
+        temperature: float = 0.0,
+        timeout_s: Optional[float] = None,
+        request_id: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+        deadline_ts: Optional[float] = None,
+        top_k: int = 0,
+        tenant: Optional[str] = None,
+        priority: str = PRIORITY_INTERACTIVE,
+    ):
+        toks = self._route_tokens(prompt)
+        cost = len(toks) + max_new_tokens
+        charged = self.tenants.admit(tenant or DEFAULT_TENANT, cost,
+                                     priority=priority)
+        try:
+            idx, _hit = self._route(toks)
+            result = self._services[idx].generate(
+                prompt, max_new_tokens=max_new_tokens,
+                temperature=temperature, timeout_s=timeout_s,
+                request_id=request_id, deadline_s=deadline_s,
+                deadline_ts=deadline_ts, top_k=top_k,
+            )
+        except BaseException:
+            # failed before (shed) or during decode: refund the estimated
+            # debit — charging full cost for work that never ran would let
+            # replica-level sheds drain an innocent tenant's deficit
+            self.tenants.release(charged, cost, actual_tokens=0)
+            raise
+        self.tenants.release(
+            charged, cost,
+            actual_tokens=result.prompt_tokens + len(result.tokens),
+        )
+        return result
+
+    def generate_stream(
+        self,
+        prompt: str,
+        max_new_tokens: int = 64,
+        temperature: float = 0.0,
+        timeout_s: Optional[float] = None,
+        request_id: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+        deadline_ts: Optional[float] = None,
+        top_k: int = 0,
+        tenant: Optional[str] = None,
+        priority: str = PRIORITY_INTERACTIVE,
+    ) -> Iterator[str]:
+        toks = self._route_tokens(prompt)
+        idx, _hit = self._route(toks)
+        # the replica's own generate_stream runs its CALL-time validation
+        # (top_k vs paged speculation) here, before any SSE 200 commits;
+        # its admission — and our tenant reservation — stay deferred to the
+        # first next(), the long-standing stream contract
+        inner = self._services[idx].generate_stream(
+            prompt, max_new_tokens=max_new_tokens, temperature=temperature,
+            timeout_s=timeout_s, request_id=request_id,
+            deadline_s=deadline_s, deadline_ts=deadline_ts, top_k=top_k,
+        )
+        return self._stream_impl(inner, tenant or DEFAULT_TENANT,
+                                 len(toks) + max_new_tokens, priority)
+
+    def _stream_impl(self, inner: Iterator[str], tenant: str, cost: int,
+                     priority: str) -> Iterator[str]:
+        charged = self.tenants.admit(tenant, cost, priority=priority)
+        try:
+            yield from inner
+        finally:
+            # streams release at close/exhaust/error with the estimate —
+            # the exact split is not worth holding the reservation open for
+            self.tenants.release(charged, cost)
+
+    def check_admission(
+        self,
+        deadline_ts: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: str = PRIORITY_INTERACTIVE,
+        prompt: Optional[str] = None,
+    ) -> None:
+        """Raise what a submit right now would raise, WITHOUT reserving:
+        WFQ tenant check first (peek mode), then the target replica's own
+        admission check. With a ``prompt`` the probe routes exactly as the
+        submit will; without one it checks the least-loaded replica (if
+        that one sheds, every routing choice would)."""
+        self.tenants.admit(tenant or DEFAULT_TENANT, 0, priority=priority,
+                           reserve=False)
+        toks = self._route_tokens(prompt) if prompt else []
+        idx, _hit = self._route(toks, count=False)
+        self._services[idx].check_admission(deadline_ts)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def warmup(self, max_new_tokens: int = 4) -> dict:
+        """Warm EVERY replica CONCURRENTLY (each compiles its own jit
+        variants over its own pool/mesh slice, so serial warmup would
+        multiply startup by N) before the compile fence arms — serve
+        startup arms the fence only after this returns, i.e. after all
+        replicas report. A failed replica warmup re-raises: arming the
+        fence over an unwarmed replica would fail its first real request."""
+        results: list = [None] * len(self._services)
+        errors: list = []
+
+        def _warm(i: int, svc: PagedGenerationService) -> None:
+            try:
+                results[i] = svc.warmup(max_new_tokens=max_new_tokens)
+            except Exception as exc:  # noqa: BLE001 — re-raised below
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=_warm, args=(i, svc),
+                             name=f"replica-warmup-{i}", daemon=True)
+            for i, svc in enumerate(self._services)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return {
+            "prompts": sum(r.get("prompts", 0) for r in results),
+            "xla_compiles": sum(r.get("xla_compiles", 0) for r in results),
+            "replicas": len(self._services),
+        }
+
+    def drain(self, deadline_s: float = 30.0) -> dict:
+        """Drain all replicas CONCURRENTLY: each gets the same wall-clock
+        window (draining serially would give replica k only the deadline
+        minus its predecessors' spend). Aggregates drained/abandoned."""
+        results: list[Optional[dict]] = [None] * len(self._services)
+
+        def _drain(i: int, svc: PagedGenerationService) -> None:
+            try:
+                results[i] = svc.drain(deadline_s)
+            except Exception:  # noqa: BLE001 — drain is best-effort
+                logger.warning("replica %d drain failed", i, exc_info=True)
+
+        threads = [
+            threading.Thread(target=_drain, args=(i, svc),
+                             name=f"replica-drain-{i}", daemon=True)
+            for i, svc in enumerate(self._services)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            # each replica's drain bounds itself by deadline_s; the grace
+            # covers close()'s pump join, not extra drain time
+            t.join(timeout=deadline_s + 15.0)
+        per = []
+        for i, (svc, res) in enumerate(zip(self._services, results)):
+            if res is None:
+                res = {"drained": False, "abandoned": svc.backlog()}
+            per.append({"replica": i, **res})
+        return {
+            "drained": all(r["drained"] for r in per),
+            "abandoned": sum(r.get("abandoned", 0) for r in per),
+            "replicas": per,
+        }
+
+    def close(self) -> None:
+        for svc in self._services:
+            try:
+                svc.close()
+            except Exception:  # noqa: BLE001 — close every replica regardless
+                logger.warning("replica %d close failed", svc.replica_id,
+                               exc_info=True)
+
+    # ---------------------------------------------------------------- stats
+
+    _SUM_KEYS = (
+        "active_slots", "max_slots", "queued", "free_pages", "total_pages",
+        "pool_hbm_bytes", "head_skips", "ttft_count", "prefill_tokens",
+        "decode_tokens", "prefix_hits", "prefix_misses", "prefix_hit_tokens",
+        "prefix_miss_tokens", "prefix_cache_pages", "prefix_cache_nodes",
+        "queued_inbox", "ticks", "completed", "max_queue", "shed", "expired",
+        "cancelled", "requeued", "tick_failures", "pump_leaked",
+        "spec_verifies", "spec_emitted",
+    )
+    _MAX_KEYS = ("max_active_slots", "draining")
+
+    def stats(self) -> dict:
+        """Aggregate + per-replica stats. Counters SUM over replicas exactly
+        once each (every per-replica total appears in exactly one replica's
+        stats, so the sum cannot double-count — the leaked-pump audit relies
+        on this); high-water marks take the max; percentile-ish telemetry
+        (ttft_p50/p95, avg occupancy) is weighted by each replica's sample
+        count and labeled by construction as an approximation."""
+        per = []
+        agg: dict = {}
+        for svc in self._services:
+            s = svc.stats()
+            per.append(s)
+            for key in self._SUM_KEYS:
+                if key in s:
+                    agg[key] = agg.get(key, 0) + s[key]
+            for key in self._MAX_KEYS:
+                if key in s:
+                    agg[key] = max(agg.get(key, 0), s[key])
+        ticks = agg.get("ticks", 0)
+        if ticks:
+            agg["avg_active_slots"] = round(
+                sum(s.get("avg_active_slots", 0.0) * s.get("ticks", 0)
+                    for s in per) / ticks, 3,
+            )
+        else:
+            agg["avg_active_slots"] = 0.0
+        hit = agg.get("prefix_hit_tokens", 0)
+        miss = agg.get("prefix_miss_tokens", 0)
+        if hit + miss:
+            agg["prefix_hit_token_ratio"] = round(hit / (hit + miss), 4)
+        ttft_n = sum(s.get("ttft_count", 0) for s in per
+                     if "ttft_p50_ms" in s)
+        if ttft_n:
+            for key in ("ttft_p50_ms", "ttft_p95_ms"):
+                agg[key] = round(
+                    sum(s[key] * s.get("ttft_count", 0) for s in per
+                        if key in s) / ttft_n, 2,
+                )
+        spec_v = agg.get("spec_verifies", 0)
+        if spec_v:
+            agg["spec_tokens_per_verify"] = round(
+                agg.get("spec_emitted", 0) / spec_v, 2)
+        first = per[0]
+        agg["page_size"] = first.get("page_size")
+        agg["kv_quant"] = first.get("kv_quant")
+        agg["n_replicas"] = len(per)
+        agg["replicas"] = per
+        with self._mutex:
+            agg["routing"] = {
+                "affinity": self._routed_affinity,
+                "least_loaded": self._routed_load,
+                "affinity_overflow": self._affinity_overflow,
+            }
+        agg["tenants"] = self.tenants.stats()
+        return agg

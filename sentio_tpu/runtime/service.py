@@ -129,9 +129,14 @@ class PagedGenerationService:
         max_queue: Optional[int] = None,
         default_deadline_s: Optional[float] = None,
         retry_budget: int = 1,
+        replica_id: int = 0,
     ) -> None:
         self.engine = engine
         self.default_timeout_s = default_timeout_s
+        # position of this service in a ReplicaSet (runtime/replica.py) —
+        # stamped onto flight-recorder tick events and engine records so
+        # per-replica behavior is attributable; 0 for a standalone service
+        self.replica_id = int(replica_id)
         # admission bound on waiting work (inbox + admitted, not yet done);
         # a submit past it sheds with 429 instead of queueing unboundedly.
         # The default is deliberately deep (8x slot depth): shedding is tail
@@ -203,7 +208,8 @@ class PagedGenerationService:
                          deadline_ts=deadline_ts,
                          retries_left=self.retry_budget)
         if request_id:
-            get_flight_recorder().note_engine_submit(request_id)
+            get_flight_recorder().note_engine_submit(
+                request_id, replica_id=self.replica_id)
         try:
             with self._mutex:
                 self._admit_ticket_locked(ticket)
@@ -290,7 +296,8 @@ class PagedGenerationService:
                          deadline_ts=deadline_ts,
                          retries_left=self.retry_budget)
         if request_id:
-            get_flight_recorder().note_engine_submit(request_id)
+            get_flight_recorder().note_engine_submit(
+                request_id, replica_id=self.replica_id)
         try:
             with self._mutex:
                 self._admit_ticket_locked(ticket)
@@ -391,6 +398,21 @@ class PagedGenerationService:
         if deadline_ts is not None:
             wait = min(wait, max(deadline_ts - time.perf_counter(), 0.0) + 5.0)
         return wait
+
+    def backlog(self) -> int:
+        """Requests waiting on this replica (inbox + admitted, not yet
+        done) — the router's load signal."""
+        with self._mutex:
+            return len(self._inbox) + len(self._tickets)
+
+    def projected_wait(self) -> Optional[float]:
+        """Projected first-token wait for a request submitted NOW (TTFT-EMA
+        scaled by backlog; None while cold) — the router's least-loaded
+        key, the same estimate admission control weighs against deadlines."""
+        with self._mutex:
+            return self._projected_wait_locked(
+                len(self._inbox) + len(self._tickets)
+            )
 
     def check_admission(self, deadline_ts: Optional[float] = None) -> None:
         """Raise the shed error a submit right now would raise, WITHOUT
@@ -516,6 +538,7 @@ class PagedGenerationService:
         with self._mutex:
             return {
                 **engine_stats,
+                "replica": self.replica_id,
                 "queued_inbox": len(self._inbox),
                 "ticks": self._ticks,
                 "completed": self._completed,
@@ -876,6 +899,7 @@ class PagedGenerationService:
                 last_compiles = compiles_now
                 recorder.record_tick(
                     **compile_fields,
+                    replica=self.replica_id,
                     dur_ms=round(tick_dur_s * 1e3, 3),
                     active_slots=int(active),
                     queue_depth=queued,

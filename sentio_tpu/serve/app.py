@@ -308,25 +308,69 @@ def _resolve_deadline_ts(request: web.Request, req, serve_cfg) -> Optional[float
     return time.perf_counter() + deadline_ms / 1e3
 
 
+_TENANT_RE = None
+
+
+def _request_tenant(request: web.Request) -> tuple[str, str]:
+    """(tenant, priority) for this request. The tenant key is the auth
+    principal when auth is on (a client cannot spoof another tenant by
+    header once authenticated), else a header-safe ``X-Tenant`` value, else
+    the shared default tenant. ``X-Priority: batch`` opts into the
+    shed-earlier tier; anything else is interactive."""
+    global _TENANT_RE
+    if _TENANT_RE is None:
+        import re
+
+        _TENANT_RE = re.compile(r"[A-Za-z0-9._:-]{1,64}")
+    from sentio_tpu.runtime.replica import (
+        DEFAULT_TENANT,
+        PRIORITY_BATCH,
+        PRIORITY_INTERACTIVE,
+    )
+
+    auth = request.get("auth")
+    if auth and auth.get("sub"):
+        tenant = f"user:{auth['sub']}"
+    else:
+        raw = request.headers.get("X-Tenant", "").strip()
+        tenant = raw if raw and _TENANT_RE.fullmatch(raw) else DEFAULT_TENANT
+    priority = (
+        PRIORITY_BATCH
+        if request.headers.get("X-Priority", "").strip().lower() == "batch"
+        else PRIORITY_INTERACTIVE
+    )
+    return tenant, priority
+
+
 async def chat(request: web.Request) -> web.Response:
     container: DependencyContainer = request.app["container"]
     body = await _json_body(request)
     req = parse_chat_request(body, container.settings.serve)
     deadline_ts = _resolve_deadline_ts(request, req, container.settings.serve)
+    tenant, priority = _request_tenant(request)
     if req.stream:
         # shed BEFORE response.prepare commits the 200 status line: an SSE
         # stream can only degrade after that, never 429/503
         service = container.peek("generation_service")
         if service is not None and hasattr(service, "check_admission"):
             try:
-                service.check_admission(deadline_ts)
+                if getattr(service, "supports_tenants", False):
+                    # replica tier: WFQ tenant check + the routed replica's
+                    # own admission, exactly as the submit will see them
+                    service.check_admission(
+                        deadline_ts, tenant=tenant, priority=priority,
+                        prompt=req.question,
+                    )
+                else:
+                    service.check_admission(deadline_ts)
             except SentioError:
                 raise  # typed shed/deadline → 429/503/504 with Retry-After
             except Exception:  # noqa: BLE001 — closed/broken paged path
                 # the provider still has its contiguous-engine escape hatch;
                 # pre-blocking here would 500 a servable stream
                 logger.debug("stream admission pre-check skipped", exc_info=True)
-        return await _chat_stream(request, container, req, deadline_ts)
+        return await _chat_stream(request, container, req, deadline_ts,
+                                  tenant=tenant, priority=priority)
     result = await container.chat_handler.process_chat_request(
         question=req.question,
         top_k=req.top_k,
@@ -334,12 +378,16 @@ async def chat(request: web.Request) -> web.Response:
         mode=req.mode,
         thread_id=req.thread_id,
         deadline_ts=deadline_ts,
+        tenant=tenant,
+        priority=priority,
     )
     return web.json_response(result)
 
 
 async def _chat_stream(request: web.Request, container: DependencyContainer, req,
-                       deadline_ts: Optional[float] = None) -> web.StreamResponse:
+                       deadline_ts: Optional[float] = None,
+                       tenant: Optional[str] = None,
+                       priority: Optional[str] = None) -> web.StreamResponse:
     """SSE token streaming (reference generator.py:298-333 / openai SSE).
     Retrieval + selection run first (blocking stage on a thread), then the
     generator's token iterator is pumped from a worker thread into the
@@ -401,6 +449,8 @@ async def _chat_stream(request: web.Request, container: DependencyContainer, req
             mode=req.mode,
             request_id=request_id,
             deadline_ts=deadline_ts,
+            tenant=tenant,
+            priority=priority,
         ):
             if not put((kind, payload)):
                 return
@@ -654,6 +704,16 @@ def _publish_serving_gauges(container: DependencyContainer):
                   "tick_failures", "pump_leaked"):
         if event in stats:
             m.bump_serving_total(event, float(stats[event]))
+    # multi-replica tier: the aggregate keeps every dashboard working; the
+    # replica-labeled gauge says WHICH replica is hot (occupancy/queue/pool
+    # per replica — the signals that justify or indict the router)
+    for replica_stats in stats.get("replicas", ()):  # ReplicaSet only
+        rid = replica_stats.get("replica", 0)
+        for key in ("active_slots", "queued", "queued_inbox", "free_pages",
+                    "prefix_cache_pages", "prefix_hit_token_ratio",
+                    "pool_hbm_bytes", "ttft_p50_ms", "completed", "shed"):
+            if key in replica_stats:
+                m.set_replica_stat(rid, key, float(replica_stats[key]))
     return stats
 
 

@@ -234,18 +234,25 @@ class DependencyContainer:
 
     @property
     def generation_service(self):
-        """Continuous-batching pump over the paged KV pool — the default
-        decode path for /chat. Shares weights/tokenizer with the contiguous
-        engine (which keeps streaming + escape-hatch duty)."""
+        """Multi-replica continuous-batching tier over the paged KV pool —
+        the default decode path for /chat. A :class:`ReplicaSet` owns
+        REPLICAS independent engine+service replicas (private pool, radix
+        tree, and pump each; weights/tokenizer shared with the contiguous
+        engine, which keeps escape-hatch duty), routes by radix-prefix
+        affinity then least-loaded, and applies per-tenant weighted fair
+        queueing in front. REPLICAS=1 degenerates to the single-engine
+        behavior every existing test pins."""
 
         def build():
             cfg = self.settings.generator
+            serve = self.settings.serve
             if cfg.provider != "tpu" or not cfg.use_paged_decode:
                 return None
             engine = self.engine
             if engine is None:
                 return None
             from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+            from sentio_tpu.runtime.replica import ReplicaSet
             from sentio_tpu.runtime.service import PagedGenerationService
 
             # paged speculative decoding: a configured draft checkpoint now
@@ -277,50 +284,88 @@ class DependencyContainer:
                         draft_cfg.n_layers, cfg.speculative_k,
                     )
 
-            paged = ContinuousBatchingEngine(
-                model_config=engine.model_config,
-                params=engine.params,
-                tokenizer=engine.tokenizer,
-                max_slots=cfg.max_batch_size,
-                page_size=cfg.kv_page_size,
-                max_pages_per_seq=cfg.kv_max_pages_per_seq,
-                steps_per_tick=cfg.decode_steps_per_tick,
-                max_tick_steps=cfg.decode_max_tick_steps,
-                pipeline_depth=cfg.decode_pipeline_depth,
-                kv_quant=cfg.kv_quant,
-                prefill_chunk=cfg.prefill_chunk or None,
-                draft_params=draft_params,
-                draft_config=draft_cfg,
-                spec_k=cfg.speculative_k,
-                prefix_cache=cfg.prefix_cache,
-                mesh=self.mesh,  # pool kv-heads shard over tp with the weights
-            )
+            n_replicas = max(serve.replicas, 1)
+            # replicas map onto dp-axis slices of the mesh when it divides
+            # evenly; otherwise every replica shares the whole mesh (their
+            # dispatches serialize on device — still correct, no scale-out)
+            meshes = [self.mesh] * n_replicas
+            if self.mesh is not None and n_replicas > 1:
+                from sentio_tpu.parallel.mesh import MeshError, split_mesh_dp
+
+                try:
+                    meshes = split_mesh_dp(self.mesh, n_replicas)
+                    logger.info(
+                        "replicas mapped onto %d dp-axis mesh slices",
+                        n_replicas,
+                    )
+                except MeshError as exc:
+                    logger.warning(
+                        "REPLICAS=%d cannot slice the dp axis (%s); "
+                        "replicas will share the whole mesh", n_replicas, exc,
+                    )
+
+            warm_head = ""
             if cfg.prefix_cache:
                 # the radix cache learns shared heads automatically from
                 # traffic; warming the rendered template head (instruction +
                 # section header) just spares the FIRST /chat its cold
-                # prefill of that span
+                # prefill of that span — per replica, since each owns a
+                # private tree
                 from sentio_tpu.ops.prompts import PromptBuilder
 
                 prompts = PromptBuilder()
-                head = prompts.static_head(
+                warm_head = prompts.static_head(
                     "retrieve", instruction=prompts.load("profile")
+                ) or ""
+
+            services = []
+            for i in range(n_replicas):
+                paged = ContinuousBatchingEngine(
+                    model_config=engine.model_config,
+                    params=engine.params,
+                    tokenizer=engine.tokenizer,
+                    max_slots=cfg.max_batch_size,
+                    page_size=cfg.kv_page_size,
+                    max_pages_per_seq=cfg.kv_max_pages_per_seq,
+                    steps_per_tick=cfg.decode_steps_per_tick,
+                    max_tick_steps=cfg.decode_max_tick_steps,
+                    pipeline_depth=cfg.decode_pipeline_depth,
+                    kv_quant=cfg.kv_quant,
+                    prefill_chunk=cfg.prefill_chunk or None,
+                    draft_params=draft_params,
+                    draft_config=draft_cfg,
+                    spec_k=cfg.speculative_k,
+                    prefix_cache=cfg.prefix_cache,
+                    mesh=meshes[i],  # pool kv-heads shard over tp with the weights
                 )
-                shared = paged.warm_prefix(head) if head else 0
-                if shared:
-                    logger.info(
-                        "prefix cache warmed: %d tokens of the /chat "
-                        "template head", shared,
-                    )
-            serve = self.settings.serve
-            return PagedGenerationService(
-                paged,
-                max_queue=serve.admission_max_queue or None,
-                default_deadline_s=(
-                    serve.default_deadline_ms / 1e3
-                    if serve.default_deadline_ms > 0 else None
-                ),
-                retry_budget=serve.crash_retry_budget,
+                if warm_head:
+                    shared = paged.warm_prefix(warm_head)
+                    if shared and i == 0:
+                        logger.info(
+                            "prefix cache warmed: %d tokens of the /chat "
+                            "template head (x%d replicas)", shared, n_replicas,
+                        )
+                services.append(PagedGenerationService(
+                    paged,
+                    max_queue=serve.admission_max_queue or None,
+                    default_deadline_s=(
+                        serve.default_deadline_ms / 1e3
+                        if serve.default_deadline_ms > 0 else None
+                    ),
+                    retry_budget=serve.crash_retry_budget,
+                    replica_id=i,
+                ))
+            return ReplicaSet(
+                services,
+                tenant_weights=serve.parsed_tenant_weights(),
+                tenant_default_weight=serve.tenant_default_weight,
+                tenant_refill_tokens_per_s=serve.tenant_refill_tokens_per_s,
+                tenant_burst_tokens=serve.tenant_burst_tokens,
+                tenant_headroom=(serve.tenant_headroom
+                                 if serve.tenant_headroom >= 0 else None),
+                batch_shed_fraction=serve.batch_shed_fraction,
+                affinity_stickiness=serve.affinity_stickiness,
+                route_prefix_tokens=serve.route_prefix_tokens,
             )
 
         return self._get("generation_service", build)

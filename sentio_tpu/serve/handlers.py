@@ -51,6 +51,8 @@ class ChatHandler:
         mode: str = "balanced",
         thread_id: Optional[str] = None,
         deadline_ts: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
     ) -> dict[str, Any]:
         t0 = time.perf_counter()
         query_id = thread_id or uuid.uuid4().hex[:12]
@@ -63,6 +65,12 @@ class ChatHandler:
             # absolute perf_counter deadline rides metadata into the graph's
             # generate node and down into the decode-service ticket
             metadata["deadline_ts"] = deadline_ts
+        if tenant is not None:
+            # WFQ key: rides metadata into the generate node, whose decode
+            # admission is charged to this tenant's fair-share quota
+            metadata["tenant"] = tenant
+        if priority is not None:
+            metadata["priority"] = priority
         # flight record opens HERE — the query_id in metadata is the trace
         # context every downstream layer (graph executor, generator provider,
         # decode-engine pump) attaches its telemetry to
@@ -176,6 +184,8 @@ class ChatHandler:
         mode: str = "balanced",
         request_id: Optional[str] = None,
         deadline_ts: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
     ):
         """Typed-event generator for SSE, with FULL graph-stage parity
         (reference factory.py:191-208 — streaming traverses the same graph):
@@ -225,6 +235,7 @@ class ChatHandler:
             for piece in self.container.generator.stream(
                 question, selected, mode=mode, temperature=temperature,
                 request_id=request_id, deadline_ts=deadline_ts,
+                tenant=tenant, priority=priority,
             ):
                 chunks.append(piece)
                 yield ("token", piece)

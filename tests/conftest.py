@@ -40,6 +40,7 @@ _SANITIZED_MODULES = {
     "test_paged_sched",
     "test_paged_spec",
     "test_prefix_cache",
+    "test_replica",
     "test_service",
     "test_sanitize",
 }

@@ -1,0 +1,17 @@
+"""Seeded violation: ReplicaSet-style routing state mutated lock-free.
+
+Cross-replica routing counters are touched by every submitter thread, so
+the guarded-by contract matters here exactly as much as in the service —
+this fixture is the router-shaped regression the lock checker must catch.
+"""
+import threading
+
+
+class BadReplicaRouter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._routed = 0  # guarded-by: _lock
+
+    def route(self):
+        self._routed += 1
+        return self._routed

@@ -35,6 +35,16 @@ class TestSeededFixtures:
         # the finding names both the attribute and the missing lock
         assert "_items" in got[0].message and "_lock" in got[0].message
 
+    def test_replica_fixture_exact_findings(self):
+        """Cross-replica routing state (multi-replica tier) mutated without
+        its lock: both the unlocked increment and the unlocked read fire."""
+        got = _findings("replica_bad.py")
+        assert [(f.rule, f.line) for f in got] == [
+            ("lock-discipline", 16),
+            ("lock-discipline", 17),
+        ]
+        assert "_routed" in got[0].message and "_lock" in got[0].message
+
     def test_clock_fixture_exact_finding(self):
         got = _findings("clock_bad.py")
         assert [(f.rule, f.line) for f in got] == [("wall-clock-duration", 6)]
@@ -125,6 +135,7 @@ class TestRepoGate:
         expectations = {
             "sentio_tpu/runtime/service.py": ("PagedGenerationService",
                                               "_inbox"),
+            "sentio_tpu/runtime/replica.py": ("TenantFairQueue", "_tenants"),
             "sentio_tpu/infra/flight.py": ("FlightRecorder", "_records"),
             "sentio_tpu/infra/metrics.py": ("InMemoryMetrics", "histograms"),
         }

@@ -1,0 +1,466 @@
+"""Multi-replica serving tier (runtime/replica.py) — tier 1.
+
+The contract under test, end to end on tiny engines (conftest arms
+SENTIO_SANITIZE=1 for this module, so every tick self-checks):
+
+* **radix-prefix affinity** — a session's follow-up routes to the replica
+  whose radix cache holds its prefix, and that request's
+  ``prefix_hit_tokens`` proves the KV was actually reused (not just that
+  routing picked a replica); stickiness yields to least-loaded when the
+  hit replica is backlogged;
+* **weighted fair queueing** — a flooding tenant is capped at its
+  fair-share quota below total capacity, so a second tenant's FIRST
+  request is admitted (the acceptance criterion, asserted both on the
+  queue in isolation and through real engines under load);
+* **N=1 equivalence** — a single-replica set is a pass-through: same
+  greedy tokens, same stats keys the serving gauges read;
+* **chaos** — a faulted tick on one replica is contained by that replica's
+  crash-containment (PR 5 fault points); every caller terminates and the
+  set keeps serving;
+* **fan-out lifecycle** — warmup warms every replica before returning,
+  drain drains concurrently, leaked pumps sum without double-count.
+"""
+
+import threading
+import time
+
+import pytest
+
+from sentio_tpu.infra import faults
+from sentio_tpu.infra.exceptions import ServiceOverloaded
+from sentio_tpu.runtime.paged import ContinuousBatchingEngine, PagedResult
+from sentio_tpu.runtime.replica import (
+    DEFAULT_TENANT,
+    PRIORITY_BATCH,
+    ReplicaSet,
+    TenantFairQueue,
+)
+from sentio_tpu.runtime.service import PagedGenerationService
+
+
+def _engine(base=None, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_pages_per_seq", 4)
+    kw.setdefault("steps_per_tick", 2)
+    if base is not None:
+        kw.setdefault("params", base.params)
+        kw.setdefault("tokenizer", base.tokenizer)
+    return ContinuousBatchingEngine(**kw)
+
+
+@pytest.fixture(scope="module")
+def replica_set():
+    """One 2-replica set for the module: each new engine recompiles its jit
+    variants, so tests share the set (the chaos drill resets, not poisons)."""
+    e0 = _engine()
+    e1 = _engine(base=e0)
+    rs = ReplicaSet(
+        [PagedGenerationService(e0, max_queue=8),
+         PagedGenerationService(e1, max_queue=8)],
+    )
+    yield rs
+    rs.close()
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.reset()
+
+
+def _assert_pages_conserved(rs):
+    for s in rs.stats()["replicas"]:
+        assert s["free_pages"] + s.get("prefix_cache_pages", 0) \
+            == s["total_pages"] - 1, s
+
+
+class TestTenantFairQueue:
+    def test_flood_capped_and_second_tenant_admitted(self):
+        """THE fairness criterion: a saturating single-tenant flood is
+        quota-capped below capacity, and a second tenant's first request is
+        admitted within its quota."""
+        q = TenantFairQueue(capacity=16)
+        shed = None
+        for _ in range(20):
+            try:
+                q.admit("hot", 10)
+            except ServiceOverloaded as exc:
+                shed = exc
+                break
+        assert shed is not None and shed.status == 429
+        assert shed.details["shed_reason"] == "tenant_quota"
+        hot = q.stats()["per_tenant"]["hot"]
+        assert hot["pending"] < q.capacity, "flood consumed the whole capacity"
+        # the idle tenant's FIRST request lands inside the reserved headroom
+        assert q.admit("idle", 10) == "idle"
+        assert q.stats()["per_tenant"]["idle"]["admitted"] == 1
+        # the hot tenant stays capped (its quota HALVED once idle is active)
+        with pytest.raises(ServiceOverloaded):
+            q.admit("hot", 10)
+        # releases restore admission
+        for _ in range(hot["pending"]):
+            q.release("hot", 10)
+        assert q.admit("hot", 10) == "hot"
+
+    def test_weights_scale_quotas(self):
+        q = TenantFairQueue(capacity=30, weights={"big": 2.0, "small": 1.0},
+                            headroom=0)
+        # both active: big's quota should be ~2x small's
+        q.admit("big", 1)
+        q.admit("small", 1)
+        big_quota = small_quota = 0
+        with q._mutex:
+            big_quota = q._quota_locked("big", q._tenants["big"])
+            small_quota = q._quota_locked("small", q._tenants["small"])
+        assert big_quota == 2 * small_quota
+
+    def test_batch_tier_sheds_before_interactive(self):
+        q = TenantFairQueue(capacity=10, batch_shed_fraction=0.5, headroom=1)
+        for _ in range(5):
+            q.admit("a", 1)
+        with pytest.raises(ServiceOverloaded) as exc_info:
+            q.admit("b", 1, priority=PRIORITY_BATCH)
+        assert exc_info.value.status == 503
+        assert exc_info.value.details["shed_reason"] == "priority_batch"
+        q.admit("b", 1)  # interactive still admits at the same load
+
+    def test_deficit_rate_limits_contended_tenant_only(self):
+        q = TenantFairQueue(capacity=100, refill_tokens_per_s=1.0,
+                            burst_tokens=10)
+        # burn the burst while ALONE: never deficit-shed (idle capacity is
+        # not rationed), even far past the credit
+        for _ in range(30):
+            q.admit("solo", 5)
+        with q._mutex:
+            assert q._tenants["solo"].deficit < 0
+        # a second tenant appears → solo is now contended and broke
+        q.admit("other", 1)
+        with pytest.raises(ServiceOverloaded) as exc_info:
+            q.admit("solo", 5)
+        assert exc_info.value.details["shed_reason"] == "tenant_deficit"
+        assert exc_info.value.details["retry_after_s"] >= 0.5
+        # the fresh tenant has full burst credit
+        q.admit("other", 5)
+
+    def test_release_corrects_estimate_to_actual(self):
+        q = TenantFairQueue(capacity=10, refill_tokens_per_s=1.0,
+                            burst_tokens=100)
+        q.admit("t", 60)
+        with q._mutex:
+            assert q._tenants["t"].deficit == pytest.approx(40, abs=1)
+        q.release("t", 60, actual_tokens=10)  # stopped early: credit back
+        with q._mutex:
+            assert q._tenants["t"].deficit == pytest.approx(90, abs=1)
+        assert q.stats()["per_tenant"]["t"]["tokens"] == 10
+
+    def test_tenant_cardinality_bounded(self):
+        q = TenantFairQueue(capacity=10_000)
+        # 20 over the cap: few enough that the shared overflow bucket stays
+        # inside its own fair-share quota (overflow tenants still queue)
+        for i in range(TenantFairQueue.MAX_TRACKED + 20):
+            charged = q.admit(f"t{i}", 1)
+        assert charged == TenantFairQueue.OVERFLOW_TENANT
+        assert len(q.stats()["per_tenant"]) <= TenantFairQueue.MAX_TRACKED + 1
+
+    def test_tenant_metrics_recorded(self):
+        from sentio_tpu.infra.metrics import MetricsCollector, set_metrics
+
+        collector = MetricsCollector()
+        set_metrics(collector)
+        try:
+            q = TenantFairQueue(capacity=4, headroom=1)
+            for _ in range(4):
+                try:
+                    q.admit("m", 1)
+                except ServiceOverloaded:
+                    pass
+            counters = collector.memory.snapshot()["counters"]
+            assert counters.get("tenant_admitted('m',)", 0) >= 1
+            assert counters.get("tenant_shed('m', 'tenant_quota')", 0) >= 1
+        finally:
+            set_metrics(None)
+
+
+class TestIsolation:
+    def test_shared_engine_rejected(self, replica_set):
+        svc = replica_set._services[0]
+        with pytest.raises(ValueError, match="share"):
+            ReplicaSet([svc, PagedGenerationService(svc.engine)])
+
+    def test_sanitizer_guard_named_per_replica(self, replica_set):
+        guard = replica_set._services[1].engine._san
+        assert guard is not None and "[r1]" in guard.name
+
+
+class TestRouting:
+    SESSION = ("session head for affinity routing spanning several pages "
+               "of cached prefix easily")
+
+    def test_two_turn_session_lands_on_prefix_holder(self, replica_set):
+        rs = replica_set
+        first = rs.generate(self.SESSION + " turn one", max_new_tokens=3,
+                            temperature=0.0, timeout_s=120)
+        assert first.finish_reason in ("stop", "length")
+        toks = rs._route_tokens(self.SESSION + " turn two")
+        peeks = [svc.engine.peek_prefix(toks) for svc in rs._services]
+        holder = max(range(len(peeks)), key=lambda i: peeks[i])
+        assert peeks[holder] > 0, "first turn left no cached prefix"
+        routed, hit = rs._route(toks)
+        assert routed == holder and hit == peeks[holder]
+        # end to end: the second turn's result PROVES the KV reuse
+        hits_before = rs.stats()["replicas"][holder]["prefix_hit_tokens"]
+        second = rs.generate(self.SESSION + " turn two", max_new_tokens=3,
+                             temperature=0.0, timeout_s=120)
+        assert second.prefix_hit_tokens > 0
+        hits_after = rs.stats()["replicas"][holder]["prefix_hit_tokens"]
+        assert hits_after - hits_before >= second.prefix_hit_tokens
+
+    def test_stickiness_yields_under_backlog(self, replica_set, monkeypatch):
+        rs = replica_set
+        toks = rs._route_tokens(self.SESSION + " turn three")
+        holder, hit = rs._route(toks)
+        assert hit > 0
+        # the prefix holder reports a backlog past the stickiness bound:
+        # routing must fall through to least-loaded (the OTHER replica)
+        monkeypatch.setattr(rs._services[holder], "backlog", lambda: 10_000)
+        monkeypatch.setattr(rs._services[holder], "projected_wait",
+                            lambda: 100.0)
+        routed, hit2 = rs._route(toks)
+        assert routed != holder and hit2 == 0
+        stats = rs.stats()["routing"]
+        assert stats["affinity_overflow"] >= 1
+
+    def test_cold_prompt_routes_least_loaded(self, replica_set, monkeypatch):
+        rs = replica_set
+        toks = rs._route_tokens("entirely novel prompt with no cached head")
+        assert all(svc.engine.peek_prefix(toks) == 0 for svc in rs._services)
+        monkeypatch.setattr(rs._services[0], "projected_wait", lambda: 9.0)
+        monkeypatch.setattr(rs._services[1], "projected_wait", lambda: 0.1)
+        assert rs._route(toks)[0] == 1
+
+    def test_peek_prefix_takes_no_refcounts_and_no_lru_touch(self):
+        from sentio_tpu.runtime.radix import RadixPrefixCache
+
+        class _Alloc:
+            def free(self, ids):
+                pass
+
+        cache = RadixPrefixCache(page_size=4, allocator=_Alloc())
+        toks = list(range(8))
+        node, _donated = cache.insert(toks, 0, [1, 2])
+        before = (node.refcount, node.last_used)
+        assert cache.peek_prefix(toks + [99]) == 8
+        assert cache.peek_prefix(toks[:5]) == 4  # page-aligned partial
+        assert cache.peek_prefix([7, 7, 7, 7]) == 0
+        assert (node.refcount, node.last_used) == before, (
+            "peek_prefix must not pin or LRU-touch nodes"
+        )
+        # match() by contrast DOES touch LRU — the probe is the exception
+        cache.match(toks)
+        assert node.last_used != before[1]
+
+
+class TestEquivalence:
+    def test_n1_set_is_a_pass_through(self):
+        engine = _engine()
+        svc = PagedGenerationService(engine)
+        rs = ReplicaSet([svc])
+        try:
+            prompt = "single replica equivalence check prompt"
+            direct = svc.generate(prompt, max_new_tokens=6, temperature=0.0,
+                                  timeout_s=120)
+            routed = rs.generate(prompt, max_new_tokens=6, temperature=0.0,
+                                 timeout_s=120)
+            assert routed.tokens == direct.tokens
+            stats = rs.stats()
+            # every key the serving gauges read must survive aggregation
+            for key in ("active_slots", "queued", "queued_inbox",
+                        "free_pages", "total_pages", "completed", "ticks",
+                        "max_queue", "shed", "expired", "pump_leaked",
+                        "avg_active_slots", "max_active_slots",
+                        "pool_hbm_bytes", "draining"):
+                assert key in stats, key
+            assert stats["n_replicas"] == 1
+            assert stats["completed"] == svc.stats()["completed"]
+        finally:
+            rs.close()
+
+
+class TestWfqThroughEngines:
+    def test_flooding_tenant_cannot_starve_second_tenant(self):
+        """End to end through real engines: tenant A floods past its quota
+        (typed 429s observed, reason ``tenant_quota``), and tenant B's
+        request — arriving mid-flood — is admitted and completes. A
+        dedicated set with a large headroom pins A's quota at 4 of the 16
+        queue slots, so the quota layer (not the per-replica queue bound)
+        is provably what capped the flood."""
+        e0 = _engine()
+        e1 = _engine(base=e0)
+        rs = ReplicaSet(
+            [PagedGenerationService(e0, max_queue=8),
+             PagedGenerationService(e1, max_queue=8)],
+            tenant_headroom=12,  # capacity 16 → lone-tenant quota 4
+        )
+        outcomes: list = []
+
+        def flood(i):
+            try:
+                outcomes.append(rs.generate(
+                    f"tenant a flood request number {i}", max_new_tokens=12,
+                    temperature=0.0, timeout_s=120, tenant="team-a",
+                ))
+            except ServiceOverloaded as exc:
+                outcomes.append(exc)
+
+        try:
+            threads = [threading.Thread(target=flood, args=(i,))
+                       for i in range(10)]
+            for t in threads:
+                t.start()
+            # the first admissions pay the fresh engines' compile (seconds),
+            # so the flood saturates its 4-slot quota long before anything
+            # completes; wait until that is observable
+            deadline = time.monotonic() + 60
+            saturated = False
+            while time.monotonic() < deadline and not saturated:
+                a = rs.tenants.stats()["per_tenant"].get("team-a")
+                saturated = bool(a and a["shed"] >= 1)
+                time.sleep(0.002)
+            assert saturated, "flood never hit tenant A's quota"
+            # mid-flood, tenant B's FIRST request is admitted within its
+            # quota and completes — A cannot starve it
+            result_b = rs.generate("tenant b first request", max_new_tokens=3,
+                                   temperature=0.0, timeout_s=120,
+                                   tenant="team-b")
+            assert result_b.finish_reason in ("stop", "length")
+            for t in threads:
+                t.join(timeout=180)
+            sheds = [o for o in outcomes if isinstance(o, ServiceOverloaded)]
+            dones = [o for o in outcomes if isinstance(o, PagedResult)]
+            assert sheds, "the flood was never shed"
+            assert all(e.details.get("shed_reason") == "tenant_quota"
+                       and e.details.get("tenant") == "team-a"
+                       for e in sheds), sheds
+            assert dones, "the flood tenant must still be served within quota"
+            tenants = rs.tenants.stats()["per_tenant"]
+            assert tenants["team-a"]["shed"] >= 1
+            assert tenants["team-b"]["shed"] == 0
+            assert tenants["team-b"]["admitted"] == 1
+            _assert_pages_conserved(rs)
+        finally:
+            rs.close()
+
+
+class TestChaos:
+    def test_one_replica_faults_others_keep_serving(self, replica_set):
+        """PR 5 fault points through the set: a one-shot tick fault hits
+        whichever replica ticks next; its crash containment requeues, the
+        other replica never notices, every caller terminates."""
+        rs = replica_set
+        outcomes: dict = {}
+
+        def call(i):
+            try:
+                outcomes[i] = rs.generate(
+                    f"chaos replica load {i}", max_new_tokens=4,
+                    temperature=0.0, timeout_s=120,
+                )
+            except Exception as exc:  # noqa: BLE001 — typed errors terminal
+                outcomes[i] = exc
+
+        with faults.inject("paged.step", error=RuntimeError("replica chaos"),
+                           times=2) as rule:
+            threads = [threading.Thread(target=call, args=(i,))
+                       for i in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180)
+            assert not any(t.is_alive() for t in threads)
+        assert rule.fired >= 1
+        assert len(outcomes) == 6
+        # the set survived: a post-chaos request works end to end
+        ok = rs.generate("post replica chaos sanity", max_new_tokens=3,
+                         timeout_s=120)
+        assert ok.finish_reason in ("stop", "length")
+        agg = rs.stats()
+        assert agg["tick_failures"] >= 1
+        _assert_pages_conserved(rs)
+
+
+class TestLifecycleFanOut:
+    def test_warmup_warms_every_replica(self):
+        e0 = _engine()
+        e1 = _engine(base=e0)
+        rs = ReplicaSet([PagedGenerationService(e0),
+                         PagedGenerationService(e1)])
+        try:
+            out = rs.warmup(max_new_tokens=2)
+            assert out["replicas"] == 2
+            assert out["prompts"] > 0
+            for s in rs.stats()["replicas"]:
+                assert s["completed"] > 0, (
+                    f"replica {s['replica']} was never warmed: {s}"
+                )
+        finally:
+            rs.close()
+
+    def test_drain_concurrent_and_aggregated(self, replica_set):
+        out = replica_set.drain(deadline_s=30.0)
+        assert out["drained"] is True
+        assert out["abandoned"] == 0
+        assert [r["replica"] for r in out["replicas"]] == [0, 1]
+        with pytest.raises((RuntimeError, ServiceOverloaded)):
+            replica_set.generate("after drain", max_new_tokens=2)
+
+    def test_leaked_pump_sums_without_double_count(self):
+        e0 = _engine()
+        e1 = _engine(base=e0)
+        svc0 = PagedGenerationService(e0)
+        svc1 = PagedGenerationService(e1)
+        rs = ReplicaSet([svc0, svc1])
+        release = threading.Event()
+
+        class StuckPump:
+            name = "paged-decode-pump"
+            daemon = True
+
+            def join(self, timeout=None):
+                pass
+
+            def is_alive(self):
+                return not release.is_set()
+
+        with svc1._mutex:
+            svc1._pump = StuckPump()
+        rs.close()
+        stats = rs.stats()
+        assert stats["pump_leaked"] == 1
+        assert [s["pump_leaked"] for s in stats["replicas"]] == [0, 1]
+        release.set()
+
+
+class TestMeshSplit:
+    def test_split_dp_into_disjoint_submeshes(self):
+        from sentio_tpu.config import MeshConfig
+        from sentio_tpu.parallel.mesh import AXIS_DP, build_mesh, split_mesh_dp
+
+        mesh = build_mesh(MeshConfig())  # 8 virtual CPU devices, all on dp
+        subs = split_mesh_dp(mesh, 2)
+        assert len(subs) == 2
+        seen = set()
+        for sub in subs:
+            assert sub.shape[AXIS_DP] == mesh.shape[AXIS_DP] // 2
+            ids = {d.id for d in sub.devices.flat}
+            assert not (ids & seen), "replicas share devices"
+            seen |= ids
+        assert len(seen) == len(list(mesh.devices.flat))
+
+    def test_ragged_split_raises(self):
+        from sentio_tpu.config import MeshConfig
+        from sentio_tpu.parallel.mesh import MeshError, build_mesh, split_mesh_dp
+
+        mesh = build_mesh(MeshConfig())
+        with pytest.raises(MeshError, match="not divisible"):
+            split_mesh_dp(mesh, 3)

@@ -130,6 +130,61 @@ class TestThreadGuard:
             eng.step()
 
 
+class TestCrossReplicaOwnership:
+    """Multi-replica tier: pump-thread ownership is PER REPLICA — each
+    replica's pump owns only its own engine, and a thread that legitimately
+    drives replica 0 is still an intruder on replica 1."""
+
+    def test_cross_replica_mutation_raises(self):
+        e0 = _engine()
+        e1 = _engine()
+        ready = threading.Event()
+        release = threading.Event()
+
+        def replica_one_pump():
+            e1.submit("replica one work", max_new_tokens=2)  # binds e1
+            ready.set()
+            release.wait(timeout=60)
+
+        t = threading.Thread(target=replica_one_pump, name="r1-pump")
+        t.start()
+        ready.wait(timeout=60)
+        # this thread legitimately drives replica 0...
+        e0.submit("replica zero work", max_new_tokens=2)
+        caught: list = []
+        try:
+            # ...but replica 1 is owned by its own (live) pump: a
+            # cross-replica mutation must raise, not silently interleave
+            try:
+                e1.step()
+            except SanitizerError as exc:
+                caught.append(exc)
+        finally:
+            release.set()
+            t.join(timeout=60)
+        assert caught, "cross-replica engine.step must raise under sanitize"
+        assert "single-threaded" in str(caught[0])
+        # replica 0 was never poisoned: its rightful driver finishes
+        while e0.has_work:
+            e0.step()
+        # replica 1's owner died: ownership migrates and IT finishes too
+        while e1.has_work:
+            e1.step()
+
+    def test_replica_set_names_guards_per_replica(self):
+        from sentio_tpu.runtime.replica import ReplicaSet
+        from sentio_tpu.runtime.service import PagedGenerationService
+
+        e0 = _engine()
+        e1 = _engine()
+        rs = ReplicaSet([PagedGenerationService(e0),
+                         PagedGenerationService(e1)])
+        try:
+            assert "[r0]" in e0._san.name and "[r1]" in e1._san.name
+        finally:
+            rs.close()
+
+
 class TestEngineInvariants:
     # the conservation/refcount checks are representation-blind, but the
     # quantized dict pool must ride through the same per-tick verification
