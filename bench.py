@@ -883,6 +883,191 @@ def phase_load(llm_cfg, new_tokens):
     return result
 
 
+def phase_chaos(llm_cfg, new_tokens):
+    """Replica-kill chaos drill over the open-loop harness (BENCH_CHAOS=1):
+    a 2-replica set serves a steady Poisson arrival stream; mid-run one
+    replica's next decode tick is killed AND its ``engine.reset()`` is
+    forced to fail — the worst-case loss, where the replica latches broken
+    and the supervisor must rebuild it in place from the shared weights.
+    The artifact answers the three operator questions: **availability**
+    (completed / arrivals — the error-budget fraction is its complement),
+    **p95 during the incident window** (requests arriving between the kill
+    and the set reporting all-HEALTHY again), and **time-to-recover**
+    (kill → rebuilt replica back in rotation). Untyped errors are counted
+    separately and should be zero — every failure a caller sees must be a
+    typed shed/deadline/replica error.
+
+    Env knobs: BENCH_CHAOS_QPS (8), BENCH_CHAOS_SECONDS (30),
+    BENCH_CHAOS_KILL_AT_S (5), BENCH_CHAOS_SLOTS (8),
+    BENCH_CHAOS_SEED (1234)."""
+    import random
+    import threading
+
+    from sentio_tpu.infra import faults
+    from sentio_tpu.infra.exceptions import (
+        DeadlineExceededError,
+        SentioError,
+        ServiceOverloaded,
+    )
+    from sentio_tpu.infra.metrics import MetricsCollector, set_metrics
+    from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+    from sentio_tpu.runtime.replica import ReplicaSet
+    from sentio_tpu.runtime.service import PagedGenerationService
+
+    qps = float(os.environ.get("BENCH_CHAOS_QPS", "8"))
+    run_s = float(os.environ.get("BENCH_CHAOS_SECONDS", "30"))
+    kill_at_s = float(os.environ.get("BENCH_CHAOS_KILL_AT_S", "5"))
+    max_slots = int(os.environ.get("BENCH_CHAOS_SLOTS", "8"))
+    seed = int(os.environ.get("BENCH_CHAOS_SEED", "1234"))
+    gen_tokens = min(new_tokens, 16)
+    rng = random.Random(seed)
+
+    log("phase CHAOS: building 2-replica set ...")
+    e0 = ContinuousBatchingEngine(
+        model_config=llm_cfg, max_slots=max_slots, page_size=16,
+        max_pages_per_seq=8, steps_per_tick=8, max_tick_steps=8,
+        pipeline_depth=2, ignore_eos=True,
+    )
+    e1 = ContinuousBatchingEngine(
+        model_config=llm_cfg, params=e0.params, tokenizer=e0.tokenizer,
+        max_slots=max_slots, page_size=16, max_pages_per_seq=8,
+        steps_per_tick=8, max_tick_steps=8, pipeline_depth=2,
+        ignore_eos=True,
+    )
+    rs = ReplicaSet(
+        [PagedGenerationService(e0), PagedGenerationService(e1)],
+        # fast supervision: the drill measures recovery, not poll cadence
+        probe_interval_s=0.05, quarantine_backoff_s=0.25,
+        breaker_tick_failures=2, failover_budget=2,
+    )
+    log("phase CHAOS: warmup ...")
+    rs.warmup(max_new_tokens=gen_tokens)
+    set_metrics(MetricsCollector())
+
+    lock = threading.Lock()
+    stats = {"arrivals": 0, "ok": 0, "shed": 0, "expired": 0,
+             "typed_errors": 0, "untyped_errors": 0}
+    # (arrival time relative to t_start, e2e latency ms) for completions
+    completions: list[tuple[float, float]] = []
+    t_state = {"kill": None, "recover": None, "done": False}
+
+    def worker(prompt: str, t_rel: float) -> None:
+        t0 = time.perf_counter()
+        try:
+            r = rs.generate(prompt, max_new_tokens=gen_tokens,
+                            temperature=0.0, timeout_s=180)
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            with lock:
+                if r.finish_reason == "error":
+                    stats["typed_errors"] += 1
+                else:
+                    stats["ok"] += 1
+                    completions.append((t_rel, dt_ms))
+        except ServiceOverloaded:
+            with lock:
+                stats["shed"] += 1
+        except DeadlineExceededError:
+            with lock:
+                stats["expired"] += 1
+        except SentioError:
+            with lock:
+                stats["typed_errors"] += 1
+        except Exception:  # noqa: BLE001 — the number that must stay zero
+            with lock:
+                stats["untyped_errors"] += 1
+
+    def watcher(t_start: float) -> None:
+        # recovery clock: from the kill until the set reports all-HEALTHY
+        while t_state["recover"] is None and not t_state["done"]:
+            if t_state["kill"] is not None:
+                summary = rs.health_summary()
+                if summary["status"] == "healthy" and \
+                        time.perf_counter() - t_start - t_state["kill"] > 0.2:
+                    t_state["recover"] = time.perf_counter() - t_start
+                    return
+            time.sleep(0.02)
+
+    threads: list[threading.Thread] = []
+    t_start = time.perf_counter()
+    w = threading.Thread(target=watcher, args=(t_start,), daemon=True)
+    w.start()
+    killed = False
+    seq = 0
+    while time.perf_counter() - t_start < run_s:
+        t_rel = time.perf_counter() - t_start
+        if not killed and t_rel >= kill_at_s:
+            # one-shot kill: the next decode tick anywhere fails, and that
+            # pump's recovery reset fails too → latched broken replica
+            faults.arm("paged.step", faults.FaultRule(
+                error=RuntimeError("bench chaos: replica kill"), times=1))
+            faults.arm("engine.reset", faults.FaultRule(
+                error=RuntimeError("bench chaos: reset denied"), times=1))
+            t_state["kill"] = t_rel
+            killed = True
+            log(f"phase CHAOS: replica kill armed at t={t_rel:.1f}s")
+        prompt = f"chaos session {seq % 8:02d} steady traffic turn {seq}"
+        t = threading.Thread(target=worker, args=(prompt, t_rel), daemon=True)
+        t.start()
+        threads.append(t)
+        with lock:
+            stats["arrivals"] += 1
+        seq += 1
+        time.sleep(rng.expovariate(qps))
+    for t in threads:
+        t.join(timeout=240)
+    hung = sum(t.is_alive() for t in threads)
+    # recovery may land after the last arrival; give the supervisor a
+    # bounded grace to finish the rebuild before declaring non-recovery —
+    # but ONLY if a kill actually happened (kill_at_s past the run window
+    # means there is no incident to recover from)
+    if killed:
+        grace_end = time.perf_counter() + 120
+        while t_state["recover"] is None and time.perf_counter() < grace_end:
+            time.sleep(0.1)
+    t_state["done"] = True  # stop the watcher (it idles if never killed)
+    faults.reset()
+
+    t_kill = t_state["kill"]
+    t_recover = t_state["recover"]
+    incident = [lat for (t_rel, lat) in completions
+                if t_kill is not None
+                and t_kill <= t_rel <= (t_recover if t_recover is not None
+                                        else float("inf"))]
+    steady = [lat for (t_rel, lat) in completions
+              if t_kill is None or t_rel < t_kill]
+    arrivals = max(stats["arrivals"], 1)
+    out = {
+        "knobs": {"qps": qps, "run_s": run_s, "kill_at_s": kill_at_s,
+                  "slots_per_replica": max_slots, "gen_tokens": gen_tokens,
+                  "seed": seed},
+        **stats,
+        "hung": hung,
+        # the headline: fraction of offered requests that completed — its
+        # complement is the error budget the incident consumed
+        "availability": round(stats["ok"] / arrivals, 4),
+        "killed": killed,
+        "time_to_recover_s": (round(t_recover - t_kill, 2)
+                              if t_recover is not None and t_kill is not None
+                              else None),
+        # None (not False) when no kill was armed: there was no incident
+        "recovered": (t_recover is not None) if killed else None,
+        "health": rs.health_summary(),
+        "failovers": rs.stats().get("failovers", 0),
+    }
+    if steady:
+        out["steady_p95_ms"] = round(_percentile(steady, 0.95), 2)
+    if incident:
+        out["incident_p95_ms"] = round(_percentile(incident, 0.95), 2)
+        out["incident_completions"] = len(incident)
+    rs.close()
+    set_metrics(MetricsCollector())
+    log(f"phase CHAOS: availability={out['availability']} "
+        f"ttr={out['time_to_recover_s']}s "
+        f"incident_p95={out.get('incident_p95_ms')}ms "
+        f"untyped={stats['untyped_errors']}")
+    return out
+
+
 def phase_d_kernels():
     """Kernel-vs-XLA timings on the real chip: flash attention (prefill
     shape) and the paged decode kernel (page-table walk vs gather). Each
@@ -1084,6 +1269,10 @@ def main() -> None:
     # cannot disturb the phases above
     load = phase_load(llm_cfg, new_tokens) \
         if os.environ.get("BENCH_LOAD") == "1" else None
+    # replica-kill chaos drill: availability, incident-window p95, and
+    # time-to-recover for a mid-run replica loss with reset forced to fail
+    chaos = phase_chaos(llm_cfg, new_tokens) \
+        if os.environ.get("BENCH_CHAOS") == "1" else None
 
     total_s = time.perf_counter() - t_start
     log(f"bench wall {total_s:.0f}s")
@@ -1118,6 +1307,7 @@ def main() -> None:
         **({"longctx": longctx} if longctx else {}),
         **({"speculative": speculative} if speculative else {}),
         **({"load": load} if load else {}),
+        **({"chaos": chaos} if chaos else {}),
         "wall_s": round(total_s, 1),
     }
     print(json.dumps(payload))

@@ -89,13 +89,18 @@ def reset() -> None:
         _armed = False
 
 
-def note_compile(family: str, signature: str, n: int = 1) -> None:
+def note_compile(family: str, signature: str, n: int = 1,
+                 exempt: bool = False) -> None:
     """Record ``n`` compiles at ``family`` (called by ``FamilyFn`` on jit
-    cache growth). Raises :class:`CompileFenceError` when armed."""
+    cache growth). Raises :class:`CompileFenceError` when armed — unless
+    ``exempt`` (a supervised replica rebuild marks the NEW engine's
+    FamilyFn instances exempt for the duration of its warmup, so its cold
+    compiles pass while a steady-state recompile on any OTHER engine still
+    trips the fence). Exempt compiles are still counted and evented."""
     with _lock:
         _totals[family] = _totals.get(family, 0) + n
         _events.append({"family": family, "signature": signature, "n": n})
-        armed = _armed
+        armed = _armed and not exempt
     try:  # telemetry is best-effort; the counter must never break a tick
         from sentio_tpu.infra.metrics import get_metrics
 

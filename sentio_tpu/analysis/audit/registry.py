@@ -90,6 +90,11 @@ class FamilyFn:
         self._fn = fn
         self._cache_size_fn = getattr(fn, "_cache_size", None)
         self._seen = 0
+        # armed-fence bypass for THIS instance only: a supervised replica
+        # rebuild sets it while warming its fresh engine (whose FamilyFns
+        # are all cold), then clears it — compiles on other instances keep
+        # tripping the fence throughout
+        self.fence_exempt = False
 
     def __call__(self, *args, **kwargs):
         out = self._fn(*args, **kwargs)
@@ -103,7 +108,8 @@ class FamilyFn:
                 # may raise CompileFenceError when the fence is armed — the
                 # compile already happened; the error is the report
                 fence.note_compile(
-                    self.family, abstract_signature(args, kwargs), delta
+                    self.family, abstract_signature(args, kwargs), delta,
+                    exempt=self.fence_exempt,
                 )
         return out
 

@@ -396,6 +396,31 @@ class ServeConfig:
     # batch-priority tier sheds once total pending crosses this fraction
     # of the set's capacity (interactive may use the full capacity)
     batch_shed_fraction: float = 0.8
+    # ---- replica failure domains (supervision / breaker / rebuild) ----
+    # arm the per-set supervisor thread (health state machine + in-place
+    # rebuild of quarantined replicas); 0 only for debugging
+    replica_supervise: bool = True
+    # supervisor poll cadence: breaker evaluation + rebuild scheduling
+    replica_probe_interval_s: float = 0.25
+    # per-replica breaker: sliding window for both the caller-observed
+    # error rate and the tick-failure burst count
+    replica_breaker_window_s: float = 30.0
+    # quarantine when failures/samples >= rate with at least min samples
+    replica_breaker_error_rate: float = 0.5
+    replica_breaker_min_samples: int = 4
+    # quarantine on this many failed decode ticks inside the window
+    replica_breaker_tick_failures: int = 3
+    # base backoff between FAILED rebuild attempts (doubles per failure,
+    # capped at 60s; the first rebuild try after quarantine is immediate)
+    replica_quarantine_backoff_s: float = 0.5
+    # failed rebuild attempts beyond this budget idle at the max backoff
+    replica_rebuild_budget: int = 3
+    # grace given to an error-rate-quarantined (still working) replica's
+    # in-flight requests before its rebuild swaps the service out
+    replica_rebuild_drain_s: float = 5.0
+    # ReplicaSet-layer failover retries per request after a replica dies
+    # under it (PR 5's crash retry budget, lifted across replicas)
+    replica_failover_budget: int = 1
 
     @classmethod
     def from_env(cls) -> "ServeConfig":
@@ -431,6 +456,32 @@ class ServeConfig:
             tenant_burst_tokens=_env_int(["TENANT_BURST_TOKENS"], 8192),
             tenant_headroom=_env_int(["TENANT_HEADROOM"], -1),
             batch_shed_fraction=_env_float(["BATCH_SHED_FRACTION"], 0.8),
+            replica_supervise=_env_bool(["REPLICA_SUPERVISE"], True),
+            replica_probe_interval_s=_env_float(
+                ["REPLICA_PROBE_INTERVAL_S"], 0.25
+            ),
+            replica_breaker_window_s=_env_float(
+                ["REPLICA_BREAKER_WINDOW_S"], 30.0
+            ),
+            replica_breaker_error_rate=_env_float(
+                ["REPLICA_BREAKER_ERROR_RATE"], 0.5
+            ),
+            replica_breaker_min_samples=_env_int(
+                ["REPLICA_BREAKER_MIN_SAMPLES"], 4
+            ),
+            replica_breaker_tick_failures=_env_int(
+                ["REPLICA_BREAKER_TICK_FAILURES"], 3
+            ),
+            replica_quarantine_backoff_s=_env_float(
+                ["REPLICA_QUARANTINE_BACKOFF_S"], 0.5
+            ),
+            replica_rebuild_budget=_env_int(["REPLICA_REBUILD_BUDGET"], 3),
+            replica_rebuild_drain_s=_env_float(
+                ["REPLICA_REBUILD_DRAIN_S"], 5.0
+            ),
+            replica_failover_budget=_env_int(
+                ["REPLICA_FAILOVER_BUDGET"], 1
+            ),
         )
 
     def parsed_tenant_weights(self) -> dict[str, float]:

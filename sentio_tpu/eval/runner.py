@@ -237,8 +237,11 @@ def run_eval(
             )
             # the serving tier's front-end, N=1: eval measures the same
             # routed path production serves (a degenerate single-replica
-            # route is a pass-through, so config outputs stay pinned)
-            service = ReplicaSet([PagedGenerationService(paged)])
+            # route is a pass-through, so config outputs stay pinned).
+            # supervise=False: eval never closes the set, and a leaked
+            # supervisor thread would outlive the config run
+            service = ReplicaSet([PagedGenerationService(paged)],
+                                 supervise=False)
             generator = LLMGenerator(
                 provider=TpuProvider(engine=engine, service=service),
                 config=settings.generator,

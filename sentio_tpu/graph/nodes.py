@@ -208,12 +208,19 @@ def create_verifier_node(verifier, settings: Optional[Settings] = None):
         # same trace id as the generate node: the verify admission lands on
         # the same flight record, where its prefix_hit_tokens show the
         # generate prompt head being reused from the radix cache
-        request_id = state.get("metadata", {}).get("query_id")
+        meta = state.get("metadata", {})
+        request_id = meta.get("query_id")
         # the remaining deadline bounds the audit decode too — without it
         # the pump's expiry sweep could never cancel an expired caller's
         # verify slot (verifier soft-fails internally, so an expiry here
         # degrades to a 'warn' verdict rather than failing the answer)
         deadline = deadline_ts(state)
+        # WFQ tenant + priority: the verify admission is charged to the
+        # REQUESTING tenant, exactly like the generate admission — verify
+        # traffic riding the shared tenant would let one tenant's verify
+        # load starve every other tenant's quota for free
+        tenant = meta.get("tenant")
+        priority = meta.get("priority")
         t0 = time.perf_counter()
         result = await asyncio.get_running_loop().run_in_executor(
             None,
@@ -221,6 +228,8 @@ def create_verifier_node(verifier, settings: Optional[Settings] = None):
                 state["query"], answer, docs,
                 request_id=str(request_id) if request_id else None,
                 deadline_ts=deadline,
+                tenant=str(tenant) if tenant else None,
+                priority=str(priority) if priority else None,
             ),
         )
         update: dict[str, Any] = {

@@ -172,6 +172,27 @@ class CircuitOpenError(ServiceUnavailableError):
     code = ErrorCode.CIRCUIT_OPEN
 
 
+class ReplicaUnavailable(ServiceUnavailableError):
+    """The decode replica (or every replica in the set) is out of rotation:
+    the engine latched broken after a failed reset, the service was closed,
+    or the supervisor has quarantined it for rebuild. Carries
+    ``retry_after_s`` so handlers answer 503 + ``Retry-After`` — the
+    supervisor rebuilds replicas in place, so coming back IS worthwhile
+    (unlike an untyped 500, which tells the caller nothing)."""
+
+    code = ErrorCode.SERVICE_UNAVAILABLE
+    # a replica outage must surface as an honest 503 + Retry-After, not be
+    # swallowed by the degradation ladder into a 200 apology (same rule as
+    # ServiceOverloaded: the caller can act on a typed answer)
+    soft_fail_exempt = True
+
+    def __init__(self, message: str = "decode replica unavailable",
+                 retry_after_s: float = 5.0, **kw) -> None:
+        kw.setdefault("retryable", True)
+        super().__init__(message, **kw)
+        self.details.setdefault("retry_after_s", retry_after_s)
+
+
 class TimeoutError_(SentioError):
     code = ErrorCode.TIMEOUT
 

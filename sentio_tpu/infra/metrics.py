@@ -209,6 +209,14 @@ class MetricsCollector:
                 "per-replica decode service point-in-time stats",
                 ["replica", "stat"], registry=r,
             ),
+            # replica failure domains (runtime/replica.py supervisor): 1 on
+            # the replica's CURRENT health state, 0 on the other three —
+            # monitoring.yaml alerts on any replica out of HEALTHY > 60s
+            "replica_health": Gauge(
+                "sentio_tpu_replica_health",
+                "replica health state machine position (1 = current state)",
+                ["replica", "state"], registry=r,
+            ),
         }
 
     # ------------------------------------------------------------- recording
@@ -323,6 +331,21 @@ class MetricsCollector:
         gauge = self._prom.get("replica_stat")
         if gauge is not None:
             gauge.labels(replica=str(replica), stat=key).set(value)
+
+    def record_replica_health(self, replica: int, state: str) -> None:
+        """Publish one replica's health-state transition: the new state's
+        series goes to 1 and every other state's to 0, so
+        ``sentio_tpu_replica_health{replica="K"}`` always sums to 1 and a
+        dashboard can plot the machine's position directly."""
+        from sentio_tpu.runtime.replica import HEALTH_STATES
+
+        for name in HEALTH_STATES:
+            value = 1.0 if name == state else 0.0
+            self.memory.set_gauge("replica_health", (str(replica), name),
+                                  value)
+            gauge = self._prom.get("replica_health")
+            if gauge is not None:
+                gauge.labels(replica=str(replica), state=name).set(value)
 
     def record_breaker(self, name: str, state: str) -> None:
         value = {"closed": 0.0, "half_open": 1.0, "open": 2.0}.get(state, 0.0)

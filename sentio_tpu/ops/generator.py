@@ -548,14 +548,19 @@ class LLMGenerator:
 
     def chat_raw(self, prompt: str, max_new_tokens: int, temperature: float,
                  request_id: Optional[str] = None,
-                 deadline_ts: Optional[float] = None) -> str:
+                 deadline_ts: Optional[float] = None,
+                 tenant: Optional[str] = None,
+                 priority: Optional[str] = None) -> str:
         """Direct provider access (verifier path — shares the weights). A
         ``request_id`` ties the call into the flight recorder, so the
         verify node's engine admission shows up on the same trace as the
-        generate node's."""
+        generate node's; ``tenant``/``priority`` charge the verify decode
+        to the REQUESTING tenant's WFQ quota instead of the shared default
+        (a tenant's verify traffic must not ride free and starve others)."""
         return self.provider.chat(
             prompt, max_new_tokens=max_new_tokens, temperature=temperature,
-            **self._trace_kwargs("chat", request_id, deadline_ts),
+            **self._trace_kwargs("chat", request_id, deadline_ts,
+                                 tenant, priority),
         )
 
 

@@ -53,6 +53,8 @@ class AnswerVerifier:
         documents: Sequence[Document],
         request_id: Optional[str] = None,
         deadline_ts: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
     ) -> VerifyResult:
         try:
             # the audit prompt EMBEDS the generate prompt verbatim as its
@@ -69,13 +71,18 @@ class AnswerVerifier:
                 answer=answer,
             )
             # the caller's deadline bounds the audit decode too — an
-            # expired caller's verification is cancelled like its generation
+            # expired caller's verification is cancelled like its
+            # generation — and the audit admission is charged to the
+            # caller's WFQ tenant (a flooding tenant's verify traffic
+            # competes inside ITS quota, not against everyone)
             reply = self.generator.chat_raw(
                 prompt,
                 max_new_tokens=self.config.verifier_max_tokens,
                 temperature=0.0,
                 request_id=request_id,
                 deadline_ts=deadline_ts,
+                tenant=tenant,
+                priority=priority,
             )
             return self._normalize(reply)
         except Exception as exc:  # noqa: BLE001 — the audit must never 500

@@ -1024,6 +1024,10 @@ class ContinuousBatchingEngine:
         weights and compiled programs are kept."""
         if self._san is not None:
             self._san.enter("reset")
+        # chaos seam: lets drills force the reset itself to fail (the path
+        # that latches a service _broken and quarantines a replica) —
+        # previously reachable only implicitly through a re-armed paged.step
+        faults.hit("engine.reset")
         import jax
 
         self.pool = init_pool(
@@ -1049,6 +1053,55 @@ class ContinuousBatchingEngine:
         self._top_ks[:] = 0
         self._last_tok[:] = 0
         self._rng = jax.random.PRNGKey(int(np.random.default_rng().integers(2**31)))
+
+    # FamilyFn instances owned by THIS engine (fresh jit wrappers per
+    # engine): the pump's per-engine compile attribution and the rebuild
+    # path's fence exemption both iterate exactly these attributes
+    FAMILY_ATTRS = ("_step_n", "_merge_admitted", "_prefill_scatter",
+                    "_prior_prefill_scatter", "_draft_prefill", "_spec_tick")
+
+    def set_fence_exempt(self, exempt: bool) -> None:
+        """Mark this engine's own jit families exempt from (or again subject
+        to) an armed compile fence. A supervised in-place rebuild constructs
+        a FRESH engine whose families are all cold — its warmup compiles are
+        expected and must not trip the fence, while a steady-state recompile
+        on any sibling replica's engine still does (the exemption is scoped
+        to these instances, not global)."""
+        for attr in self.FAMILY_ATTRS:
+            fn = getattr(self, attr, None)
+            if fn is not None and hasattr(fn, "fence_exempt"):
+                fn.fence_exempt = bool(exempt)
+
+    def spawn_fresh(self) -> "ContinuousBatchingEngine":
+        """A brand-new engine sharing ONLY this engine's immutable state
+        (weights, tokenizer, config) — private pool, allocator, radix tree,
+        slots, and jit wrappers. The replica supervisor's in-place rebuild
+        path: when ``reset()`` itself failed, the old engine's device
+        buffers are unrecoverable and the only safe move is a clean
+        re-instantiation from the shared weights (the same constructor path
+        serve/dependencies.py uses to build replicas at startup)."""
+        return ContinuousBatchingEngine(
+            model_config=self.cfg,
+            params=self.params,
+            tokenizer=self.tokenizer,
+            max_slots=self.max_slots,
+            page_size=self.page_size,
+            num_pages=self.allocator.num_pages,
+            max_pages_per_seq=self.max_pages_per_seq,
+            use_pallas=self._attn_impl is not None,
+            steps_per_tick=self.steps_per_tick,
+            max_tick_steps=self.max_tick_steps,
+            ignore_eos=self.ignore_eos,
+            pipeline_depth=self.pipeline_depth,
+            mesh=self.mesh,
+            forward_fn=self.forward_fn,
+            kv_quant=self.kv_quant,
+            prefill_chunk=self.prefill_chunk,
+            draft_params=self.draft_params,
+            draft_config=self.draft_cfg,
+            spec_k=self.spec_k,
+            prefix_cache=self._prefix_cache_enabled,
+        )
 
     @property
     def has_work(self) -> bool:

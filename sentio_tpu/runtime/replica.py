@@ -40,10 +40,40 @@ deliberate difference is the WFQ headroom, which sheds a lone flooding
 tenant slightly before the absolute queue bound so fairness is available
 the instant a second tenant shows up.
 
+**Replica failure domains** — each replica is an independent failure
+domain with a supervised health state machine::
+
+    HEALTHY → DEGRADED → QUARANTINED → REBUILDING → HEALTHY
+
+* the router never selects a QUARANTINED/REBUILDING replica, and DEGRADED
+  replicas take traffic only when no HEALTHY replica has queue headroom;
+* a per-replica breaker trips to QUARANTINED on the service's latched
+  ``broken`` flag (failed tick whose ``engine.reset()`` also failed), on a
+  burst of tick failures inside a sliding window, or on a caller-observed
+  error rate over the same window;
+* a supervisor thread rebuilds quarantined replicas **in place**: fresh
+  engine + pool + radix + pump from the shared weights
+  (``engine.spawn_fresh()``, the same constructor path the serving
+  container uses), re-warmed — under an armed compile fence the NEW
+  engine's cold compiles are instance-scoped exempt while steady-state
+  recompiles elsewhere still trip — and only then swapped back into
+  rotation;
+* callers **fail over**: a generate (or a stream that has not yet
+  delivered tokens) that dies with a replica-infrastructure failure is
+  re-admitted (WFQ released, then re-charged — failover never
+  double-counts quota) and re-routed to a surviving replica, bounded by a
+  per-request failover budget. Streams with delivered tokens stay
+  non-resumable and surface a typed error.
+
+Health transitions emit flight-recorder events and the
+``sentio_tpu_replica_health{replica,state}`` gauge; ``health_summary()``
+feeds ``/health`` so an N-replica pod reports ``degraded`` (keep routing)
+rather than ``unhealthy`` (restart me) while at least one replica serves.
+
 Threading: routing probes (``peek_prefix``, ``backlog``, ``projected_wait``)
 are advisory reads against live replicas; all ReplicaSet/TenantFairQueue
 mutable state sits behind one mutex held only for quick bookkeeping — never
-across a generate call or a device tick.
+across a generate call, a device tick, or a rebuild.
 """
 
 from __future__ import annotations
@@ -51,11 +81,13 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from dataclasses import dataclass
+from collections import deque
+from dataclasses import dataclass, field
 from typing import Iterator, Optional, Sequence
 
 from sentio_tpu.analysis.sanitizer import assert_held, make_lock
-from sentio_tpu.infra.exceptions import ServiceOverloaded
+from sentio_tpu.infra import faults
+from sentio_tpu.infra.exceptions import ReplicaUnavailable, ServiceOverloaded
 from sentio_tpu.infra.metrics import get_metrics
 from sentio_tpu.runtime.service import PagedGenerationService
 
@@ -67,11 +99,46 @@ __all__ = [
     "DEFAULT_TENANT",
     "PRIORITY_INTERACTIVE",
     "PRIORITY_BATCH",
+    "HEALTH_HEALTHY",
+    "HEALTH_DEGRADED",
+    "HEALTH_QUARANTINED",
+    "HEALTH_REBUILDING",
+    "HEALTH_STATES",
 ]
 
 DEFAULT_TENANT = "shared"
 PRIORITY_INTERACTIVE = "interactive"
 PRIORITY_BATCH = "batch"
+
+# replica health state machine (see module docstring); values are the
+# /metrics label and the flight-recorder event vocabulary
+HEALTH_HEALTHY = "HEALTHY"
+HEALTH_DEGRADED = "DEGRADED"
+HEALTH_QUARANTINED = "QUARANTINED"
+HEALTH_REBUILDING = "REBUILDING"
+HEALTH_STATES = (HEALTH_HEALTHY, HEALTH_DEGRADED, HEALTH_QUARANTINED,
+                 HEALTH_REBUILDING)
+
+
+@dataclass
+class _ReplicaHealth:
+    """Supervision book-keeping for one replica. All fields guarded by the
+    owning ReplicaSet's ``_mutex`` (the dataclass never escapes the lock;
+    the supervisor and caller paths both mutate it under that mutex)."""
+
+    state: str = HEALTH_HEALTHY
+    since: float = 0.0            # perf_counter of the last transition
+    last_reason: str = ""
+    # caller-observed outcomes: (perf_counter ts, ok) within the breaker
+    # window — replica-infrastructure failures only, never policy sheds
+    outcomes: deque = field(default_factory=lambda: deque(maxlen=512))
+    # perf_counter stamps of observed tick-failure increments
+    tick_fails: deque = field(default_factory=lambda: deque(maxlen=64))
+    ticks_seen: int = 0           # service tick_failure counter baseline
+    quarantined_at: float = 0.0
+    next_rebuild_at: float = 0.0  # earliest perf_counter for a rebuild try
+    rebuild_attempts: int = 0     # failed attempts THIS quarantine episode
+    rebuilds: int = 0             # lifetime successful in-place rebuilds
 
 
 @dataclass
@@ -308,11 +375,25 @@ class ReplicaSet:
         batch_shed_fraction: float = 0.8,
         affinity_stickiness: float = 4.0,
         route_prefix_tokens: int = 512,
+        supervise: bool = True,
+        probe_interval_s: float = 0.25,
+        breaker_window_s: float = 30.0,
+        breaker_error_rate: float = 0.5,
+        breaker_min_samples: int = 4,
+        breaker_tick_failures: int = 3,
+        quarantine_backoff_s: float = 0.5,
+        rebuild_budget: int = 3,
+        rebuild_drain_s: float = 5.0,
+        failover_budget: int = 1,
     ) -> None:
         services = list(services)
         if not services:
             raise ValueError("ReplicaSet needs at least one replica")
         self._check_isolation(services)
+        # element SWAPS (supervised rebuild) happen under _mutex; reads are
+        # deliberately lock-free GIL-atomic list indexing — a caller that
+        # grabbed the old replica mid-swap gets a typed failure and fails
+        # over, which is cheaper than locking every routing probe
         self._services = services
         for i, svc in enumerate(services):
             svc.replica_id = i
@@ -345,6 +426,42 @@ class ReplicaSet:
         self._routed_affinity = 0  # guarded-by: _mutex
         self._routed_load = 0  # guarded-by: _mutex
         self._affinity_overflow = 0  # guarded-by: _mutex
+        # ---- replica supervision (failure domains) ----
+        self.probe_interval_s = max(float(probe_interval_s), 0.01)
+        self.breaker_window_s = max(float(breaker_window_s), 0.1)
+        self.breaker_error_rate = min(max(float(breaker_error_rate), 0.0), 1.0)
+        self.breaker_min_samples = max(int(breaker_min_samples), 1)
+        self.breaker_tick_failures = max(int(breaker_tick_failures), 1)
+        self.quarantine_backoff_s = max(float(quarantine_backoff_s), 0.0)
+        # failed rebuild attempts beyond this budget fall back to the max
+        # backoff (the supervisor never gives up — a replica stuck broken
+        # just retries slowly instead of hot-looping expensive rebuilds)
+        self.rebuild_budget = max(int(rebuild_budget), 0)
+        self.rebuild_drain_s = max(float(rebuild_drain_s), 0.0)
+        # ReplicaSet-layer retry budget for failed-over requests (PR 5's
+        # per-ticket crash retry budget, lifted across replicas)
+        self.failover_budget = max(int(failover_budget), 0)
+        self._health = [
+            _ReplicaHealth(since=time.perf_counter(),
+                           # baseline, not zero: pre-existing tick failures
+                           # on a reused engine must not instantly trip the
+                           # burst breaker
+                           ticks_seen=svc.tick_failure_count)
+            for svc in services
+        ]  # guarded-by: _mutex
+        self._failovers = 0  # guarded-by: _mutex
+        self._closed = False  # guarded-by: _mutex
+        metrics = get_metrics()
+        for i in range(len(services)):
+            metrics.record_replica_health(i, HEALTH_HEALTHY)
+        self._stop = threading.Event()
+        self._supervisor: Optional[threading.Thread] = None
+        if supervise:
+            self._supervisor = threading.Thread(
+                target=self._supervise_loop, name="replica-supervisor",
+                daemon=True,
+            )
+            self._supervisor.start()
 
     @staticmethod
     def _check_isolation(services: Sequence[PagedGenerationService]) -> None:
@@ -390,17 +507,68 @@ class ReplicaSet:
             return []
         return list(toks[: self.route_prefix_tokens])
 
-    def _route(self, toks: Sequence[int], count: bool = True) -> tuple[int, int]:
-        """→ (replica index, predicted prefix-hit tokens). Stage 1: best
-        ``peek_prefix`` hit, sticky while that replica's backlog stays under
-        ``stickiness x max_slots``. Stage 2: least projected wait.
-        ``count=False`` for probes (check_admission): the SSE pre-check
-        routes the same request a second time and must not double-count the
-        routing-outcome telemetry."""
+    def _eligible(self, exclude: frozenset = frozenset()) -> list[int]:
+        """Replica indices the router may pick, by health: HEALTHY first;
+        DEGRADED replicas join only when every healthy replica's backlog is
+        at its admission bound (no headroom) — and carry the set alone when
+        no replica is HEALTHY. QUARANTINED/REBUILDING replicas are NEVER
+        eligible. Raises a typed :class:`ReplicaUnavailable` (503 +
+        Retry-After) when nothing can serve — the supervisor is rebuilding,
+        so retrying IS the right caller move."""
+        with self._mutex:
+            if self._closed:
+                # a closed set never heals: retryable=False so callers (and
+                # the wire layer) do not wait on a rebuild nobody will run
+                raise ReplicaUnavailable(
+                    "replica set is closed", retry_after_s=1.0,
+                    retryable=False,
+                )
+            states = [h.state for h in self._health]
+            retry_in = self._rebuild_eta_locked()
+        healthy = [i for i, s in enumerate(states)
+                   if s == HEALTH_HEALTHY and i not in exclude]
+        degraded = [i for i, s in enumerate(states)
+                    if s == HEALTH_DEGRADED and i not in exclude]
+        if healthy:
+            if degraded and all(
+                self._services[i].backlog() >= self._services[i].max_queue
+                for i in healthy
+            ):
+                return healthy + degraded
+            return healthy
+        if degraded:
+            return degraded
+        raise ReplicaUnavailable(
+            "no serving replica available (every replica is quarantined, "
+            "rebuilding, or already failed this request over)",
+            retry_after_s=max(retry_in, 1.0),
+            details={"replica_states": states},
+        )
+
+    def _rebuild_eta_locked(self) -> float:  # lock-held: _mutex
+        """Seconds until the next quarantined replica is due a rebuild try
+        — the honest Retry-After for an all-replicas-down shed."""
+        assert_held(self._mutex)
+        now = time.perf_counter()
+        etas = [h.next_rebuild_at - now for h in self._health
+                if h.state in (HEALTH_QUARANTINED, HEALTH_REBUILDING)]
+        return max(min(etas), 0.0) if etas else 1.0
+
+    def _route(self, toks: Sequence[int], count: bool = True,
+               exclude: frozenset = frozenset()) -> tuple[int, int]:
+        """→ (replica index, predicted prefix-hit tokens). Stage 0: filter
+        to health-eligible replicas (minus ``exclude``, the replicas a
+        failing-over request already tried). Stage 1: best ``peek_prefix``
+        hit, sticky while that replica's backlog stays under ``stickiness x
+        max_slots``. Stage 2: least projected wait. ``count=False`` for
+        probes (check_admission): the SSE pre-check routes the same request
+        a second time and must not double-count the routing-outcome
+        telemetry."""
+        eligible = self._eligible(exclude)
         best_i, best_hit = -1, 0
-        if len(self._services) > 1 and toks:
-            for i, svc in enumerate(self._services):
-                hit = svc.engine.peek_prefix(toks)
+        if len(eligible) > 1 and toks:
+            for i in eligible:
+                hit = self._services[i].engine.peek_prefix(toks)
                 if hit > best_hit:
                     best_i, best_hit = i, hit
         if best_hit > 0:
@@ -415,17 +583,24 @@ class ReplicaSet:
                 with self._mutex:
                     self._affinity_overflow += 1
 
-        def load_key(pair):
-            i, svc = pair
+        def load_key(i):
+            svc = self._services[i]
             return (svc.projected_wait() or 0.0, svc.backlog(), i)
 
-        idx = min(enumerate(self._services), key=load_key)[0]
+        idx = min(eligible, key=load_key)
         if count:
             with self._mutex:
                 self._routed_load += 1
         return idx, 0
 
     # ------------------------------------------------------------------ api
+
+    @staticmethod
+    def _is_replica_failure(exc: BaseException) -> bool:
+        """Failures that indict the REPLICA (its engine broke, its service
+        closed under it) rather than the request (sheds, deadlines,
+        validation) — only these are worth failing over."""
+        return isinstance(exc, ReplicaUnavailable)
 
     def generate(
         self,
@@ -440,29 +615,65 @@ class ReplicaSet:
         tenant: Optional[str] = None,
         priority: str = PRIORITY_INTERACTIVE,
     ):
+        """Route + delegate, with cross-replica failover: a replica that
+        dies under this request (typed ReplicaUnavailable, or the
+        finish_reason='error' result a crashed pump hands its waiters) is
+        reported to the breaker and — within ``failover_budget`` — the
+        request is re-admitted and re-routed to a surviving replica. The
+        WFQ reservation is fully released before each retry re-charges, so
+        failover can never double-count a tenant's quota."""
         toks = self._route_tokens(prompt)
         cost = len(toks) + max_new_tokens
-        charged = self.tenants.admit(tenant or DEFAULT_TENANT, cost,
-                                     priority=priority)
-        try:
-            idx, _hit = self._route(toks)
-            result = self._services[idx].generate(
-                prompt, max_new_tokens=max_new_tokens,
-                temperature=temperature, timeout_s=timeout_s,
-                request_id=request_id, deadline_s=deadline_s,
-                deadline_ts=deadline_ts, top_k=top_k,
+        tenant_key = tenant or DEFAULT_TENANT
+        attempts = 0
+        tried: set[int] = set()
+        while True:
+            charged = self.tenants.admit(tenant_key, cost, priority=priority)
+            idx = svc = None
+            try:
+                idx, _hit = self._route(toks, exclude=frozenset(tried))
+                svc = self._services[idx]
+                result = svc.generate(
+                    prompt, max_new_tokens=max_new_tokens,
+                    temperature=temperature, timeout_s=timeout_s,
+                    request_id=request_id, deadline_s=deadline_s,
+                    deadline_ts=deadline_ts, top_k=top_k,
+                )
+            except BaseException as exc:
+                # failed before (shed) or during decode: refund the
+                # estimated debit — charging full cost for work that never
+                # ran would let replica-level sheds drain an innocent
+                # tenant's deficit
+                self.tenants.release(charged, cost, actual_tokens=0)
+                if idx is not None and self._is_replica_failure(exc):
+                    self._note_failure(idx, exc, svc)
+                    tried.add(idx)
+                    if attempts < self.failover_budget:
+                        attempts += 1
+                        with self._mutex:
+                            self._failovers += 1
+                        continue  # re-admits (re-charges) at the loop top
+                raise
+            if result.finish_reason == "error":
+                # the crashed pump's budget-exhausted waiter surface: the
+                # request itself never misbehaved, so it is resumable here
+                self._note_failure(
+                    idx, ReplicaUnavailable("error result from replica"),
+                    svc)
+                tried.add(idx)
+                if attempts < self.failover_budget:
+                    self.tenants.release(charged, cost, actual_tokens=0)
+                    attempts += 1
+                    with self._mutex:
+                        self._failovers += 1
+                    continue
+            else:
+                self._note_success(idx, svc)
+            self.tenants.release(
+                charged, cost,
+                actual_tokens=result.prompt_tokens + len(result.tokens),
             )
-        except BaseException:
-            # failed before (shed) or during decode: refund the estimated
-            # debit — charging full cost for work that never ran would let
-            # replica-level sheds drain an innocent tenant's deficit
-            self.tenants.release(charged, cost, actual_tokens=0)
-            raise
-        self.tenants.release(
-            charged, cost,
-            actual_tokens=result.prompt_tokens + len(result.tokens),
-        )
-        return result
+            return result
 
     def generate_stream(
         self,
@@ -479,27 +690,58 @@ class ReplicaSet:
     ) -> Iterator[str]:
         toks = self._route_tokens(prompt)
         idx, _hit = self._route(toks)
+        kwargs = dict(
+            max_new_tokens=max_new_tokens, temperature=temperature,
+            timeout_s=timeout_s, request_id=request_id,
+            deadline_s=deadline_s, deadline_ts=deadline_ts, top_k=top_k,
+        )
         # the replica's own generate_stream runs its CALL-time validation
         # (top_k vs paged speculation) here, before any SSE 200 commits;
         # its admission — and our tenant reservation — stay deferred to the
         # first next(), the long-standing stream contract
-        inner = self._services[idx].generate_stream(
-            prompt, max_new_tokens=max_new_tokens, temperature=temperature,
-            timeout_s=timeout_s, request_id=request_id,
-            deadline_s=deadline_s, deadline_ts=deadline_ts, top_k=top_k,
-        )
-        return self._stream_impl(inner, tenant or DEFAULT_TENANT,
+        svc = self._services[idx]
+        inner = svc.generate_stream(prompt, **kwargs)
+        return self._stream_impl(inner, idx, svc, toks, prompt, kwargs,
+                                 tenant or DEFAULT_TENANT,
                                  len(toks) + max_new_tokens, priority)
 
-    def _stream_impl(self, inner: Iterator[str], tenant: str, cost: int,
+    def _stream_impl(self, inner: Iterator[str], idx: int, svc,
+                     toks: Sequence[int], prompt: str, kwargs: dict,
+                     tenant: str, cost: int,
                      priority: str) -> Iterator[str]:
-        charged = self.tenants.admit(tenant, cost, priority=priority)
-        try:
-            yield from inner
-        finally:
-            # streams release at close/exhaust/error with the estimate —
-            # the exact split is not worth holding the reservation open for
-            self.tenants.release(charged, cost)
+        attempts = 0
+        tried = {idx}
+        while True:
+            charged = self.tenants.admit(tenant, cost, priority=priority)
+            delivered = False
+            try:
+                for piece in inner:
+                    delivered = True
+                    yield piece
+                self.tenants.release(charged, cost)
+                self._note_success(idx, svc)
+                return
+            except BaseException as exc:
+                # streams release at close/exhaust/error with the estimate —
+                # the exact split is not worth holding the reservation open
+                self.tenants.release(charged, cost)
+                if self._is_replica_failure(exc):
+                    self._note_failure(idx, exc, svc)
+                    # delivered tokens make a stream non-resumable (replay
+                    # would duplicate output): the typed error propagates
+                    if not delivered and attempts < self.failover_budget:
+                        tried.add(idx)
+                        attempts += 1
+                        with self._mutex:
+                            self._failovers += 1
+                        # may itself raise typed ReplicaUnavailable when no
+                        # survivor exists — still a typed terminal outcome
+                        idx, _hit = self._route(toks,
+                                                exclude=frozenset(tried))
+                        svc = self._services[idx]
+                        inner = svc.generate_stream(prompt, **kwargs)
+                        continue
+                raise
 
     def check_admission(
         self,
@@ -512,14 +754,311 @@ class ReplicaSet:
         WFQ tenant check first (peek mode), then the target replica's own
         admission check. With a ``prompt`` the probe routes exactly as the
         submit will; without one it checks the least-loaded replica (if
-        that one sheds, every routing choice would)."""
+        that one sheds, every routing choice would). With every replica
+        quarantined the routing stage itself raises the typed 503."""
         self.tenants.admit(tenant or DEFAULT_TENANT, 0, priority=priority,
                            reserve=False)
         toks = self._route_tokens(prompt) if prompt else []
         idx, _hit = self._route(toks, count=False)
         self._services[idx].check_admission(deadline_ts)
 
+    # ---------------------------------------------------------- supervision
+
+    def _transition(self, idx: int, state: str, reason: str = "") -> bool:
+        """Move replica ``idx`` to ``state`` (no-op if already there),
+        emitting the flight-recorder event + health gauge + log line every
+        operator surface shares. Returns whether a transition happened."""
+        with self._mutex:
+            health = self._health[idx]
+            prev = health.state
+            if prev == state:
+                return False
+            health.state = state
+            health.since = time.perf_counter()
+            health.last_reason = reason
+        logger.warning("replica %d health %s -> %s (%s)",
+                       idx, prev, state, reason or "n/a")
+        try:  # telemetry is best-effort; supervision must not die on it
+            get_metrics().record_replica_health(idx, state)
+            from sentio_tpu.infra.flight import get_flight_recorder
+
+            get_flight_recorder().record_tick(
+                event="replica_health", replica=idx,
+                state_from=prev, state_to=state, reason=reason[:200],
+            )
+        except Exception:  # noqa: BLE001
+            logger.debug("health transition telemetry failed", exc_info=True)
+        return True
+
+    def _note_success(self, idx: int, svc=None) -> None:
+        with self._mutex:
+            if idx >= len(self._health):
+                return
+            if svc is not None and self._services[idx] is not svc:
+                return  # slot was rebuilt under this request; stale sample
+            self._health[idx].outcomes.append((time.perf_counter(), True))
+
+    def _note_failure(self, idx: int, exc: BaseException, svc=None) -> None:
+        """Caller-observed replica-infrastructure failure: feed the breaker
+        window and, when the service has LATCHED broken (reset failed — it
+        can never recover by itself), quarantine immediately instead of
+        waiting for the next supervisor pass; by backlog a corpse looks
+        least-loaded, so every poll-interval of delay re-routes live
+        traffic into it. ``svc`` is the service object the caller actually
+        talked to: if the slot has since been rebuilt (swap under _mutex),
+        the outcome belongs to the DEAD incarnation and is dropped — a
+        straggler's failure must not demote the fresh replica."""
+        now = time.perf_counter()
+        with self._mutex:
+            if self._closed or idx >= len(self._health):
+                return  # shutdown churn is not a health signal
+            current = self._services[idx]
+            if svc is not None and current is not svc:
+                return  # failure observed on a replaced incarnation
+            health = self._health[idx]
+            health.outcomes.append((now, False))
+            state = health.state
+        if state in (HEALTH_QUARANTINED, HEALTH_REBUILDING):
+            return
+        if getattr(current, "broken", False) or getattr(current, "closed",
+                                                        False):
+            self._quarantine(idx, f"replica latched unavailable: {exc}")
+
+    def _quarantine(self, idx: int, reason: str) -> None:
+        now = time.perf_counter()
+        with self._mutex:
+            health = self._health[idx]
+            if health.state in (HEALTH_QUARANTINED, HEALTH_REBUILDING):
+                return
+            health.quarantined_at = now
+            health.rebuild_attempts = 0
+            # first rebuild try is immediate (next supervisor pass); the
+            # exponential backoff applies to FAILED rebuild attempts
+            health.next_rebuild_at = now
+        self._transition(idx, HEALTH_QUARANTINED, reason)
+
+    def _prune_locked(self, series: deque, now: float) -> None:  # lock-held: _mutex
+        assert_held(self._mutex)
+        horizon = now - self.breaker_window_s
+        while series and series[0][0] < horizon:
+            series.popleft()
+
+    def _supervise_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            try:
+                self._supervise_once()
+            except Exception:  # noqa: BLE001 — the supervisor must survive
+                logger.exception("replica supervision pass failed")
+
+    def _supervise_once(self) -> None:
+        """One breaker + rebuild pass over every replica (also directly
+        callable by tests for deterministic stepping). Breakers for ALL
+        replicas are evaluated BEFORE any rebuild runs: a rebuild is
+        seconds-to-minutes of drain + compile, and a sibling replica's trip
+        must not wait behind it within the pass (it still waits between
+        passes — the supervisor is one thread; see ROADMAP)."""
+        now = time.perf_counter()
+        rebuild_ready: list[int] = []
+        for idx in range(len(self._services)):
+            svc = self._services[idx]
+            with self._mutex:
+                health = self._health[idx]
+                state = health.state
+                if state in (HEALTH_HEALTHY, HEALTH_DEGRADED):
+                    # tick-failure burst: fold counter growth into the
+                    # window (each increment is one failed decode tick)
+                    count = None
+                    try:
+                        count = svc.tick_failure_count
+                    except Exception:  # noqa: BLE001 — service mid-swap
+                        pass
+                    if count is not None:
+                        for _ in range(max(count - health.ticks_seen, 0)):
+                            health.tick_fails.append((now, False))
+                        health.ticks_seen = max(count, health.ticks_seen)
+                    self._prune_locked(health.tick_fails, now)
+                    self._prune_locked(health.outcomes, now)
+                    burst = len(health.tick_fails)
+                    fails = sum(1 for _, ok in health.outcomes if not ok)
+                    samples = len(health.outcomes)
+                rebuild_due = (state == HEALTH_QUARANTINED
+                               and now >= health.next_rebuild_at)
+            if state in (HEALTH_QUARANTINED, HEALTH_REBUILDING):
+                if rebuild_due:
+                    rebuild_ready.append(idx)
+                continue
+            if getattr(svc, "broken", False):
+                self._quarantine(idx, "engine latched broken (reset failed)")
+            elif burst >= self.breaker_tick_failures:
+                self._quarantine(
+                    idx, f"{burst} tick failures inside "
+                         f"{self.breaker_window_s:.0f}s window")
+            elif (samples >= self.breaker_min_samples
+                  and fails / samples >= self.breaker_error_rate):
+                self._quarantine(
+                    idx, f"error rate {fails}/{samples} over "
+                         f"{self.breaker_window_s:.0f}s window")
+            elif fails > 0 or burst > 0:
+                self._transition(
+                    idx, HEALTH_DEGRADED,
+                    f"{fails} caller failures / {burst} tick failures "
+                    "in window")
+            elif state == HEALTH_DEGRADED:
+                self._transition(idx, HEALTH_HEALTHY, "window clean")
+        for idx in rebuild_ready:
+            if not self._stop.is_set():
+                self._rebuild(idx)
+
+    def _rebuild(self, idx: int) -> bool:
+        """In-place rebuild of a quarantined replica: fresh engine + pool +
+        radix + pump from the shared weights, re-warmed, then swapped back
+        into rotation. Runs on the supervisor thread (or a test driver) —
+        never under ``_mutex``, since it compiles and decodes."""
+        with self._mutex:
+            attempt = self._health[idx].rebuild_attempts + 1
+        self._transition(idx, HEALTH_REBUILDING, f"rebuild attempt {attempt}")
+        fresh: Optional[PagedGenerationService] = None
+        try:
+            faults.hit("replica.rebuild")
+            old = self._services[idx]
+            if not getattr(old, "closed", False):
+                try:
+                    # error-rate quarantines leave a WORKING service: give
+                    # its in-flight callers a bounded window to finish
+                    # before the swap orphans them
+                    old.drain(self.rebuild_drain_s)
+                except Exception:  # noqa: BLE001 — drain is best-effort
+                    logger.warning("replica %d pre-rebuild drain failed",
+                                   idx, exc_info=True)
+            engine = old.engine.spawn_fresh()
+            guard = getattr(engine, "_san", None)
+            if guard is not None:
+                guard.name = f"ContinuousBatchingEngine[r{idx}]"
+            fresh = PagedGenerationService(
+                engine,
+                default_timeout_s=old.default_timeout_s,
+                max_queue=old.max_queue,
+                default_deadline_s=old.default_deadline_s,
+                retry_budget=old.retry_budget,
+                replica_id=idx,
+            )
+            self._warm_rebuilt(fresh)
+            if self._stop.is_set():
+                # the set is shutting down: never swap a live pump into a
+                # closing rotation
+                fresh.close()
+                return False
+            with self._mutex:
+                self._services[idx] = fresh
+                health = self._health[idx]
+                health.outcomes.clear()
+                health.tick_fails.clear()
+                health.ticks_seen = 0
+                health.rebuild_attempts = 0
+                health.rebuilds += 1
+            self._transition(idx, HEALTH_HEALTHY, "rebuilt in place")
+            return True
+        except Exception as exc:  # noqa: BLE001 — rebuild retries on backoff
+            logger.exception("replica %d rebuild failed", idx)
+            if fresh is not None:
+                # the half-built service never entered rotation: close it
+                # (pump + engine pool), or every failed attempt would stack
+                # another live KV pool until the device OOMs
+                try:
+                    fresh.close()
+                except Exception:  # noqa: BLE001 — already on the error path
+                    logger.warning("replica %d failed-rebuild cleanup "
+                                   "failed", idx, exc_info=True)
+            now = time.perf_counter()
+            with self._mutex:
+                health = self._health[idx]
+                health.rebuild_attempts += 1
+                # exponential backoff per failed attempt; attempts past the
+                # rebuild budget idle at the max backoff (keep trying, slowly)
+                if health.rebuild_attempts > self.rebuild_budget:
+                    backoff = 60.0
+                else:
+                    backoff = min(
+                        self.quarantine_backoff_s
+                        * (2.0 ** (health.rebuild_attempts - 1)),
+                        60.0,
+                    )
+                health.next_rebuild_at = now + backoff
+            self._transition(idx, HEALTH_QUARANTINED,
+                             f"rebuild failed: {exc}")
+            return False
+
+    def _warm_rebuilt(self, fresh: PagedGenerationService) -> None:
+        """Warm a rebuilt replica before it re-enters rotation. Under an
+        ARMED compile fence the full warmup sweep runs with the NEW
+        engine's FamilyFn instances marked fence-exempt — its cold compiles
+        are expected and scoped to this rebuild, while a steady-state
+        recompile on any sibling replica still trips the fence throughout.
+        Without an armed fence a smoke generation suffices (later compiles
+        are legal, just slow)."""
+        from sentio_tpu.analysis.audit import fence
+
+        if fence.enabled() and fence.is_armed():
+            fresh.engine.set_fence_exempt(True)
+            try:
+                fresh.warmup()
+            finally:
+                fresh.engine.set_fence_exempt(False)
+        else:
+            result = fresh.generate("replica rebuild smoke probe",
+                                    max_new_tokens=2, temperature=0.0,
+                                    deadline_s=0, timeout_s=120.0)
+            if result.finish_reason == "error":
+                raise RuntimeError("rebuilt replica failed its smoke probe")
+
+    def health_summary(self) -> dict:
+        """Set-level health for ``/health``: ``healthy`` while every replica
+        is HEALTHY, ``degraded`` while at least one replica can serve
+        (HEALTHY or DEGRADED — k8s must keep routing to a half-alive pod,
+        not restart it), ``unhealthy`` only at zero serving replicas."""
+        with self._mutex:
+            replicas = [
+                {
+                    "replica": i,
+                    "state": h.state,
+                    "since_s": round(time.perf_counter() - h.since, 1),
+                    "rebuilds": h.rebuilds,
+                    **({"reason": h.last_reason} if h.last_reason else {}),
+                }
+                for i, h in enumerate(self._health)
+            ]
+        serving = sum(1 for r in replicas
+                      if r["state"] in (HEALTH_HEALTHY, HEALTH_DEGRADED))
+        healthy = sum(1 for r in replicas if r["state"] == HEALTH_HEALTHY)
+        if healthy == len(replicas):
+            status = "healthy"
+        elif serving >= 1:
+            status = "degraded"
+        else:
+            status = "unhealthy"
+        return {
+            "status": status,
+            "healthy_replicas": healthy,
+            "serving_replicas": serving,
+            "total_replicas": len(replicas),
+            "replicas": replicas,
+        }
+
     # ------------------------------------------------------------ lifecycle
+
+    def _stop_supervisor(self, timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        supervisor = self._supervisor
+        if supervisor is not None and supervisor.is_alive():
+            supervisor.join(timeout=timeout_s)
+            if supervisor.is_alive():
+                # a rebuild mid-flight can outlive the join window; it
+                # checks _stop before swapping and closes its fresh
+                # service, so the straggler is bounded — surface it
+                logger.warning(
+                    "replica supervisor did not exit within %.0fs "
+                    "(rebuild in flight?)", timeout_s,
+                )
 
     def warmup(self, max_new_tokens: int = 4) -> dict:
         """Warm EVERY replica CONCURRENTLY (each compiles its own jit
@@ -557,7 +1096,10 @@ class ReplicaSet:
     def drain(self, deadline_s: float = 30.0) -> dict:
         """Drain all replicas CONCURRENTLY: each gets the same wall-clock
         window (draining serially would give replica k only the deadline
-        minus its predecessors' spend). Aggregates drained/abandoned."""
+        minus its predecessors' spend). Aggregates drained/abandoned. The
+        supervisor stops FIRST so a mid-drain rebuild cannot swap a fresh
+        pump into a rotation that is shutting down."""
+        self._stop_supervisor()
         results: list[Optional[dict]] = [None] * len(self._services)
 
         def _drain(i: int, svc: PagedGenerationService) -> None:
@@ -582,6 +1124,11 @@ class ReplicaSet:
             if res is None:
                 res = {"drained": False, "abandoned": svc.backlog()}
             per.append({"replica": i, **res})
+        with self._mutex:
+            # every replica's drain ends in close(): the set is done — later
+            # submits get the non-retryable closed-set error instead of
+            # failover churn against corpses
+            self._closed = True
         return {
             "drained": all(r["drained"] for r in per),
             "abandoned": sum(r.get("abandoned", 0) for r in per),
@@ -589,6 +1136,9 @@ class ReplicaSet:
         }
 
     def close(self) -> None:
+        self._stop_supervisor()
+        with self._mutex:
+            self._closed = True
         for svc in self._services:
             try:
                 svc.close()
@@ -662,5 +1212,7 @@ class ReplicaSet:
                 "least_loaded": self._routed_load,
                 "affinity_overflow": self._affinity_overflow,
             }
+            agg["failovers"] = self._failovers
         agg["tenants"] = self.tenants.stats()
+        agg["health"] = self.health_summary()
         return agg

@@ -55,7 +55,11 @@ from sentio_tpu.analysis.sanitizer import (
     bind_engine_owner,
     make_lock,
 )
-from sentio_tpu.infra.exceptions import DeadlineExceededError, ServiceOverloaded
+from sentio_tpu.infra.exceptions import (
+    DeadlineExceededError,
+    ReplicaUnavailable,
+    ServiceOverloaded,
+)
 from sentio_tpu.infra.flight import get_flight_recorder
 from sentio_tpu.infra.metrics import get_metrics
 from sentio_tpu.runtime.paged import ContinuousBatchingEngine, PagedResult
@@ -67,6 +71,7 @@ __all__ = [
     "GenerationTimeout",
     "ServiceOverloaded",
     "DeadlineExceededError",
+    "ReplicaUnavailable",
 ]
 
 
@@ -334,7 +339,14 @@ class PagedGenerationService:
                 else:  # "done"
                     result: PagedResult = payload
                     if result.finish_reason == "error":
-                        raise RuntimeError("paged decode failed mid-stream")
+                        # typed: a stream that already delivered tokens is
+                        # non-resumable (replay would duplicate output), so
+                        # the caller's only move is a fresh request shortly
+                        raise ReplicaUnavailable(
+                            "paged decode failed mid-stream (stream is "
+                            "non-resumable)", retry_after_s=2.0,
+                            details={"replica": self.replica_id},
+                        )
                     emitted = list(result.tokens)  # authoritative final sequence
                 text = tokenizer.decode(emitted)
                 if kind == "done":
@@ -414,23 +426,55 @@ class PagedGenerationService:
                 len(self._inbox) + len(self._tickets)
             )
 
+    @property
+    def broken(self) -> bool:
+        """Latched after a failed tick whose ``engine.reset()`` ALSO failed:
+        the engine's device state is unrecoverable in place. A ReplicaSet
+        supervisor reads this as the trip-immediately breaker signal."""
+        with self._mutex:
+            return self._broken
+
+    @property
+    def closed(self) -> bool:
+        with self._mutex:
+            return self._closed
+
+    @property
+    def tick_failure_count(self) -> int:
+        """Lifetime failed decode ticks — the ReplicaSet supervisor's burst
+        breaker polls this (cheaper than a full stats() snapshot)."""
+        with self._mutex:
+            return self._tick_failures
+
     def check_admission(self, deadline_ts: Optional[float] = None) -> None:
         """Raise the shed error a submit right now would raise, WITHOUT
         enqueuing. The SSE path calls this before committing a 200 status
         line — after ``response.prepare`` a shed can only degrade, not 429."""
         with self._mutex:
-            if self._closed:
-                raise RuntimeError("generation service is closed")
-            if self._broken:
-                raise RuntimeError("paged decode engine is down (reset failed)")
+            self._check_available_locked()
             self._check_admission_locked(deadline_ts)
+
+    def _check_available_locked(self) -> None:  # lock-held: _mutex
+        """Closed / broken-engine admissions raise a TYPED 503 + Retry-After
+        (ReplicaUnavailable) instead of the old bare RuntimeError → 500: a
+        supervised replica rebuilds in place, so the honest answer to a
+        caller is \"retry shortly\", not \"internal error\"."""
+        assert_held(self._mutex)
+        if self._closed:
+            raise ReplicaUnavailable(
+                "generation service is closed", retry_after_s=5.0,
+                details={"replica": self.replica_id, "reason": "closed"},
+            )
+        if self._broken:
+            raise ReplicaUnavailable(
+                "paged decode engine is down (reset failed; awaiting "
+                "supervised rebuild)", retry_after_s=5.0,
+                details={"replica": self.replica_id, "reason": "broken"},
+            )
 
     def _admit_ticket_locked(self, ticket: _Ticket) -> None:  # lock-held: _mutex
         assert_held(self._mutex)
-        if self._closed:
-            raise RuntimeError("generation service is closed")
-        if self._broken:
-            raise RuntimeError("paged decode engine is down (reset failed)")
+        self._check_available_locked()
         self._check_admission_locked(ticket.deadline_ts)
         self._inbox.append(ticket)
         self._ensure_pump()
@@ -705,9 +749,7 @@ class PagedGenerationService:
             # second paged service in the same process must not be pinned
             # on an innocent tick of THIS pump
             total = 0
-            for attr in ("_step_n", "_merge_admitted", "_prefill_scatter",
-                         "_prior_prefill_scatter", "_draft_prefill",
-                         "_spec_tick"):
+            for attr in ContinuousBatchingEngine.FAMILY_ATTRS:
                 fn = getattr(self.engine, attr, None)
                 total += getattr(fn, "_seen", 0) or 0
             return total

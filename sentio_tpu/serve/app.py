@@ -583,7 +583,12 @@ async def clear(request: web.Request) -> web.Response:
 
 
 async def health(request: web.Request) -> web.Response:
-    return web.json_response(request.app["container"].health_handler.basic())
+    report = request.app["container"].health_handler.basic()
+    # "degraded" (1 ≤ healthy replicas < N) stays 200: the pod is serving
+    # at reduced capacity and the supervisor is rebuilding — a 503 here
+    # would make k8s restart a half-alive pod and lose the survivors too
+    status = 503 if report["status"] == "unhealthy" else 200
+    return web.json_response(report, status=status)
 
 
 async def health_detailed(request: web.Request) -> web.Response:
@@ -701,7 +706,9 @@ def _publish_serving_gauges(container: DependencyContainer):
                   # overload & crash-containment outcomes (lifetime totals;
                   # sentio_tpu_shed_total{reason} carries the fine labels)
                   "shed", "expired", "cancelled", "requeued",
-                  "tick_failures", "pump_leaked"):
+                  "tick_failures", "pump_leaked",
+                  # cross-replica failover retries (ReplicaSet layer)
+                  "failovers"):
         if event in stats:
             m.bump_serving_total(event, float(stats[event]))
     # multi-replica tier: the aggregate keeps every dashboard working; the

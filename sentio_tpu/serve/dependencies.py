@@ -366,6 +366,18 @@ class DependencyContainer:
                 batch_shed_fraction=serve.batch_shed_fraction,
                 affinity_stickiness=serve.affinity_stickiness,
                 route_prefix_tokens=serve.route_prefix_tokens,
+                # replica failure domains: breaker + supervised in-place
+                # rebuild + cross-replica failover (REPLICA_* env knobs)
+                supervise=serve.replica_supervise,
+                probe_interval_s=serve.replica_probe_interval_s,
+                breaker_window_s=serve.replica_breaker_window_s,
+                breaker_error_rate=serve.replica_breaker_error_rate,
+                breaker_min_samples=serve.replica_breaker_min_samples,
+                breaker_tick_failures=serve.replica_breaker_tick_failures,
+                quarantine_backoff_s=serve.replica_quarantine_backoff_s,
+                rebuild_budget=serve.replica_rebuild_budget,
+                rebuild_drain_s=serve.replica_rebuild_drain_s,
+                failover_budget=serve.replica_failover_budget,
             )
 
         return self._get("generation_service", build)

@@ -323,11 +323,31 @@ class HealthHandler:
         self._lock = asyncio.Lock()
 
     def basic(self) -> dict[str, Any]:
-        return {
+        """Cheap liveness-with-capacity view: replica failure domains fold
+        in here. ``degraded`` means ready at reduced capacity (1 ≤ serving
+        replicas < N — k8s must KEEP routing to this pod while the
+        supervisor rebuilds the dead replica in place); ``unhealthy`` only
+        when zero replicas can serve, the one state where restarting the
+        pod beats waiting."""
+        out = {
             "status": "healthy",
             "service": "sentio-tpu",
             "uptime_s": round(time.perf_counter() - self.container.started_at, 1),
         }
+        service = self.container.peek("generation_service")
+        if service is not None and hasattr(service, "health_summary"):
+            try:
+                replicas = service.health_summary()
+            except Exception:  # noqa: BLE001 — health must never 500
+                logger.debug("replica health summary failed", exc_info=True)
+            else:
+                out["status"] = replicas["status"]
+                out["replicas"] = {
+                    k: replicas[k]
+                    for k in ("healthy_replicas", "serving_replicas",
+                              "total_replicas", "replicas")
+                }
+        return out
 
     def live(self) -> dict[str, Any]:
         return {"status": "alive"}

@@ -1,0 +1,19 @@
+"""Seeded violation: replica-supervisor health state mutated lock-free.
+
+The ReplicaSet supervisor flips per-replica health states from its own
+thread while the router reads them on every submitter thread — a
+lock-free transition would let the router keep routing into a replica
+mid-quarantine. This fixture is the supervisor-shaped regression the
+lock checker must catch.
+"""
+import threading
+
+
+class BadSupervisor:
+    def __init__(self):
+        self._mutex = threading.Lock()
+        self._health = ["HEALTHY"]  # guarded-by: _mutex
+
+    def quarantine(self, idx):
+        self._health[idx] = "QUARANTINED"
+        return self._health[idx]

@@ -273,6 +273,48 @@ class TestCompileFence:
         fn.lower(jax.ShapeDtypeStruct((4,), np.float32))
         assert fence.compiles_total() == base
 
+    def test_instance_scoped_exemption_for_supervised_rebuild(self):
+        """A replica rebuild's fresh engine warms under an ARMED fence via
+        instance-scoped exemption: the exempt FamilyFn's cold compiles are
+        counted but not fatal, while a sibling (non-exempt) instance still
+        trips the fence throughout — steady-state recompiles stay loud."""
+
+        @jit_family("test.rebuilt", register=False)
+        def rebuilt(x):
+            return x + 1
+
+        @jit_family("test.sibling", register=False)
+        def sibling(x):
+            return x - 1
+
+        sibling(np.ones(3, np.float32))  # warmed before arming
+        fence.arm()
+        base = fence.compiles_total()
+        rebuilt.fence_exempt = True
+        rebuilt(np.ones(4, np.float32))  # cold compile: exempt, counted
+        assert fence.compiles_total() == base + 1
+        with pytest.raises(fence.CompileFenceError):
+            sibling(np.ones(8, np.float32))  # sibling recompile: still fatal
+        rebuilt.fence_exempt = False  # warmup over: exemption lifted
+        with pytest.raises(fence.CompileFenceError):
+            rebuilt(np.ones(16, np.float32))
+        fence.disarm()
+
+    def test_engine_set_fence_exempt_toggles_family_instances(self):
+        from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+
+        engine = ContinuousBatchingEngine(
+            max_slots=2, page_size=8, max_pages_per_seq=4,
+        )
+        fns = [getattr(engine, attr) for attr in engine.FAMILY_ATTRS
+               if getattr(engine, attr, None) is not None]
+        assert fns, "engine exposes no family instances"
+        assert all(fn.fence_exempt is False for fn in fns)
+        engine.set_fence_exempt(True)
+        assert all(fn.fence_exempt is True for fn in fns)
+        engine.set_fence_exempt(False)
+        assert all(fn.fence_exempt is False for fn in fns)
+
 
 class TestServingTelemetry:
     def test_ticks_carry_compile_counts_and_fence_survives_warm_traffic(self):

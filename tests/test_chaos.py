@@ -23,7 +23,12 @@ import time
 import pytest
 
 from sentio_tpu.infra import faults
-from sentio_tpu.infra.exceptions import DeadlineExceededError, ServiceOverloaded
+from sentio_tpu.infra.exceptions import (
+    DeadlineExceededError,
+    ReplicaUnavailable,
+    SentioError,
+    ServiceOverloaded,
+)
 from sentio_tpu.runtime.paged import ContinuousBatchingEngine, PagedResult
 from sentio_tpu.runtime.service import PagedGenerationService
 
@@ -53,11 +58,12 @@ def _assert_no_pump_threads(timeout_s: float = 15.0):
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         pumps = [t for t in threading.enumerate()
-                 if t.name == "paged-decode-pump" and t.is_alive()]
+                 if t.name in ("paged-decode-pump", "replica-supervisor")
+                 and t.is_alive()]
         if not pumps:
             return
         time.sleep(0.05)
-    raise AssertionError(f"leaked pump threads: {pumps}")
+    raise AssertionError(f"leaked pump/supervisor threads: {pumps}")
 
 
 class TestChaosDrill:
@@ -203,6 +209,145 @@ class TestChaosDrill:
         _assert_pages_conserved(svc)
         svc.close()
 
+    def test_replica_kill_drill_failover_and_rebuild(self):
+        """ISSUE 8 acceptance drill (sanitizer armed for this module): one
+        of 2 replicas is killed mid-traffic — a decode tick fails AND its
+        ``engine.reset()`` is forced to fail, so the replica latches broken
+        — under ≥8 concurrent mixed generate/stream callers. The contract:
+
+        * every caller terminates with a TYPED outcome (a result, text, or
+          a SentioError — never a bare RuntimeError);
+        * the surviving replica keeps serving during the outage;
+        * the supervisor quarantines the corpse, rebuilds it in place from
+          the shared weights, and the REBUILT replica serves a request
+          before the test ends;
+        * page pools conserve on both sides and no pump/supervisor threads
+          leak."""
+        from sentio_tpu.runtime.replica import HEALTH_HEALTHY, ReplicaSet
+
+        e0 = ContinuousBatchingEngine(
+            max_slots=2, page_size=8, max_pages_per_seq=4, steps_per_tick=2,
+        )
+        e1 = ContinuousBatchingEngine(
+            params=e0.params, tokenizer=e0.tokenizer,
+            max_slots=2, page_size=8, max_pages_per_seq=4, steps_per_tick=2,
+        )
+        svc0 = PagedGenerationService(e0, retry_budget=1)
+        svc1 = PagedGenerationService(e1, retry_budget=1)
+        # pre-compile both engines so the drill's traffic exercises the
+        # failure machinery instead of waiting out XLA compiles
+        svc0.generate("drill warm zero", max_new_tokens=2, timeout_s=180)
+        svc1.generate("drill warm one", max_new_tokens=2, timeout_s=180)
+        rs = ReplicaSet(
+            [svc0, svc1],
+            probe_interval_s=0.05, quarantine_backoff_s=0.1,
+            breaker_tick_failures=2, failover_budget=2,
+        )
+        outcomes: dict[str, object] = {}
+
+        def call_generate(i):
+            try:
+                outcomes[f"g{i}"] = rs.generate(
+                    f"replica drill generate {i}", max_new_tokens=6,
+                    temperature=0.0, timeout_s=120,
+                )
+            except Exception as exc:  # noqa: BLE001 — typed errors terminal
+                outcomes[f"g{i}"] = exc
+
+        def call_stream(i):
+            try:
+                outcomes[f"s{i}"] = "".join(rs.generate_stream(
+                    f"replica drill stream {i}", max_new_tokens=6,
+                    temperature=0.0, timeout_s=120,
+                ))
+            except Exception as exc:  # noqa: BLE001
+                outcomes[f"s{i}"] = exc
+
+        try:
+            # armed BEFORE traffic: whichever replica ticks first dies with
+            # an unrecoverable reset (deterministically exactly one kill)
+            faults.arm("paged.step", faults.FaultRule(
+                error=RuntimeError("drill: replica kill"), times=1))
+            faults.arm("engine.reset", faults.FaultRule(
+                error=RuntimeError("drill: reset denied"), times=1))
+            threads = (
+                [threading.Thread(target=call_generate, args=(i,))
+                 for i in range(5)]
+                + [threading.Thread(target=call_stream, args=(i,))
+                   for i in range(4)]
+            )
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180)
+            assert not any(t.is_alive() for t in threads), (
+                "caller thread hung across the replica kill"
+            )
+            faults.reset()
+            # exactly one replica latched broken
+            dead = [i for i, svc in enumerate((svc0, svc1)) if svc.broken]
+            assert len(dead) == 1, f"expected one broken replica, got {dead}"
+            # EVERY caller terminated with a typed outcome; the survivor
+            # absorbed failed-over load (successes exist despite the kill)
+            assert len(outcomes) == 9
+            successes = 0
+            for name, out in outcomes.items():
+                if isinstance(out, Exception):
+                    assert isinstance(out, SentioError), (
+                        f"{name}: untyped {type(out).__name__}: {out}"
+                    )
+                else:
+                    assert isinstance(out, (PagedResult, str)), (name, out)
+                    if isinstance(out, PagedResult):
+                        assert out.finish_reason in ("stop", "length"), (
+                            name, out,
+                        )
+                    successes += 1
+            assert successes >= 1, (
+                f"survivor never served during the outage: {outcomes}"
+            )
+            # the supervisor rebuilds the corpse in place and the set
+            # returns to full health
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if rs.health_summary()["status"] == "healthy":
+                    break
+                time.sleep(0.05)
+            summary = rs.health_summary()
+            assert summary["status"] == "healthy", summary
+            assert summary["replicas"][dead[0]]["rebuilds"] == 1, summary
+            # the REBUILT replica itself serves (not just the survivor):
+            # route directly at the fresh service occupying the dead slot
+            rebuilt = rs._services[dead[0]]
+            assert rebuilt is not (svc0, svc1)[dead[0]]
+            ok = rebuilt.generate("rebuilt replica serves again",
+                                  max_new_tokens=3, timeout_s=180)
+            assert ok.finish_reason in ("stop", "length")
+            # ... and through the router too
+            ok2 = rs.generate("post drill routed sanity", max_new_tokens=3,
+                              timeout_s=120)
+            assert ok2.finish_reason in ("stop", "length")
+            # health transitions were evented to the flight recorder
+            from sentio_tpu.infra.flight import get_flight_recorder
+
+            events = [t for t in get_flight_recorder().timeline()
+                      if t.get("event") == "replica_health"]
+            seen = {(e["state_from"], e["state_to"]) for e in events}
+            assert ("HEALTHY", "QUARANTINED") in seen, seen
+            assert ("QUARANTINED", "REBUILDING") in seen, seen
+            assert ("REBUILDING", "HEALTHY") in seen, seen
+            # page-pool conservation on BOTH sides of the kill (sanitizer
+            # checked every tick; this is the end-state audit)
+            for s in rs.stats()["replicas"]:
+                assert s["free_pages"] + s.get("prefix_cache_pages", 0) \
+                    == s["total_pages"] - 1, s
+            assert rs.stats()["health"]["replicas"][dead[0]]["state"] \
+                == HEALTH_HEALTHY
+        finally:
+            faults.reset()
+            rs.close()
+        _assert_no_pump_threads()
+
     def test_admission_shed_and_deadline_at_submit(self, engine):
         """Typed sheds: a full queue answers 429-style ServiceOverloaded
         with a retry hint; an already-expired deadline is a typed
@@ -257,8 +402,8 @@ class TestChaosDrill:
             except ServiceOverloaded as exc:
                 shed = exc
                 break
-            except RuntimeError:
-                break  # drain already closed the service — also a shed
+            except ReplicaUnavailable:
+                break  # drain already closed the service — also typed
             time.sleep(0.005)
         t.join(timeout=120)
         d.join(timeout=120)
@@ -266,6 +411,6 @@ class TestChaosDrill:
         assert drain_out.get("drained") is True, drain_out
         if shed is not None:
             assert shed.status == 503
-        with pytest.raises(RuntimeError, match="closed"):
+        with pytest.raises(ReplicaUnavailable, match="closed"):
             svc.generate("after drain-close")
         _assert_no_pump_threads()

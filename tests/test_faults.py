@@ -147,3 +147,63 @@ class TestDegradationLadder:
                 engine.generate(["hello"])
             out = engine.generate(["hello"])  # recovered
         assert len(out) == 1
+
+
+class TestSupervisorFaultPoints:
+    """The replica-supervision seams (ISSUE 8): ``engine.reset`` lets chaos
+    force the crash-containment reset itself to fail (the path that latches
+    a service broken), and ``replica.rebuild`` lets chaos exercise
+    rebuild-fails-then-succeeds with the supervisor's backoff."""
+
+    def test_engine_reset_fault_point_fires_then_clears(self):
+        from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+
+        engine = ContinuousBatchingEngine(
+            max_slots=2, page_size=8, max_pages_per_seq=4,
+        )
+        with faults.inject("engine.reset",
+                           error=RuntimeError("reset denied"),
+                           times=1) as rule:
+            with pytest.raises(RuntimeError, match="reset denied"):
+                engine.reset()
+            engine.reset()  # second attempt proceeds normally
+        assert rule.hits == 2 and rule.fired == 1
+        # the reset actually rebuilt the decode state
+        assert engine.allocator.free_pages == engine.allocator.num_pages - 1
+
+    def test_replica_rebuild_fails_then_succeeds(self):
+        from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+        from sentio_tpu.runtime.replica import (
+            HEALTH_HEALTHY,
+            HEALTH_QUARANTINED,
+            ReplicaSet,
+        )
+        from sentio_tpu.runtime.service import PagedGenerationService
+
+        engine = ContinuousBatchingEngine(
+            max_slots=2, page_size=8, max_pages_per_seq=4, steps_per_tick=2,
+        )
+        svc = PagedGenerationService(engine)
+        rs = ReplicaSet([svc], supervise=False, quarantine_backoff_s=0.0)
+        try:
+            rs._quarantine(0, "seeded by test")
+            with faults.inject("replica.rebuild",
+                               error=RuntimeError("no rebuild capacity"),
+                               times=1) as rule:
+                assert rs._rebuild(0) is False
+                assert rule.fired == 1
+            replica = rs.health_summary()["replicas"][0]
+            assert replica["state"] == HEALTH_QUARANTINED
+            assert "rebuild failed" in replica["reason"]
+            assert replica["rebuilds"] == 0
+            # backoff 0 → immediately due again; unarmed point now passes
+            # and the replica re-enters rotation on a working fresh engine
+            assert rs._rebuild(0) is True
+            replica = rs.health_summary()["replicas"][0]
+            assert replica["state"] == HEALTH_HEALTHY
+            assert replica["rebuilds"] == 1
+            ok = rs.generate("post rebuild request", max_new_tokens=2,
+                             temperature=0.0, timeout_s=180)
+            assert ok.finish_reason in ("stop", "length")
+        finally:
+            rs.close()

@@ -45,6 +45,18 @@ class TestSeededFixtures:
         ]
         assert "_routed" in got[0].message and "_lock" in got[0].message
 
+    def test_supervisor_fixture_exact_findings(self):
+        """Replica-supervisor health state (failure domains) mutated
+        without its mutex: the unlocked transition write and the unlocked
+        read both fire — the regression that would let the router race a
+        quarantine."""
+        got = _findings("supervisor_bad.py")
+        assert [(f.rule, f.line) for f in got] == [
+            ("lock-discipline", 18),
+            ("lock-discipline", 19),
+        ]
+        assert "_health" in got[0].message and "_mutex" in got[0].message
+
     def test_clock_fixture_exact_finding(self):
         got = _findings("clock_bad.py")
         assert [(f.rule, f.line) for f in got] == [("wall-clock-duration", 6)]
@@ -132,14 +144,17 @@ class TestRepoGate:
         from sentio_tpu.analysis.locks import collect_guarded
 
         repo = Path(__file__).resolve().parents[1]
-        expectations = {
-            "sentio_tpu/runtime/service.py": ("PagedGenerationService",
-                                              "_inbox"),
-            "sentio_tpu/runtime/replica.py": ("TenantFairQueue", "_tenants"),
-            "sentio_tpu/infra/flight.py": ("FlightRecorder", "_records"),
-            "sentio_tpu/infra/metrics.py": ("InMemoryMetrics", "histograms"),
-        }
-        for rel, (cls, attr) in expectations.items():
+        expectations = [
+            ("sentio_tpu/runtime/service.py", "PagedGenerationService",
+             "_inbox"),
+            ("sentio_tpu/runtime/replica.py", "TenantFairQueue", "_tenants"),
+            # replica failure domains: the supervisor's per-replica health
+            # machine is submitter-and-supervisor shared state
+            ("sentio_tpu/runtime/replica.py", "ReplicaSet", "_health"),
+            ("sentio_tpu/infra/flight.py", "FlightRecorder", "_records"),
+            ("sentio_tpu/infra/metrics.py", "InMemoryMetrics", "histograms"),
+        ]
+        for rel, cls, attr in expectations:
             p = repo / rel
             src = SourceFile(path=p, rel=rel, text=p.read_text())
             guarded = collect_guarded(ast.parse(src.text), src)

@@ -27,10 +27,14 @@ import time
 import pytest
 
 from sentio_tpu.infra import faults
-from sentio_tpu.infra.exceptions import ServiceOverloaded
+from sentio_tpu.infra.exceptions import ReplicaUnavailable, ServiceOverloaded
 from sentio_tpu.runtime.paged import ContinuousBatchingEngine, PagedResult
 from sentio_tpu.runtime.replica import (
     DEFAULT_TENANT,
+    HEALTH_DEGRADED,
+    HEALTH_HEALTHY,
+    HEALTH_QUARANTINED,
+    HEALTH_REBUILDING,
     PRIORITY_BATCH,
     ReplicaSet,
     TenantFairQueue,
@@ -58,6 +62,10 @@ def replica_set():
     rs = ReplicaSet(
         [PagedGenerationService(e0, max_queue=8),
          PagedGenerationService(e1, max_queue=8)],
+        # no supervisor thread: routing/health tests flip states by hand
+        # and must not race an async rebuild (the supervised path is
+        # drilled end to end in test_chaos + TestSupervisor below)
+        supervise=False,
     )
     yield rs
     rs.close()
@@ -389,6 +397,329 @@ class TestChaos:
         _assert_pages_conserved(rs)
 
 
+class TestHealthRouting:
+    """Acceptance: the router NEVER selects a QUARANTINED/REBUILDING
+    replica; DEGRADED replicas take traffic only when no healthy replica
+    has queue headroom; zero serving replicas is a typed 503."""
+
+    @pytest.fixture(autouse=True)
+    def _restore_states(self, replica_set):
+        yield
+        with replica_set._mutex:
+            for h in replica_set._health:
+                h.state = HEALTH_HEALTHY
+
+    def _set_state(self, rs, idx, state):
+        with rs._mutex:
+            rs._health[idx].state = state
+
+    def test_router_never_selects_quarantined_or_rebuilding(self, replica_set):
+        rs = replica_set
+        toks = rs._route_tokens("health exclusion probe prompt")
+        for state in (HEALTH_QUARANTINED, HEALTH_REBUILDING):
+            self._set_state(rs, 0, state)
+            for _ in range(8):
+                assert rs._route(toks, count=False)[0] == 1, state
+            self._set_state(rs, 0, HEALTH_HEALTHY)
+            self._set_state(rs, 1, state)
+            for _ in range(8):
+                assert rs._route(toks, count=False)[0] == 0, state
+            self._set_state(rs, 1, HEALTH_HEALTHY)
+
+    def test_affinity_never_overrides_quarantine(self, replica_set):
+        """Even the replica holding a session's cached prefix is skipped
+        once quarantined — cache reuse never beats exclusion."""
+        rs = replica_set
+        toks = rs._route_tokens(TestRouting.SESSION + " turn four")
+        holder, hit = rs._route(toks, count=False)
+        if hit == 0:  # session prefix evicted: seed it again
+            rs.generate(TestRouting.SESSION + " turn four",
+                        max_new_tokens=2, temperature=0.0, timeout_s=120)
+            holder, hit = rs._route(toks, count=False)
+        assert hit > 0
+        self._set_state(rs, holder, HEALTH_QUARANTINED)
+        routed, _routed_hit = rs._route(toks, count=False)
+        assert routed != holder
+
+    def test_degraded_taken_only_without_healthy_headroom(self, replica_set,
+                                                          monkeypatch):
+        rs = replica_set
+        toks = rs._route_tokens("entirely cold degraded routing probe")
+        self._set_state(rs, 0, HEALTH_DEGRADED)
+        # healthy replica 1 has headroom: degraded 0 is not even eligible
+        assert rs._route(toks, count=False)[0] == 1
+        # healthy replica saturated at its admission bound: degraded joins
+        monkeypatch.setattr(rs._services[1], "backlog",
+                            lambda: rs._services[1].max_queue)
+        monkeypatch.setattr(rs._services[1], "projected_wait", lambda: 99.0)
+        assert rs._route(toks, count=False)[0] == 0
+
+    def test_all_down_is_typed_503_with_retry_hint(self, replica_set):
+        rs = replica_set
+        self._set_state(rs, 0, HEALTH_QUARANTINED)
+        self._set_state(rs, 1, HEALTH_REBUILDING)
+        with pytest.raises(ReplicaUnavailable) as exc_info:
+            rs.generate("nowhere to go", max_new_tokens=2)
+        assert exc_info.value.status == 503
+        assert exc_info.value.details["retry_after_s"] >= 1.0
+        # the SSE pre-check sheds the same way, BEFORE a 200 commits
+        with pytest.raises(ReplicaUnavailable):
+            rs.check_admission(prompt="nowhere to go")
+
+    def test_health_summary_degraded_vs_unhealthy(self, replica_set):
+        rs = replica_set
+        assert rs.health_summary()["status"] == "healthy"
+        self._set_state(rs, 0, HEALTH_QUARANTINED)
+        summary = rs.health_summary()
+        assert summary["status"] == "degraded"
+        assert summary["healthy_replicas"] == 1
+        assert summary["serving_replicas"] == 1
+        # DEGRADED still serves: not unhealthy
+        self._set_state(rs, 1, HEALTH_DEGRADED)
+        assert rs.health_summary()["status"] == "degraded"
+        self._set_state(rs, 1, HEALTH_REBUILDING)
+        summary = rs.health_summary()
+        assert summary["status"] == "unhealthy"
+        assert summary["serving_replicas"] == 0
+
+
+class TestSupervisor:
+    """N=1 supervisor equivalence (no router involved): a single replica
+    that latches broken quarantines immediately, answers typed 503s while
+    down, is rebuilt in place by the supervisor pass, and serves again.
+    Driven via _supervise_once for determinism (the async supervisor
+    thread is exercised by the replica-kill drill in test_chaos)."""
+
+    def test_n1_quarantine_rebuild_recover(self):
+        engine = _engine()
+        svc = PagedGenerationService(engine, retry_budget=0)
+        svc.generate("n1 supervisor warm", max_new_tokens=2, timeout_s=180)
+        rs = ReplicaSet([svc], supervise=False, quarantine_backoff_s=0.0,
+                        failover_budget=1)
+        try:
+            with faults.inject("paged.step",
+                               error=RuntimeError("n1 kill"), times=1), \
+                 faults.inject("engine.reset",
+                               error=RuntimeError("n1 reset denied"),
+                               times=1):
+                with pytest.raises(ReplicaUnavailable):
+                    rs.generate("doomed", max_new_tokens=4, timeout_s=120)
+            assert svc.broken
+            # the caller-path breaker quarantined it without any supervisor
+            assert rs.health_summary()["replicas"][0]["state"] \
+                == HEALTH_QUARANTINED
+            # while down: typed 503 + Retry-After, from generate AND from
+            # the stream pre-check — never an untyped 500
+            with pytest.raises(ReplicaUnavailable) as exc_info:
+                rs.generate("while down", max_new_tokens=2)
+            assert exc_info.value.status == 503
+            with pytest.raises(ReplicaUnavailable):
+                rs.check_admission()
+            # one supervisor pass rebuilds in place (backoff 0 → due now)
+            rs._supervise_once()
+            summary = rs.health_summary()
+            assert summary["status"] == "healthy", summary
+            assert summary["replicas"][0]["rebuilds"] == 1
+            ok = rs.generate("recovered", max_new_tokens=3, timeout_s=180)
+            assert ok.finish_reason in ("stop", "length")
+            # the rebuilt engine is a fresh instance on the same weights
+            assert rs._services[0] is not svc
+            assert rs._services[0].engine is not engine
+            assert rs._services[0].engine.params is engine.params
+        finally:
+            faults.reset()
+            rs.close()
+
+    def test_breaker_trips_on_tick_failure_burst(self):
+        """Tick failures (with SUCCESSFUL resets — callers keep succeeding
+        via requeue) still quarantine once the burst threshold is crossed:
+        a replica that crashes every few ticks is a liability even though
+        crash containment hides it from callers."""
+        engine = _engine()
+        svc = PagedGenerationService(engine, retry_budget=3)
+        svc.generate("burst warm", max_new_tokens=2, timeout_s=180)
+        rs = ReplicaSet([svc], supervise=False, breaker_tick_failures=2,
+                        quarantine_backoff_s=60.0)
+        try:
+            with faults.inject("paged.step",
+                               error=RuntimeError("flaky tick"), times=2):
+                ok = rs.generate("survives the flaky ticks",
+                                 max_new_tokens=4, timeout_s=120)
+            assert ok.finish_reason in ("stop", "length")
+            assert svc.tick_failure_count >= 2
+            rs._supervise_once()
+            state = rs.health_summary()["replicas"][0]["state"]
+            assert state == HEALTH_QUARANTINED
+            assert "tick failures" in \
+                rs.health_summary()["replicas"][0]["reason"]
+        finally:
+            faults.reset()
+            rs.close()
+
+    def test_degraded_on_failure_then_clean_window_heals(self):
+        engine = _engine()
+        svc = PagedGenerationService(engine)
+        rs = ReplicaSet([svc], supervise=False, breaker_window_s=0.3,
+                        breaker_min_samples=50)
+        try:
+            rs._note_failure(0, ReplicaUnavailable("transient"))
+            rs._supervise_once()
+            assert rs.health_summary()["replicas"][0]["state"] \
+                == HEALTH_DEGRADED
+            time.sleep(0.4)  # window expires
+            rs._supervise_once()
+            assert rs.health_summary()["replicas"][0]["state"] \
+                == HEALTH_HEALTHY
+        finally:
+            rs.close()
+
+    def test_failover_releases_and_recharges_wfq(self):
+        """Failover must not double-count tenant quota: after a failed-over
+        generate completes, the tenant's pending count is zero and exactly
+        one admission per attempt was recorded."""
+        e0 = _engine()
+        e1 = _engine(base=e0)
+        svc0 = PagedGenerationService(e0, retry_budget=0)
+        svc1 = PagedGenerationService(e1, retry_budget=0)
+        svc0.generate("failover warm zero", max_new_tokens=2, timeout_s=180)
+        svc1.generate("failover warm one", max_new_tokens=2, timeout_s=180)
+        rs = ReplicaSet([svc0, svc1], supervise=False, failover_budget=1)
+        try:
+            with faults.inject("paged.step",
+                               error=RuntimeError("kill once"), times=1), \
+                 faults.inject("engine.reset",
+                               error=RuntimeError("reset denied"), times=1):
+                result = rs.generate("failover rider", max_new_tokens=4,
+                                     temperature=0.0, timeout_s=120,
+                                     tenant="team-f")
+            assert result.finish_reason in ("stop", "length")
+            stats = rs.stats()
+            assert stats["failovers"] == 1
+            tenant = stats["tenants"]["per_tenant"]["team-f"]
+            assert tenant["pending"] == 0, "reservation leaked"
+            assert tenant["admitted"] == 2, "one admission per attempt"
+            # exactly one replica died and the set degraded, not collapsed
+            assert [svc0.broken, svc1.broken].count(True) == 1
+            assert rs.health_summary()["status"] == "degraded"
+        finally:
+            faults.reset()
+            rs.close()
+
+
+class TestVerifyTenantCharging:
+    """ROADMAP item 1 leftover: verify-node decode admissions must be
+    charged to the REQUESTING tenant's WFQ quota, not the shared default —
+    otherwise one tenant's verify traffic rides free and can starve every
+    other tenant."""
+
+    def _verifier_over(self, service):
+        from sentio_tpu.config import GeneratorConfig
+        from sentio_tpu.ops.generator import LLMGenerator, TpuProvider
+        from sentio_tpu.ops.verifier import AnswerVerifier
+
+        cfg = GeneratorConfig(provider="tpu", verifier_max_tokens=8)
+        generator = LLMGenerator(
+            provider=TpuProvider(service=service), config=cfg)
+        return AnswerVerifier(generator=generator, config=cfg)
+
+    def test_verify_charges_request_tenant_and_cannot_starve(self):
+        """A flooding tenant's verify calls saturate ITS quota (typed sheds
+        → degraded 'warn' verdicts), while another tenant's verify call
+        admits mid-flood and completes — through a real TenantFairQueue."""
+        import queue as _q
+
+        release = threading.Event()
+        charged: list[str] = []
+        queue = TenantFairQueue(capacity=4, headroom=2)  # lone quota: 2
+
+        class GatedSet:
+            """Replica-tier-shaped fake: supports_tenants + a real WFQ in
+            front of a generate that holds its admission until released
+            (standing in for a slow decode)."""
+
+            supports_tenants = True
+
+            def generate(self, prompt, max_new_tokens=64, temperature=0.0,
+                         request_id=None, deadline_ts=None, tenant=None,
+                         priority=None, **kw):
+                key = queue.admit(tenant or DEFAULT_TENANT, 8)
+                charged.append(key)
+                try:
+                    release.wait(30)
+                finally:
+                    queue.release(key, 8)
+                return PagedResult(
+                    request_id=0,
+                    text='{"verdict": "pass", "citations_ok": true, '
+                         '"notes": []}',
+                    tokens=[1], prompt_tokens=1, finish_reason="stop",
+                )
+
+        verifier = self._verifier_over(GatedSet())
+        results: dict[str, object] = {}
+
+        def verify_as(tag, tenant):
+            results[tag] = verifier.verify(
+                "q?", "answer", [], tenant=tenant)
+
+        hold = [threading.Thread(target=verify_as, args=(f"a{i}", "team-a"))
+                for i in range(2)]
+        for t in hold:
+            t.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(charged) < 2:
+            time.sleep(0.005)
+        assert charged.count("team-a") == 2
+        # 3rd team-a verify: over ITS quota → typed shed → warn verdict
+        verify_as("a2", "team-a")
+        warn = results["a2"]
+        assert warn.verdict == "warn"
+        assert any("quota" in note for note in warn.notes), warn.notes
+        # team-b's verify admits inside the reserved headroom mid-flood
+        b = threading.Thread(target=verify_as, args=("b0", "team-b"))
+        b.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and "team-b" not in charged:
+            time.sleep(0.005)
+        assert "team-b" in charged, "tenant B's verify was starved"
+        release.set()
+        for t in hold:
+            t.join(timeout=30)
+        b.join(timeout=30)
+        assert results["b0"].verdict == "pass"
+
+    def test_verify_node_threads_tenant_from_metadata(self):
+        import asyncio
+
+        from sentio_tpu.graph.nodes import create_verifier_node
+        from sentio_tpu.ops.verifier import VerifyResult
+
+        captured: dict = {}
+
+        class StubVerifier:
+            def verify(self, query, answer, docs, request_id=None,
+                       deadline_ts=None, tenant=None, priority=None):
+                captured.update(tenant=tenant, priority=priority,
+                                request_id=request_id)
+                return VerifyResult(verdict="pass")
+
+        from sentio_tpu.config import Settings
+
+        node = create_verifier_node(StubVerifier(), settings=Settings())
+        state = {
+            "query": "q?",
+            "response": "an answer",
+            "retrieved_documents": [],
+            "metadata": {"query_id": "vt-1", "tenant": "team-z",
+                         "priority": "batch"},
+        }
+        out = asyncio.run(node(state))
+        assert out["evaluation"]["verdict"] == "pass"
+        assert captured["tenant"] == "team-z"
+        assert captured["priority"] == "batch"
+        assert captured["request_id"] == "vt-1"
+
+
 class TestLifecycleFanOut:
     def test_warmup_warms_every_replica(self):
         e0 = _engine()
@@ -411,7 +742,7 @@ class TestLifecycleFanOut:
         assert out["drained"] is True
         assert out["abandoned"] == 0
         assert [r["replica"] for r in out["replicas"]] == [0, 1]
-        with pytest.raises((RuntimeError, ServiceOverloaded)):
+        with pytest.raises((ReplicaUnavailable, ServiceOverloaded)):
             replica_set.generate("after drain", max_new_tokens=2)
 
     def test_leaked_pump_sums_without_double_count(self):

@@ -194,6 +194,52 @@ class TestHealthAndInfo:
 
         run(with_client(fast_settings(), body))
 
+    def test_health_replica_degraded_stays_200_unhealthy_503(self):
+        """Replica failure domains on /health: 1 ≤ serving < N reports
+        ``degraded`` with HTTP 200 (k8s must keep routing to the half-alive
+        pod while the supervisor rebuilds), and ``unhealthy`` → 503 only at
+        ZERO serving replicas (restarting is now the best move)."""
+
+        class HalfAliveSet:
+            def health_summary(self):
+                return {
+                    "status": "degraded", "healthy_replicas": 1,
+                    "serving_replicas": 1, "total_replicas": 2,
+                    "replicas": [
+                        {"replica": 0, "state": "HEALTHY", "since_s": 5.0,
+                         "rebuilds": 0},
+                        {"replica": 1, "state": "REBUILDING",
+                         "since_s": 1.0, "rebuilds": 0,
+                         "reason": "engine latched broken"},
+                    ],
+                }
+
+            def close(self):
+                pass
+
+        class DeadSet(HalfAliveSet):
+            def health_summary(self):
+                return {
+                    "status": "unhealthy", "healthy_replicas": 0,
+                    "serving_replicas": 0, "total_replicas": 2,
+                    "replicas": [],
+                }
+
+        async def body(client, container):
+            container.override("generation_service", HalfAliveSet())
+            resp = await client.get("/health")
+            assert resp.status == 200
+            data = await resp.json()
+            assert data["status"] == "degraded"
+            assert data["replicas"]["serving_replicas"] == 1
+            assert data["replicas"]["replicas"][1]["state"] == "REBUILDING"
+            container.override("generation_service", DeadSet())
+            resp = await client.get("/health")
+            assert resp.status == 503
+            assert (await resp.json())["status"] == "unhealthy"
+
+        run(with_client(fast_settings(), body))
+
     def test_info(self):
         async def body(client, container):
             resp = await client.get("/info")
@@ -522,6 +568,55 @@ class TestOverloadMapping:
             assert resp.status == 504
             data = await resp.json()
             assert data["error"]["code"] == "DEADLINE_EXCEEDED"
+
+        run(with_client(fast_settings(), body))
+
+    def test_replica_unavailable_maps_to_503_with_retry_after(self):
+        """A broken/closed decode replica surfaces as a typed 503 +
+        Retry-After (ReplicaUnavailable) instead of the old untyped
+        RuntimeError → opaque 500 — the supervisor rebuilds replicas in
+        place, so 'come back shortly' is the honest wire answer."""
+        from sentio_tpu.infra.exceptions import ReplicaUnavailable
+
+        class BrokenReplicaGraph:
+            def invoke(self, *a, **k):
+                raise ReplicaUnavailable(
+                    "paged decode engine is down (reset failed; awaiting "
+                    "supervised rebuild)", retry_after_s=4.0)
+
+        async def body(client, container):
+            container.override("graph", BrokenReplicaGraph())
+            resp = await client.post("/chat", json={"question": "any"})
+            assert resp.status == 503
+            assert resp.headers.get("Retry-After") == "4"
+            data = await resp.json()
+            assert data["error"]["code"] == "SERVICE_UNAVAILABLE"
+            assert data["error"]["retryable"] is True
+            # NOT a degraded 200 apology: the ladder is bypassed
+            assert "answer" not in data
+
+        run(with_client(fast_settings(), body))
+
+    def test_stream_precheck_sheds_replica_unavailable_before_sse(self):
+        """The SSE pre-check path: every replica down → typed 503 before
+        the 200 status line commits (previously the untyped RuntimeError
+        was swallowed and the stream limped into the degraded ladder)."""
+        from sentio_tpu.infra.exceptions import ReplicaUnavailable
+
+        class DownSet:
+            supports_tenants = True
+
+            def check_admission(self, deadline_ts=None, tenant=None,
+                                priority=None, prompt=None):
+                raise ReplicaUnavailable(
+                    "no serving replica available", retry_after_s=2.0)
+
+        async def body(client, container):
+            container.override("generation_service", DownSet())
+            resp = await client.post(
+                "/chat", json={"question": "stream me", "stream": True})
+            assert resp.status == 503
+            assert resp.headers.get("Retry-After") == "2"
 
         run(with_client(fast_settings(), body))
 

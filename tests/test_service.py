@@ -18,7 +18,11 @@ from sentio_tpu.models.llama import LlamaConfig
 from sentio_tpu.parallel.batcher import BatcherClosed, ThreadBatcher
 from sentio_tpu.runtime.engine import GeneratorEngine
 from sentio_tpu.runtime.paged import ContinuousBatchingEngine
-from sentio_tpu.runtime.service import GenerationTimeout, PagedGenerationService
+from sentio_tpu.runtime.service import (
+    GenerationTimeout,
+    PagedGenerationService,
+    ReplicaUnavailable,
+)
 
 pytestmark = pytest.mark.slow
 
@@ -229,8 +233,12 @@ class TestPagedGenerationService:
         )
         svc = PagedGenerationService(engine)
         svc.close()
-        with pytest.raises(RuntimeError, match="closed"):
+        # typed 503 (ReplicaUnavailable) — closed/broken admissions carry a
+        # Retry-After instead of the old untyped RuntimeError → 500
+        with pytest.raises(ReplicaUnavailable, match="closed") as exc_info:
             svc.generate("x")
+        assert exc_info.value.status == 503
+        assert exc_info.value.details["retry_after_s"] > 0
 
 
 class TestRobustness:
@@ -347,7 +355,7 @@ class TestRobustness:
         t.join(timeout=120)
         assert out["drained"] is True
         assert result["r"].finish_reason in ("stop", "length")
-        with pytest.raises((RuntimeError, ServiceOverloaded)):
+        with pytest.raises((ReplicaUnavailable, ServiceOverloaded)):
             svc.generate("too late")
 
     def test_leaked_pump_surfaces_in_stats(self, contiguous):
