@@ -884,22 +884,33 @@ def phase_load(llm_cfg, new_tokens):
 
 
 def phase_chaos(llm_cfg, new_tokens):
-    """Replica-kill chaos drill over the open-loop harness (BENCH_CHAOS=1):
+    """Replica chaos drill over the open-loop harness (BENCH_CHAOS=1):
     a 2-replica set serves a steady Poisson arrival stream; mid-run one
-    replica's next decode tick is killed AND its ``engine.reset()`` is
-    forced to fail — the worst-case loss, where the replica latches broken
-    and the supervisor must rebuild it in place from the shared weights.
-    The artifact answers the three operator questions: **availability**
+    replica suffers the scenario picked by ``BENCH_CHAOS_MODE``:
+
+    * ``kill`` (default) — the next decode tick raises AND its
+      ``engine.reset()`` is forced to fail: the replica latches broken and
+      the supervisor rebuilds it in place from the shared weights;
+    * ``stall`` — the next decode tick WEDGES (stall fault: blocks,
+      raising nothing) exactly like a hung device dispatch; nothing
+      latches, so recovery rests entirely on the pump-heartbeat watchdog:
+      quarantine on heartbeat age, inbox handoff to the survivor, engine
+      abandonment, in-place rebuild.
+
+    The artifact answers the operator questions: **availability**
     (completed / arrivals — the error-budget fraction is its complement),
     **p95 during the incident window** (requests arriving between the kill
-    and the set reporting all-HEALTHY again), and **time-to-recover**
-    (kill → rebuilt replica back in rotation). Untyped errors are counted
-    separately and should be zero — every failure a caller sees must be a
-    typed shed/deadline/replica error.
+    and the set reporting all-HEALTHY again), **time-to-recover** (kill →
+    rebuilt replica back in rotation), **detection latency** (kill → first
+    replica out of HEALTHY — for stalls this is the watchdog's whole
+    value), and **handed_off_tickets** (inbox tickets moved to survivors
+    at quarantine instead of riding caller failover). Untyped errors are
+    counted separately and should be zero.
 
     Env knobs: BENCH_CHAOS_QPS (8), BENCH_CHAOS_SECONDS (30),
     BENCH_CHAOS_KILL_AT_S (5), BENCH_CHAOS_SLOTS (8),
-    BENCH_CHAOS_SEED (1234)."""
+    BENCH_CHAOS_SEED (1234), BENCH_CHAOS_MODE (kill|stall),
+    BENCH_CHAOS_STALL_BUDGET_S (2)."""
     import random
     import threading
 
@@ -919,10 +930,12 @@ def phase_chaos(llm_cfg, new_tokens):
     kill_at_s = float(os.environ.get("BENCH_CHAOS_KILL_AT_S", "5"))
     max_slots = int(os.environ.get("BENCH_CHAOS_SLOTS", "8"))
     seed = int(os.environ.get("BENCH_CHAOS_SEED", "1234"))
+    mode = os.environ.get("BENCH_CHAOS_MODE", "kill").strip().lower()
+    stall_budget_s = float(os.environ.get("BENCH_CHAOS_STALL_BUDGET_S", "2"))
     gen_tokens = min(new_tokens, 16)
     rng = random.Random(seed)
 
-    log("phase CHAOS: building 2-replica set ...")
+    log(f"phase CHAOS: building 2-replica set (mode={mode}) ...")
     e0 = ContinuousBatchingEngine(
         model_config=llm_cfg, max_slots=max_slots, page_size=16,
         max_pages_per_seq=8, steps_per_tick=8, max_tick_steps=8,
@@ -934,11 +947,18 @@ def phase_chaos(llm_cfg, new_tokens):
         steps_per_tick=8, max_tick_steps=8, pipeline_depth=2,
         ignore_eos=True,
     )
+    # stall mode rests on the watchdog: the per-service stall budget must
+    # exceed the slowest legitimate tick (warmup has pre-compiled, so the
+    # default 2s is generous) but stay small next to the run window
+    svc_kw = ({"tick_stall_budget_s": stall_budget_s}
+              if mode == "stall" else {})
     rs = ReplicaSet(
-        [PagedGenerationService(e0), PagedGenerationService(e1)],
+        [PagedGenerationService(e0, **svc_kw),
+         PagedGenerationService(e1, **svc_kw)],
         # fast supervision: the drill measures recovery, not poll cadence
         probe_interval_s=0.05, quarantine_backoff_s=0.25,
         breaker_tick_failures=2, failover_budget=2,
+        rebuild_drain_s=1.0,
     )
     log("phase CHAOS: warmup ...")
     rs.warmup(max_new_tokens=gen_tokens)
@@ -949,7 +969,8 @@ def phase_chaos(llm_cfg, new_tokens):
              "typed_errors": 0, "untyped_errors": 0}
     # (arrival time relative to t_start, e2e latency ms) for completions
     completions: list[tuple[float, float]] = []
-    t_state = {"kill": None, "recover": None, "done": False}
+    t_state = {"kill": None, "detect": None, "recover": None, "done": False}
+    stall_release = threading.Event()
 
     def worker(prompt: str, t_rel: float) -> None:
         t0 = time.perf_counter()
@@ -977,12 +998,21 @@ def phase_chaos(llm_cfg, new_tokens):
                 stats["untyped_errors"] += 1
 
     def watcher(t_start: float) -> None:
-        # recovery clock: from the kill until the set reports all-HEALTHY
+        # detection clock: kill → first replica out of HEALTHY (for stalls
+        # this measures the watchdog, the headline of the scenario);
+        # recovery clock: kill → the set reports all-HEALTHY again.
+        # Recovery only counts AFTER detection — a stall leaves the set
+        # reporting healthy for a full watchdog budget after the wedge,
+        # and "recovered before anything was detected" is not recovery
         while t_state["recover"] is None and not t_state["done"]:
             if t_state["kill"] is not None:
                 summary = rs.health_summary()
-                if summary["status"] == "healthy" and \
-                        time.perf_counter() - t_start - t_state["kill"] > 0.2:
+                if t_state["detect"] is None and any(
+                        r["state"] != "HEALTHY"
+                        for r in summary["replicas"]):
+                    t_state["detect"] = time.perf_counter() - t_start
+                if t_state["detect"] is not None and \
+                        summary["status"] == "healthy":
                     t_state["recover"] = time.perf_counter() - t_start
                     return
             time.sleep(0.02)
@@ -996,15 +1026,25 @@ def phase_chaos(llm_cfg, new_tokens):
     while time.perf_counter() - t_start < run_s:
         t_rel = time.perf_counter() - t_start
         if not killed and t_rel >= kill_at_s:
-            # one-shot kill: the next decode tick anywhere fails, and that
-            # pump's recovery reset fails too → latched broken replica
-            faults.arm("paged.step", faults.FaultRule(
-                error=RuntimeError("bench chaos: replica kill"), times=1))
-            faults.arm("engine.reset", faults.FaultRule(
-                error=RuntimeError("bench chaos: reset denied"), times=1))
+            if mode == "stall":
+                # one-shot wedge: the next decode tick anywhere BLOCKS
+                # (raising nothing) until released after the run — the
+                # watchdog must find it by heartbeat age alone
+                faults.arm("paged.step", faults.FaultRule(
+                    stall_event=stall_release,
+                    stall_s=run_s + 300.0, times=1))
+            else:
+                # one-shot kill: the next decode tick anywhere fails, and
+                # that pump's recovery reset fails too → latched broken
+                faults.arm("paged.step", faults.FaultRule(
+                    error=RuntimeError("bench chaos: replica kill"),
+                    times=1))
+                faults.arm("engine.reset", faults.FaultRule(
+                    error=RuntimeError("bench chaos: reset denied"),
+                    times=1))
             t_state["kill"] = t_rel
             killed = True
-            log(f"phase CHAOS: replica kill armed at t={t_rel:.1f}s")
+            log(f"phase CHAOS: replica {mode} armed at t={t_rel:.1f}s")
         prompt = f"chaos session {seq % 8:02d} steady traffic turn {seq}"
         t = threading.Thread(target=worker, args=(prompt, t_rel), daemon=True)
         t.start()
@@ -1025,9 +1065,11 @@ def phase_chaos(llm_cfg, new_tokens):
         while t_state["recover"] is None and time.perf_counter() < grace_end:
             time.sleep(0.1)
     t_state["done"] = True  # stop the watcher (it idles if never killed)
+    stall_release.set()  # unwedge the abandoned pump so it can exit
     faults.reset()
 
     t_kill = t_state["kill"]
+    t_detect = t_state["detect"]
     t_recover = t_state["recover"]
     incident = [lat for (t_rel, lat) in completions
                 if t_kill is not None
@@ -1036,23 +1078,37 @@ def phase_chaos(llm_cfg, new_tokens):
     steady = [lat for (t_rel, lat) in completions
               if t_kill is None or t_rel < t_kill]
     arrivals = max(stats["arrivals"], 1)
+    set_stats = rs.stats()
     out = {
         "knobs": {"qps": qps, "run_s": run_s, "kill_at_s": kill_at_s,
                   "slots_per_replica": max_slots, "gen_tokens": gen_tokens,
-                  "seed": seed},
+                  "seed": seed, "mode": mode,
+                  **({"stall_budget_s": stall_budget_s}
+                     if mode == "stall" else {})},
         **stats,
         "hung": hung,
         # the headline: fraction of offered requests that completed — its
         # complement is the error budget the incident consumed
         "availability": round(stats["ok"] / arrivals, 4),
         "killed": killed,
+        # kill → first replica out of HEALTHY: for the stall scenario this
+        # is pure watchdog latency (nothing raised); for kill it is the
+        # caller-path breaker's reaction time
+        "detection_latency_s": (round(t_detect - t_kill, 2)
+                                if t_detect is not None and t_kill is not None
+                                else None),
         "time_to_recover_s": (round(t_recover - t_kill, 2)
                               if t_recover is not None and t_kill is not None
                               else None),
         # None (not False) when no kill was armed: there was no incident
         "recovered": (t_recover is not None) if killed else None,
+        "detected": (t_detect is not None) if killed else None,
         "health": rs.health_summary(),
-        "failovers": rs.stats().get("failovers", 0),
+        "failovers": set_stats.get("failovers", 0),
+        # quarantine inbox handoff: tickets that completed on a survivor
+        # WITHOUT consuming their callers' failover budget
+        "handed_off_tickets": set_stats.get("handed_off", 0),
+        "stall_quarantines": set_stats.get("stall_quarantines", 0),
     }
     if steady:
         out["steady_p95_ms"] = round(_percentile(steady, 0.95), 2)
@@ -1060,10 +1116,20 @@ def phase_chaos(llm_cfg, new_tokens):
         out["incident_p95_ms"] = round(_percentile(incident, 0.95), 2)
         out["incident_completions"] = len(incident)
     rs.close()
+    # let the released (previously wedged) pump unwind before returning:
+    # it exits at its next loop top now that its service is closed, and a
+    # pump still inside XLA at interpreter exit aborts the process
+    unwind_end = time.perf_counter() + 30
+    while time.perf_counter() < unwind_end and any(
+            t.name == "paged-decode-pump" and t.is_alive()
+            for t in threading.enumerate()):
+        time.sleep(0.05)
     set_metrics(MetricsCollector())
-    log(f"phase CHAOS: availability={out['availability']} "
+    log(f"phase CHAOS[{mode}]: availability={out['availability']} "
+        f"detect={out['detection_latency_s']}s "
         f"ttr={out['time_to_recover_s']}s "
         f"incident_p95={out.get('incident_p95_ms')}ms "
+        f"handed_off={out['handed_off_tickets']} "
         f"untyped={stats['untyped_errors']}")
     return out
 

@@ -1,0 +1,90 @@
+"""Blocking-call discipline: unbounded joins + supervisor-thread waits.
+
+The stall-tolerance layer (runtime/service.py heartbeat + the ReplicaSet
+watchdog) exists because a thread wedged inside a blocking call raises
+nothing. These rules keep the *framework's own* threads from recreating the
+hazard they guard against:
+
+``join-no-timeout``
+    A zero-argument ``.join()`` call blocks forever if the joined thread is
+    wedged (the exact failure mode the watchdog detects in pumps). Every
+    thread join in framework code must carry a timeout and surface the
+    straggler — ``PagedGenerationService.close()`` counting ``pump_leaked``
+    is the pattern. Zero-argument only: ``"sep".join(parts)`` and
+    ``os.path.join(a, b)`` take positional arguments and never match.
+
+``supervisor-blocking-wait``
+    Inside supervisor/watchdog-owned code (methods or functions whose name
+    contains ``supervise``, ``supervisor``, ``watchdog``, or
+    ``rebuild_worker``, and their nested functions), a zero-argument
+    ``.wait()`` or ``.get()`` blocks the detection loop itself — a stalled
+    supervisor cannot quarantine anything. Waits there must carry a timeout
+    so the loop keeps its cadence. Zero-argument only: ``event.wait(0.5)``
+    and ``d.get(key)`` never match.
+
+Suppression: the standard inline ``# lint: allow(<rule>)`` marker.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from sentio_tpu.analysis.findings import Finding, SourceFile
+
+__all__ = ["check_blocking"]
+
+RULE_JOIN = "join-no-timeout"
+RULE_SUPERVISOR_WAIT = "supervisor-blocking-wait"
+
+# function/method names that mark supervisor- or watchdog-owned code paths
+_SUPERVISOR_NAME = re.compile(r"supervise|supervisor|watchdog|rebuild_worker")
+
+# zero-argument attribute calls that block forever on these names
+_BLOCKING_ATTRS = ("wait", "get")
+
+
+def _zero_arg_attr_call(node: ast.Call) -> str:
+    """The attribute name of a ``obj.attr()`` call with NO arguments at
+    all, else ''."""
+    if node.args or node.keywords:
+        return ""
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return ""
+
+
+def check_blocking(tree: ast.Module, src: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+
+    def visit(node: ast.AST, in_supervisor: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner = in_supervisor or bool(_SUPERVISOR_NAME.search(node.name))
+            for child in ast.iter_child_nodes(node):
+                visit(child, inner)
+            return
+        if isinstance(node, ast.Call):
+            attr = _zero_arg_attr_call(node)
+            if attr == "join":
+                f = src.finding(
+                    RULE_JOIN, node.lineno,
+                    ".join() without a timeout blocks forever on a wedged "
+                    "thread — pass timeout= and surface the straggler "
+                    "(see PagedGenerationService.close pump_leaked)",
+                )
+                if f is not None:
+                    findings.append(f)
+            elif in_supervisor and attr in _BLOCKING_ATTRS:
+                f = src.finding(
+                    RULE_SUPERVISOR_WAIT, node.lineno,
+                    f".{attr}() without a timeout inside supervisor/"
+                    "watchdog-owned code — a blocked detection loop cannot "
+                    "quarantine anything; poll with a timeout instead",
+                )
+                if f is not None:
+                    findings.append(f)
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_supervisor)
+
+    visit(tree, False)
+    return findings

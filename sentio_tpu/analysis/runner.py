@@ -23,6 +23,7 @@ from sentio_tpu.analysis.findings import (
     load_baseline,
     save_baseline,
 )
+from sentio_tpu.analysis.blocking import check_blocking
 from sentio_tpu.analysis.hygiene import check_hygiene
 from sentio_tpu.analysis.locks import check_locks
 from sentio_tpu.analysis.retrace import check_retrace
@@ -33,7 +34,7 @@ PACKAGE_ROOT = Path(__file__).resolve().parents[1]  # sentio_tpu/
 REPO_ROOT = PACKAGE_ROOT.parent
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
 
-RULES = (check_retrace, check_locks, check_hygiene)
+RULES = (check_retrace, check_locks, check_hygiene, check_blocking)
 
 
 def _iter_py_files(path: Path):

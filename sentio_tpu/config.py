@@ -421,6 +421,21 @@ class ServeConfig:
     # ReplicaSet-layer failover retries per request after a replica dies
     # under it (PR 5's crash retry budget, lifted across replicas)
     replica_failover_budget: int = 1
+    # ---- stall detection & watchdog ----
+    # wall-clock budget one pump loop iteration may take before the
+    # watchdog declares the replica STALLED (heartbeat stale with pending
+    # work) and quarantines it — must comfortably exceed the slowest
+    # legitimate tick INCLUDING a cold XLA compile; 0 disables
+    tick_stall_budget_s: float = 120.0
+    # bounded rebuild worker pool: detection cadence stays at the
+    # supervisor's probe interval while rebuilds (seconds-to-minutes of
+    # drain + compile, or wedged entirely) run on workers; 0 = rebuild on
+    # the supervisor thread (pre-pool behavior)
+    replica_rebuild_workers: int = 1
+    # SSE liveness: emit a comment keepalive when no event has been
+    # written for this long (a stalled decode otherwise looks identical
+    # to a slow one from the client side); 0 disables
+    sse_keepalive_s: float = 15.0
 
     @classmethod
     def from_env(cls) -> "ServeConfig":
@@ -482,6 +497,11 @@ class ServeConfig:
             replica_failover_budget=_env_int(
                 ["REPLICA_FAILOVER_BUDGET"], 1
             ),
+            tick_stall_budget_s=_env_float(["TICK_STALL_BUDGET_S"], 120.0),
+            replica_rebuild_workers=_env_int(
+                ["REPLICA_REBUILD_WORKERS"], 1
+            ),
+            sse_keepalive_s=_env_float(["SSE_KEEPALIVE_S"], 15.0),
         )
 
     def parsed_tenant_weights(self) -> dict[str, float]:

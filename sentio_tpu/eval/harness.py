@@ -8,6 +8,7 @@ stack) and scores retrieval against the gold ids.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -106,10 +107,24 @@ def run_queries(
         for t in threads:
             t.start()
         for t in threads:
-            t.join()
+            # bounded: a worker wedged inside a hung provider call must not
+            # hang the whole eval run past the per-query budget
+            t.join(timeout=600.0)
+        stragglers = sum(1 for t in threads if t.is_alive())
+        if stragglers:
+            # surfaced, not silent: the result below aggregates a PARTIAL
+            # run (the snapshot under the lock keeps the straggler's late
+            # appends from racing the sort)
+            logging.getLogger(__name__).warning(
+                "%d eval worker(s) still wedged after the 600s join; "
+                "aggregating partial results", stragglers,
+            )
     wall_s = time.perf_counter() - t_start
 
-    latencies.sort()
+    with lock:
+        latencies = sorted(latencies)
+        hits = list(hits)
+        n_errors = errors
     n_ok = len(latencies)
     return EvalResult(
         name=name,
@@ -118,5 +133,5 @@ def run_queries(
         p50_ms=_percentile(latencies, 0.50),
         p95_ms=_percentile(latencies, 0.95),
         qps=n_ok / wall_s if wall_s > 0 else 0.0,
-        errors=errors,
+        errors=n_errors,
     )

@@ -7,12 +7,20 @@ module is that hook: named injection points are planted at the framework's
 failure-relevant seams (device dispatch in the generator engine, retriever
 legs, reranker batches), default to no-ops with near-zero overhead, and
 tests (or chaos drills) arm them with rules — fail N times, fail with a
-given exception, add latency, fail with probability p under a seeded RNG.
+given exception, add latency, fail with probability p under a seeded RNG,
+or **stall**: block inside the injection point for a duration (or until the
+test releases an event), simulating the wedged device dispatch that raises
+nothing but never returns — the hang class of fault the watchdog layer
+(runtime/replica.py) exists to detect.
 
 Usage:
 
     with inject("engine.generate", error=TimeoutError("deadline"), times=2):
         ...  # first two generate dispatches raise, third proceeds
+
+    release = threading.Event()
+    with inject("paged.step", stall_event=release, stall_s=60.0, times=1):
+        ...  # the next decode tick wedges until release.set() (60s cap)
 
 Planting a point in framework code:
 
@@ -45,15 +53,25 @@ class FaultRule:
     * ``probability`` — fire with this probability (seeded ``rng`` makes it
       deterministic in tests).
     * ``delay_s`` — sleep before (optionally) failing: deadline simulation.
+    * ``stall_s`` / ``stall_event`` — the **hang** fault: block inside the
+      injection point for ``stall_s`` seconds, or until the test sets
+      ``stall_event`` (whichever comes first; ``stall_s=None`` with an
+      event means wait for the release alone). The stall happens on the
+      CALLING thread — arming it at ``paged.step`` wedges that replica's
+      pump exactly like a hung device dispatch. Composes with ``error``:
+      stall first, then raise (a dispatch that hangs and THEN dies).
     """
 
     error: Optional[BaseException] = None
     times: Optional[int] = None
     probability: float = 1.0
     delay_s: float = 0.0
+    stall_s: Optional[float] = None
+    stall_event: Optional[threading.Event] = None
     rng: random.Random = field(default_factory=lambda: random.Random(0))
     hits: int = 0
     fired: int = 0
+    stalled: int = 0
 
     def should_fire(self) -> bool:
         if self.times is not None and self.fired >= self.times:
@@ -99,6 +117,16 @@ def hit(point: str) -> None:
             rule.fired += 1
         delay = rule.delay_s if fire else 0.0
         error = rule.error if fire else None
+        stall_s = rule.stall_s if fire else None
+        stall_event = rule.stall_event if fire else None
+        if fire and (stall_s is not None or stall_event is not None):
+            rule.stalled += 1
+    # stall OUTSIDE the registry lock: a wedged injection point must not
+    # block every other point's (unarmed, fast-path-missed) hit
+    if stall_event is not None:
+        stall_event.wait(stall_s)
+    elif stall_s is not None and stall_s > 0:
+        time.sleep(stall_s)
     if delay > 0:
         time.sleep(delay)
     if error is not None:
@@ -112,13 +140,18 @@ def inject(
     times: Optional[int] = None,
     probability: float = 1.0,
     delay_s: float = 0.0,
+    stall_s: Optional[float] = None,
+    stall_event: Optional[threading.Event] = None,
     seed: int = 0,
 ) -> Iterator[FaultRule]:
     """Arm ``point`` for the duration of the block; yields the rule so the
-    test can assert on ``hits``/``fired``."""
+    test can assert on ``hits``/``fired``/``stalled``. NB: exiting the block
+    disarms the point but does NOT release threads already wedged inside a
+    stall — set the ``stall_event`` (or bound ``stall_s``) to free them."""
     rule = FaultRule(
         error=error, times=times, probability=probability,
-        delay_s=delay_s, rng=random.Random(seed),
+        delay_s=delay_s, stall_s=stall_s, stall_event=stall_event,
+        rng=random.Random(seed),
     )
     arm(point, rule)
     try:

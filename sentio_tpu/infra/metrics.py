@@ -217,6 +217,16 @@ class MetricsCollector:
                 "replica health state machine position (1 = current state)",
                 ["replica", "state"], registry=r,
             ),
+            # stall watchdog: seconds since a replica's decode pump last
+            # completed a loop iteration WITH pending work (0 = idle or
+            # freshly ticked). A tick wedged inside a device dispatch
+            # raises nothing — this gauge climbing toward the stall budget
+            # is the only early signal; monitoring.yaml alerts on it
+            "pump_heartbeat_age": Gauge(
+                "sentio_tpu_pump_heartbeat_age_seconds",
+                "decode pump heartbeat age under pending work",
+                ["replica"], registry=r,
+            ),
         }
 
     # ------------------------------------------------------------- recording
@@ -331,6 +341,18 @@ class MetricsCollector:
         gauge = self._prom.get("replica_stat")
         if gauge is not None:
             gauge.labels(replica=str(replica), stat=key).set(value)
+
+    def record_heartbeat_age(self, replica: int, age_s: float) -> None:
+        """Publish one replica's pump heartbeat age (0.0 = idle or fresh).
+        Set each watchdog pass, so the gauge's scrape-to-scrape slope under
+        a wedged pump is ~1 s/s — the stall signature dashboards alert
+        on."""
+        if not self.enabled:
+            return
+        self.memory.set_gauge("pump_heartbeat_age", (str(replica),), age_s)
+        gauge = self._prom.get("pump_heartbeat_age")
+        if gauge is not None:
+            gauge.labels(replica=str(replica)).set(age_s)
 
     def record_replica_health(self, replica: int, state: str) -> None:
         """Publish one replica's health-state transition: the new state's

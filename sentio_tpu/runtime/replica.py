@@ -65,10 +65,29 @@ domain with a supervised health state machine::
   per-request failover budget. Streams with delivered tokens stay
   non-resumable and surface a typed error.
 
+**Stall tolerance** — the breaker only sees faults that *raise*; a tick
+that hangs inside a wedged device dispatch raises nothing. The supervisor
+pass doubles as a **watchdog**: each service stamps a pump heartbeat per
+loop iteration, and a heartbeat stale past the service's
+``tick_stall_budget_s`` *with pending work* quarantines the replica with
+no exception observed. Since a thread blocked in XLA cannot be killed,
+recovery **abandons** the wedged engine+service (admitted tickets fail
+typed and fail over; the leaked pump is accounted and the count carried
+across the incarnation swap) and rebuilds the slot via the normal
+``spawn_fresh`` path. At *any* quarantine — stall or breaker — the dead
+replica's queued-but-never-dispatched **inbox tickets are handed off**
+directly to survivors (WFQ release/re-charge via
+:meth:`TenantFairQueue.recharge`); the blocked caller wakes with the
+survivor's result without spending failover budget. Rebuilds run on a
+bounded **worker pool** so detection cadence never waits behind a long
+(or wedged) rebuild.
+
 Health transitions emit flight-recorder events and the
-``sentio_tpu_replica_health{replica,state}`` gauge; ``health_summary()``
-feeds ``/health`` so an N-replica pod reports ``degraded`` (keep routing)
-rather than ``unhealthy`` (restart me) while at least one replica serves.
+``sentio_tpu_replica_health{replica,state}`` gauge (plus
+``sentio_tpu_pump_heartbeat_age_seconds`` per watchdog pass);
+``health_summary()`` feeds ``/health`` so an N-replica pod reports
+``degraded`` (keep routing) rather than ``unhealthy`` (restart me) while
+at least one replica serves.
 
 Threading: routing probes (``peek_prefix``, ``backlog``, ``projected_wait``)
 are advisory reads against live replicas; all ReplicaSet/TenantFairQueue
@@ -79,6 +98,7 @@ across a generate call, a device tick, or a rebuild.
 from __future__ import annotations
 
 import logging
+import queue as _queue
 import threading
 import time
 from collections import deque
@@ -87,9 +107,16 @@ from typing import Iterator, Optional, Sequence
 
 from sentio_tpu.analysis.sanitizer import assert_held, make_lock
 from sentio_tpu.infra import faults
-from sentio_tpu.infra.exceptions import ReplicaUnavailable, ServiceOverloaded
+from sentio_tpu.infra.exceptions import (
+    ReplicaUnavailable,
+    SentioError,
+    ServiceOverloaded,
+)
 from sentio_tpu.infra.metrics import get_metrics
-from sentio_tpu.runtime.service import PagedGenerationService
+from sentio_tpu.runtime.service import (
+    PagedGenerationService,
+    finish_ticket_error,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -139,6 +166,9 @@ class _ReplicaHealth:
     next_rebuild_at: float = 0.0  # earliest perf_counter for a rebuild try
     rebuild_attempts: int = 0     # failed attempts THIS quarantine episode
     rebuilds: int = 0             # lifetime successful in-place rebuilds
+    # a rebuild for this replica is queued on (or running on) the worker
+    # pool: the next supervisor pass must not enqueue it again
+    rebuild_inflight: bool = False
 
 
 @dataclass
@@ -316,6 +346,50 @@ class TenantFairQueue:
                 get_metrics().record_tenant_admitted(tenant)
             return tenant
 
+    def recharge(self, tenant: str, cost_tokens: int,
+                 priority: str = PRIORITY_INTERACTIVE) -> None:
+        """Atomically release + re-admit one HELD reservation — the
+        quarantine inbox handoff's WFQ move. The ticket is already pending
+        (its caller still blocks on it), so this re-evaluates the quota and
+        priority rules as if the reservation were being granted now: on
+        success the pending count is unchanged and one admission is
+        recorded (a handoff is an attempt, like a failover retry); on shed
+        the original reservation is RESTORED before the typed error raises,
+        so the caller's eventual ``release`` still balances. The deficit is
+        untouched — the tokens were debited at original admission and the
+        handoff does not re-spend them."""
+        now = time.perf_counter()
+        with self._mutex:
+            state = self._tenants.get(tenant)
+            if state is None or state.pending == 0:
+                return  # already released (racing completion): nothing held
+            self._refill_locked(state, now)
+            state.pending -= 1
+            try:
+                total_pending = sum(s.pending for s in self._tenants.values())
+                quota = self._quota_locked(tenant, state)
+                if state.pending >= quota:
+                    self._shed_locked(
+                        tenant, state, "tenant_quota",
+                        f"tenant {tenant!r} is over its fair-share quota at "
+                        f"handoff ({state.pending + 1}/{quota} of "
+                        f"{self.capacity} total)",
+                        status=429, retry_after_s=1.0,
+                    )
+                if priority == PRIORITY_BATCH and total_pending + 1 > \
+                        self.batch_shed_fraction * self.capacity:
+                    self._shed_locked(
+                        tenant, state, "priority_batch",
+                        f"batch-tier handoff shed at {total_pending + 1}/"
+                        f"{self.capacity} pending (batch yields to "
+                        "interactive)",
+                        status=503, retry_after_s=2.0,
+                    )
+            finally:
+                state.pending += 1
+            state.admitted += 1
+            get_metrics().record_tenant_admitted(tenant)
+
     def release(self, tenant: str, cost_tokens: int,
                 actual_tokens: Optional[int] = None) -> None:
         """Return one admission. ``actual_tokens`` (when known) corrects the
@@ -385,6 +459,7 @@ class ReplicaSet:
         rebuild_budget: int = 3,
         rebuild_drain_s: float = 5.0,
         failover_budget: int = 1,
+        rebuild_workers: int = 1,
     ) -> None:
         services = list(services)
         if not services:
@@ -451,12 +526,40 @@ class ReplicaSet:
         ]  # guarded-by: _mutex
         self._failovers = 0  # guarded-by: _mutex
         self._closed = False  # guarded-by: _mutex
+        # stall-tolerance telemetry: inbox tickets moved to survivors at
+        # quarantine, stall-triggered quarantines, and pump_leaked counts
+        # carried over from service incarnations a rebuild replaced (the
+        # per-replica sum only sees CURRENT incarnations — without the
+        # carryover an abandoned wedged pump would vanish from stats)
+        self._handed_off = 0  # guarded-by: _mutex
+        self._stall_quarantines = 0  # guarded-by: _mutex
+        self._pump_leaked_carryover = 0  # guarded-by: _mutex
         metrics = get_metrics()
         for i in range(len(services)):
             metrics.record_replica_health(i, HEALTH_HEALTHY)
         self._stop = threading.Event()
         self._supervisor: Optional[threading.Thread] = None
+        # rebuild worker pool: rebuilds are seconds-to-minutes of drain +
+        # compile — running them on the supervisor thread would delay the
+        # NEXT breaker/watchdog pass behind them. With the pool, the
+        # supervisor only detects and enqueues; workers rebuild. Without a
+        # supervisor (test mode) _supervise_once rebuilds inline so
+        # deterministic stepping keeps working.
+        self.rebuild_workers = max(int(rebuild_workers), 0)
+        self._rebuild_q: Optional[_queue.Queue] = None
+        self._rebuild_pool: list[threading.Thread] = []
         if supervise:
+            if self.rebuild_workers > 0:
+                self._rebuild_q = _queue.Queue()
+                self._rebuild_pool = [
+                    threading.Thread(
+                        target=self._rebuild_worker,
+                        name=f"replica-rebuild-{k}", daemon=True,
+                    )
+                    for k in range(self.rebuild_workers)
+                ]
+                for t in self._rebuild_pool:
+                    t.start()
             self._supervisor = threading.Thread(
                 target=self._supervise_loop, name="replica-supervisor",
                 daemon=True,
@@ -545,6 +648,16 @@ class ReplicaSet:
             details={"replica_states": states},
         )
 
+    def _least_loaded(self, eligible: Sequence[int]) -> int:
+        """The least-loaded replica among ``eligible`` (projected wait,
+        then backlog, then index) — the routing stage-2 key, shared with
+        the quarantine inbox handoff's survivor choice."""
+        def load_key(i: int):
+            svc = self._services[i]
+            return (svc.projected_wait() or 0.0, svc.backlog(), i)
+
+        return min(eligible, key=load_key)
+
     def _rebuild_eta_locked(self) -> float:  # lock-held: _mutex
         """Seconds until the next quarantined replica is due a rebuild try
         — the honest Retry-After for an all-replicas-down shed."""
@@ -583,11 +696,7 @@ class ReplicaSet:
                 with self._mutex:
                     self._affinity_overflow += 1
 
-        def load_key(i):
-            svc = self._services[i]
-            return (svc.projected_wait() or 0.0, svc.backlog(), i)
-
-        idx = min(eligible, key=load_key)
+        idx = self._least_loaded(eligible)
         if count:
             with self._mutex:
                 self._routed_load += 1
@@ -638,6 +747,10 @@ class ReplicaSet:
                     temperature=temperature, timeout_s=timeout_s,
                     request_id=request_id, deadline_s=deadline_s,
                     deadline_ts=deadline_ts, top_k=top_k,
+                    # opaque WFQ metadata riding the ticket: the quarantine
+                    # inbox handoff uses it to release/re-charge this
+                    # reservation when the ticket moves to a survivor
+                    tenant=charged, priority=priority, cost_tokens=cost,
                 )
             except BaseException as exc:
                 # failed before (shed) or during decode: refund the
@@ -694,6 +807,11 @@ class ReplicaSet:
             max_new_tokens=max_new_tokens, temperature=temperature,
             timeout_s=timeout_s, request_id=request_id,
             deadline_s=deadline_s, deadline_ts=deadline_ts, top_k=top_k,
+            # WFQ handoff metadata (see generate): streams charge at first
+            # next(), so the RAW tenant key is stamped — an
+            # overflow-bucketed tenant simply skips the recharge
+            tenant=tenant or DEFAULT_TENANT, priority=priority,
+            cost_tokens=len(toks) + max_new_tokens,
         )
         # the replica's own generate_stream runs its CALL-time validation
         # (top_k vs paged speculation) here, before any SSE 200 commits;
@@ -824,7 +942,7 @@ class ReplicaSet:
                                                         False):
             self._quarantine(idx, f"replica latched unavailable: {exc}")
 
-    def _quarantine(self, idx: int, reason: str) -> None:
+    def _quarantine(self, idx: int, reason: str, stalled: bool = False) -> None:
         now = time.perf_counter()
         with self._mutex:
             health = self._health[idx]
@@ -835,7 +953,92 @@ class ReplicaSet:
             # first rebuild try is immediate (next supervisor pass); the
             # exponential backoff applies to FAILED rebuild attempts
             health.next_rebuild_at = now
+            if stalled:
+                self._stall_quarantines += 1
         self._transition(idx, HEALTH_QUARANTINED, reason)
+        svc = self._services[idx]
+        inbox: list = []
+        if stalled:
+            # a wedged pump cannot be killed: abandon the engine+service
+            # outright — admitted tickets fail typed (their KV dies with
+            # the wedged engine; callers fail over), inbox tickets hand off
+            try:
+                inbox = svc.abandon(reason)
+            except Exception:  # noqa: BLE001 — quarantine must complete
+                logger.exception("replica %d abandon failed", idx)
+        else:
+            # breaker quarantine of a WORKING replica: in-flight work gets
+            # the rebuild's drain grace, but queued-never-dispatched
+            # tickets would otherwise sit out the whole rebuild — move them
+            try:
+                inbox = svc.extract_inbox()
+            except Exception:  # noqa: BLE001
+                logger.exception("replica %d inbox extraction failed", idx)
+        self._handoff_inbox(idx, inbox)
+
+    def _handoff_inbox(self, idx: int, tickets: list) -> None:
+        """Quarantine inbox handoff: re-admit the dead replica's
+        never-dispatched tickets directly to surviving replicas instead of
+        leaving them to ride each caller's failover loop (which only fires
+        after the caller OBSERVES a failure — for a queued ticket that
+        means waiting out its full deadline). Each ticket's WFQ reservation
+        is released and re-charged (``TenantFairQueue.recharge``); a ticket
+        no survivor can take fails with the typed error the caller's
+        failover budget is NOT billed for — the ticket object itself moves,
+        so the blocked caller just wakes with a result (or a typed
+        error)."""
+        if not tickets:
+            return
+        moved = 0
+        for ticket in tickets:
+            exc: Optional[Exception] = None
+            if ticket.tenant is not None:
+                try:
+                    self.tenants.recharge(
+                        ticket.tenant, ticket.cost_tokens,
+                        priority=ticket.priority or PRIORITY_INTERACTIVE,
+                    )
+                except ServiceOverloaded as shed:
+                    exc = shed
+            if exc is None:
+                try:
+                    eligible = self._eligible(exclude=frozenset({idx}))
+                    target = self._least_loaded(eligible)
+                    self._services[target].adopt(ticket)
+                    moved += 1
+                    continue
+                except Exception as adopt_exc:  # noqa: BLE001 — typed below
+                    exc = adopt_exc
+            if not isinstance(exc, SentioError):
+                # the caller blocked on this ticket must never see an
+                # untyped infrastructure error
+                exc = ReplicaUnavailable(
+                    f"inbox handoff failed: {exc}", retry_after_s=2.0,
+                    details={"replica": idx},
+                )
+            self._finish_handoff_ticket(ticket, exc)
+        with self._mutex:
+            self._handed_off += moved
+        logger.warning("replica %d quarantine: %d/%d inbox tickets handed "
+                       "off to survivors", idx, moved, len(tickets))
+        try:
+            from sentio_tpu.infra.flight import get_flight_recorder
+
+            get_flight_recorder().record_tick(
+                event="inbox_handoff", replica=idx,
+                handed_off=moved, failed=len(tickets) - moved,
+            )
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            logger.debug("handoff telemetry failed", exc_info=True)
+
+    @staticmethod
+    def _finish_handoff_ticket(ticket, exc: Exception) -> None:
+        """Terminal typed outcome for a ticket no survivor could adopt.
+        The ticket was extracted from its dead service's inbox, so this
+        thread owns it exclusively — no service lock applies; the shared
+        sequence in runtime/service.py keeps this path byte-identical to
+        the normal in-service error path."""
+        finish_ticket_error(ticket, exc, "failed_over")
 
     def _prune_locked(self, series: deque, now: float) -> None:  # lock-held: _mutex
         assert_held(self._mutex)
@@ -882,10 +1085,53 @@ class ReplicaSet:
                     fails = sum(1 for _, ok in health.outcomes if not ok)
                     samples = len(health.outcomes)
                 rebuild_due = (state == HEALTH_QUARANTINED
-                               and now >= health.next_rebuild_at)
+                               and now >= health.next_rebuild_at
+                               and not health.rebuild_inflight)
             if state in (HEALTH_QUARANTINED, HEALTH_REBUILDING):
+                # zero the heartbeat gauge for out-of-rotation replicas:
+                # left at its last (over-budget) value it would keep the
+                # stall alert firing for the whole rebuild, making
+                # "watchdog acted" indistinguishable from "watchdog dead"
+                try:
+                    get_metrics().record_heartbeat_age(idx, 0.0)
+                except Exception:  # noqa: BLE001 — telemetry best-effort
+                    pass
                 if rebuild_due:
                     rebuild_ready.append(idx)
+                continue
+            # ---- stall watchdog (detection only — recovery is the normal
+            # quarantine → abandon → rebuild path). A pump wedged inside a
+            # device dispatch raises nothing and latches nothing; the only
+            # observable is a stale heartbeat WITH pending work, so this
+            # check needs no exception to fire.
+            budget = getattr(svc, "tick_stall_budget_s", 0.0) or 0.0
+            age = None
+            if budget > 0:
+                try:
+                    age = svc.heartbeat_age()
+                except Exception:  # noqa: BLE001 — service mid-swap
+                    pass
+            try:
+                get_metrics().record_heartbeat_age(
+                    idx, age if age is not None else 0.0)
+            except Exception:  # noqa: BLE001 — telemetry best-effort
+                pass
+            if age is not None and age > budget:
+                try:
+                    from sentio_tpu.infra.flight import get_flight_recorder
+
+                    get_flight_recorder().record_tick(
+                        event="pump_stall", replica=idx,
+                        heartbeat_age_s=round(age, 3), budget_s=budget,
+                    )
+                except Exception:  # noqa: BLE001
+                    logger.debug("stall telemetry failed", exc_info=True)
+                self._quarantine(
+                    idx,
+                    f"pump stalled: heartbeat {age:.1f}s old with pending "
+                    f"work (budget {budget:.0f}s)",
+                    stalled=True,
+                )
                 continue
             if getattr(svc, "broken", False):
                 self._quarantine(idx, "engine latched broken (reset failed)")
@@ -906,8 +1152,44 @@ class ReplicaSet:
             elif state == HEALTH_DEGRADED:
                 self._transition(idx, HEALTH_HEALTHY, "window clean")
         for idx in rebuild_ready:
-            if not self._stop.is_set():
+            if self._stop.is_set():
+                break
+            if not self._enqueue_rebuild(idx):
+                # no worker pool (supervise=False test mode): rebuild
+                # inline so deterministic _supervise_once stepping keeps
+                # its synchronous contract
                 self._rebuild(idx)
+
+    def _enqueue_rebuild(self, idx: int) -> bool:
+        """Hand one due rebuild to the worker pool (False = no pool, run
+        inline). Marks the replica's rebuild in-flight so the next
+        detection pass — which keeps running at the probe cadence while
+        workers rebuild — does not enqueue it twice."""
+        if self._rebuild_q is None:
+            return False
+        with self._mutex:
+            health = self._health[idx]
+            if health.rebuild_inflight:
+                return True  # already queued or running
+            health.rebuild_inflight = True
+        self._rebuild_q.put(idx)
+        return True
+
+    def _rebuild_worker(self) -> None:
+        """One bounded-pool rebuild worker: detection (supervisor) cadence
+        is decoupled from rebuild duration — a minutes-long (or wedged)
+        rebuild occupies a worker, not the supervisor's breaker pass."""
+        while not self._stop.is_set():
+            try:
+                idx = self._rebuild_q.get(timeout=0.25)
+            except _queue.Empty:
+                continue
+            if idx is None:
+                return  # shutdown sentinel
+            try:
+                self._rebuild(idx)
+            except Exception:  # noqa: BLE001 — the pool must survive
+                logger.exception("replica %d rebuild crashed on worker", idx)
 
     def _rebuild(self, idx: int) -> bool:
         """In-place rebuild of a quarantined replica: fresh engine + pool +
@@ -916,6 +1198,7 @@ class ReplicaSet:
         never under ``_mutex``, since it compiles and decodes."""
         with self._mutex:
             attempt = self._health[idx].rebuild_attempts + 1
+            self._health[idx].rebuild_inflight = True
         self._transition(idx, HEALTH_REBUILDING, f"rebuild attempt {attempt}")
         fresh: Optional[PagedGenerationService] = None
         try:
@@ -925,7 +1208,10 @@ class ReplicaSet:
                 try:
                     # error-rate quarantines leave a WORKING service: give
                     # its in-flight callers a bounded window to finish
-                    # before the swap orphans them
+                    # before the swap orphans them. An ABANDONED (stalled)
+                    # service has no pending tickets left, so this returns
+                    # immediately and close()'s join — bounded by the drain
+                    # deadline's remainder — counts the wedged pump leaked
                     old.drain(self.rebuild_drain_s)
                 except Exception:  # noqa: BLE001 — drain is best-effort
                     logger.warning("replica %d pre-rebuild drain failed",
@@ -941,6 +1227,7 @@ class ReplicaSet:
                 default_deadline_s=old.default_deadline_s,
                 retry_budget=old.retry_budget,
                 replica_id=idx,
+                tick_stall_budget_s=old.tick_stall_budget_s,
             )
             self._warm_rebuilt(fresh)
             if self._stop.is_set():
@@ -948,8 +1235,13 @@ class ReplicaSet:
                 # closing rotation
                 fresh.close()
                 return False
+            # the old incarnation leaves rotation: carry its leaked-pump
+            # count (the wedged pump a stall abandonment left behind) so
+            # the set's summed pump_leaked never silently shrinks
+            leaked = old.pump_leaked_count
             with self._mutex:
                 self._services[idx] = fresh
+                self._pump_leaked_carryover += leaked
                 health = self._health[idx]
                 health.outcomes.clear()
                 health.tick_fails.clear()
@@ -987,6 +1279,10 @@ class ReplicaSet:
             self._transition(idx, HEALTH_QUARANTINED,
                              f"rebuild failed: {exc}")
             return False
+        finally:
+            with self._mutex:
+                if idx < len(self._health):
+                    self._health[idx].rebuild_inflight = False
 
     def _warm_rebuilt(self, fresh: PagedGenerationService) -> None:
         """Warm a rebuilt replica before it re-enters rotation. Under an
@@ -1059,6 +1355,20 @@ class ReplicaSet:
                     "replica supervisor did not exit within %.0fs "
                     "(rebuild in flight?)", timeout_s,
                 )
+        if self._rebuild_q is not None:
+            for _ in self._rebuild_pool:
+                self._rebuild_q.put(None)  # wake idle workers immediately
+            for t in self._rebuild_pool:
+                if t.is_alive():
+                    t.join(timeout=timeout_s)
+                    if t.is_alive():
+                        # a worker wedged inside a stalled rebuild cannot
+                        # be killed — it checks _stop before swapping, so
+                        # abandoning it is bounded; surface the leak
+                        logger.warning(
+                            "rebuild worker %s did not exit within %.0fs "
+                            "(stalled rebuild?)", t.name, timeout_s,
+                        )
 
     def warmup(self, max_new_tokens: int = 4) -> dict:
         """Warm EVERY replica CONCURRENTLY (each compiles its own jit
@@ -1084,7 +1394,10 @@ class ReplicaSet:
         for t in threads:
             t.start()
         for t in threads:
-            t.join()
+            # each replica warmup bounds its own generations; the join only
+            # outwaits that, never blocks startup forever on a wedged pump
+            t.join(timeout=max(svc.default_timeout_s
+                               for svc in self._services) + 120.0)
         if errors:
             raise errors[0]
         return {
@@ -1213,6 +1526,15 @@ class ReplicaSet:
                 "affinity_overflow": self._affinity_overflow,
             }
             agg["failovers"] = self._failovers
+            # stall tolerance: tickets moved at quarantine, stall-triggered
+            # quarantines, and leaked pumps whose service incarnation a
+            # rebuild already replaced (summed pump_leaked above only sees
+            # the CURRENT incarnations)
+            agg["handed_off"] = self._handed_off
+            agg["stall_quarantines"] = self._stall_quarantines
+            agg["pump_leaked"] = (
+                agg.get("pump_leaked", 0) + self._pump_leaked_carryover
+            )
         agg["tenants"] = self.tenants.stats()
         agg["health"] = self.health_summary()
         return agg

@@ -79,6 +79,26 @@ class GenerationTimeout(Exception):
     pass
 
 
+def finish_ticket_error(ticket: "_Ticket", exc: Exception,
+                        finish_reason: str) -> None:
+    """THE terminal typed-error sequence for a ticket, shared by every
+    path that ends one: result-free error, flight-record close, stream
+    ``("err", exc)``, event set — exactly once (the event guard makes it
+    idempotent). Caller must own the ticket: either hold the owning
+    service's ``_mutex`` (``_finish_error_locked``) or hold it exclusively
+    off any service's books (the ReplicaSet's quarantine handoff)."""
+    if ticket.event.is_set():
+        return
+    ticket.error = exc
+    if ticket.request_id:
+        get_flight_recorder().finish_engine(
+            ticket.request_id, finish_reason=finish_reason, error=str(exc)
+        )
+    if ticket.stream_q is not None:
+        ticket.stream_q.put(("err", exc))
+    ticket.event.set()
+
+
 @dataclass
 class _Ticket:
     prompt: str
@@ -117,6 +137,13 @@ class _Ticket:
     # post-first-tick interval by the tokens produced IN that interval (a
     # fused tick emits up to steps_per_tick tokens at once)
     tokens_first: int = 0
+    # opaque fair-queueing metadata stamped by a fronting ReplicaSet
+    # (runtime/replica.py): the service itself never reads these — they ride
+    # the ticket so a quarantine-time inbox handoff can release/re-charge
+    # the owning tenant's WFQ reservation on the surviving replica
+    tenant: Optional[str] = None
+    priority: Optional[str] = None
+    cost_tokens: int = 0
 
     @property
     def path(self) -> str:
@@ -135,6 +162,7 @@ class PagedGenerationService:
         default_deadline_s: Optional[float] = None,
         retry_budget: int = 1,
         replica_id: int = 0,
+        tick_stall_budget_s: float = 120.0,
     ) -> None:
         self.engine = engine
         self.default_timeout_s = default_timeout_s
@@ -155,6 +183,13 @@ class PagedGenerationService:
         self.default_deadline_s = default_deadline_s
         # crash containment: requeues granted per ticket across failed ticks
         self.retry_budget = max(int(retry_budget), 0)
+        # wall-clock budget one pump loop iteration may take before a
+        # watchdog (ReplicaSet._supervise_once) declares the replica
+        # STALLED: a tick blocked inside a wedged device dispatch raises
+        # nothing, so heartbeat age is the only observable. Must comfortably
+        # exceed the slowest legitimate tick INCLUDING a cold XLA compile;
+        # 0 disables stall detection for this service.
+        self.tick_stall_budget_s = max(float(tick_stall_budget_s), 0.0)
         # inbox + bookkeeping ONLY, never device work
         self._mutex = make_lock("PagedGenerationService._mutex")
         self._inbox: list[_Ticket] = []  # guarded-by: _mutex
@@ -172,6 +207,17 @@ class PagedGenerationService:
         self._requeued = 0  # guarded-by: _mutex
         self._tick_failures = 0  # guarded-by: _mutex
         self._pump_leaked = 0  # guarded-by: _mutex
+        # stamped by the pump each loop iteration (perf_counter); 0.0 until
+        # the first pump starts. The watchdog reads it through
+        # heartbeat_age(): a running pump with pending work whose stamp
+        # goes stale is wedged inside a dispatch — no exception to catch
+        self._heartbeat_ts = 0.0  # guarded-by: _mutex
+        # latched by abandon(): the replica layer gave up on a wedged pump
+        self._abandoned = False  # guarded-by: _mutex
+        # warmup in progress: ticks legitimately run cold XLA compiles far
+        # past any sane stall budget, so the watchdog stands down (a
+        # genuinely wedged warmup is still bounded by its generate timeouts)
+        self._warming = False  # guarded-by: _mutex
         # EMA of recent TTFT seconds, updated by the pump — the projected-
         # wait estimate admission control weighs against a deadline
         self._ttft_ema = 0.0  # guarded-by: _mutex
@@ -194,6 +240,9 @@ class PagedGenerationService:
         deadline_s: Optional[float] = None,
         deadline_ts: Optional[float] = None,
         top_k: int = 0,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
+        cost_tokens: int = 0,
     ) -> PagedResult:
         """Submit one request and block until its tokens are done. Safe to
         call from any number of threads concurrently — that concurrency IS
@@ -205,13 +254,19 @@ class PagedGenerationService:
         the deadline is unmeetable, and the pump cancels the request the
         tick its deadline passes. Raises :class:`ServiceOverloaded` (shed),
         :class:`DeadlineExceededError` (expired), or
-        :class:`GenerationTimeout` (no deadline, plain timeout)."""
+        :class:`GenerationTimeout` (no deadline, plain timeout).
+
+        ``tenant``/``priority``/``cost_tokens`` are opaque WFQ metadata a
+        fronting ReplicaSet stamps for quarantine-time inbox handoff; a
+        bare service ignores them."""
         self._check_top_k(top_k)
         deadline_ts = self._resolve_deadline(deadline_s, deadline_ts)
         ticket = _Ticket(prompt, max_new_tokens, temperature, top_k=top_k,
                          request_id=request_id, t_submit=time.perf_counter(),
                          deadline_ts=deadline_ts,
-                         retries_left=self.retry_budget)
+                         retries_left=self.retry_budget,
+                         tenant=tenant, priority=priority,
+                         cost_tokens=int(cost_tokens))
         if request_id:
             get_flight_recorder().note_engine_submit(
                 request_id, replica_id=self.replica_id)
@@ -263,6 +318,9 @@ class PagedGenerationService:
         deadline_s: Optional[float] = None,
         deadline_ts: Optional[float] = None,
         top_k: int = 0,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
+        cost_tokens: int = 0,
     ) -> Iterator[str]:
         """Streaming variant: yields decoded text increments as the shared
         decode batch produces them (chunks of up to steps_per_tick tokens —
@@ -277,7 +335,7 @@ class PagedGenerationService:
         self._check_top_k(top_k)
         return self._generate_stream_impl(
             prompt, max_new_tokens, temperature, timeout_s, request_id,
-            deadline_s, deadline_ts, top_k,
+            deadline_s, deadline_ts, top_k, tenant, priority, cost_tokens,
         )
 
     def _generate_stream_impl(
@@ -290,6 +348,9 @@ class PagedGenerationService:
         deadline_s: Optional[float],
         deadline_ts: Optional[float],
         top_k: int,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
+        cost_tokens: int = 0,
     ) -> Iterator[str]:
         # NB: admission below is still deferred to the first next() (the
         # long-standing stream contract — SSE handlers pre-check via
@@ -299,7 +360,9 @@ class PagedGenerationService:
                          stream_q=_queue.Queue(),
                          request_id=request_id, t_submit=time.perf_counter(),
                          deadline_ts=deadline_ts,
-                         retries_left=self.retry_budget)
+                         retries_left=self.retry_budget,
+                         tenant=tenant, priority=priority,
+                         cost_tokens=int(cost_tokens))
         if request_id:
             get_flight_recorder().note_engine_submit(
                 request_id, replica_id=self.replica_id)
@@ -426,11 +489,89 @@ class PagedGenerationService:
                 len(self._inbox) + len(self._tickets)
             )
 
+    def heartbeat_age(self) -> Optional[float]:
+        """Seconds since the pump last completed a loop iteration, or None
+        when there is nothing to detect: no pump running, or no pending
+        work (an idle service is never stalled). A non-None age past
+        ``tick_stall_budget_s`` means the pump is wedged inside a dispatch
+        that raises nothing — the watchdog's only observable for the hang
+        fault class."""
+        with self._mutex:
+            if not self._pump_running or self._abandoned or self._warming:
+                return None
+            if not self._inbox and not self._tickets:
+                return None
+            if self._heartbeat_ts <= 0.0:
+                return None
+            return max(time.perf_counter() - self._heartbeat_ts, 0.0)
+
+    def extract_inbox(self) -> list[_Ticket]:
+        """Remove and return every never-dispatched inbox ticket (the
+        quarantine handoff: these hold NO engine or KV state, so a
+        surviving replica can adopt them wholesale). Cancelled/expired
+        stragglers are closed out here rather than handed off. Safe against
+        a wedged pump — it blocks OUTSIDE ``_mutex``, inside the device
+        dispatch."""
+        now = time.perf_counter()
+        out: list[_Ticket] = []
+        with self._mutex:
+            for ticket in self._inbox:
+                if ticket.event.is_set():
+                    continue
+                if ticket.cancelled:
+                    self._close_cancelled_locked(ticket)
+                    continue
+                if ticket.deadline_ts is not None and now >= ticket.deadline_ts:
+                    self._expired += 1
+                    get_metrics().record_shed("expired")
+                    self._finish_error_locked(
+                        ticket,
+                        DeadlineExceededError(
+                            "deadline expired before admission"),
+                        "expired",
+                    )
+                    continue
+                out.append(ticket)
+            self._inbox.clear()
+        return out
+
+    def adopt(self, ticket: _Ticket) -> None:
+        """Admit a ticket object handed off from a quarantined sibling
+        replica. Runs the normal admission checks (closed/broken/queue
+        bound/deadline projection) — raises the same typed errors a fresh
+        submit would, which the handoff layer turns into the ticket's
+        terminal outcome."""
+        with self._mutex:
+            self._admit_ticket_locked(ticket)
+
+    def abandon(self, reason: str) -> list[_Ticket]:
+        """Give up on this service because its pump is wedged inside a
+        device dispatch (stall-quarantine). A thread blocked in XLA cannot
+        be killed, so recovery is abandonment: latch ``_broken`` (typed 503
+        admissions from now on), fail every ADMITTED ticket with a typed
+        :class:`ReplicaUnavailable` (their KV state dies with the wedged
+        engine — generate callers fail over, delivered-token streams get
+        the typed mid-stream error), and return the never-dispatched inbox
+        tickets for handoff. Never joins the pump — ``close()`` does the
+        bounded join and accounts the leak in ``pump_leaked``."""
+        exc = ReplicaUnavailable(
+            f"replica abandoned: {reason}", retry_after_s=2.0,
+            details={"replica": self.replica_id, "reason": "stalled"},
+        )
+        with self._mutex:
+            self._abandoned = True
+            self._broken = True
+            for ticket in list(self._tickets.values()):
+                self._finish_error_locked(ticket, exc, "stalled")
+            self._tickets.clear()
+        return self.extract_inbox()
+
     @property
     def broken(self) -> bool:
-        """Latched after a failed tick whose ``engine.reset()`` ALSO failed:
-        the engine's device state is unrecoverable in place. A ReplicaSet
-        supervisor reads this as the trip-immediately breaker signal."""
+        """Latched after a failed tick whose ``engine.reset()`` ALSO failed
+        (or after :meth:`abandon` gave up on a wedged pump): the engine's
+        device state is unrecoverable in place. A ReplicaSet supervisor
+        reads this as the trip-immediately breaker signal."""
         with self._mutex:
             return self._broken
 
@@ -445,6 +586,14 @@ class PagedGenerationService:
         breaker polls this (cheaper than a full stats() snapshot)."""
         with self._mutex:
             return self._tick_failures
+
+    @property
+    def pump_leaked_count(self) -> int:
+        """Pumps that outlived their close() join (usually a wedged device
+        dispatch). A rebuild reads this off the incarnation it replaces so
+        the ReplicaSet's summed count survives the swap."""
+        with self._mutex:
+            return self._pump_leaked
 
     def check_admission(self, deadline_ts: Optional[float] = None) -> None:
         """Raise the shed error a submit right now would raise, WITHOUT
@@ -535,7 +684,10 @@ class PagedGenerationService:
         """Graceful shutdown: stop admitting (new submits shed with 503),
         let in-flight and queued work finish for up to ``deadline_s``, then
         close. Waiters still pending at the deadline get the closed-service
-        error result from the exiting pump. Returns what happened."""
+        error result from the exiting pump. The final pump join inside
+        ``close()`` is bounded by whatever remains of THIS deadline — a
+        pump wedged in a device dispatch must not stretch a 5s drain into
+        5s + a hardcoded join window. Returns what happened."""
         with self._mutex:
             self._draining = True
         t_end = time.perf_counter() + max(deadline_s, 0.0)
@@ -546,31 +698,36 @@ class PagedGenerationService:
             if pending == 0 or time.perf_counter() >= t_end:
                 break
             time.sleep(0.02)
-        self.close()
+        # the join budget is the drain deadline's remainder (floor 1s so a
+        # fully-consumed window still gives a HEALTHY exiting pump one
+        # beat to fail its waiters and die instead of being miscounted as
+        # leaked on a busy scheduler)
+        self.close(join_timeout_s=max(t_end - time.perf_counter(), 1.0))
         return {"drained": pending == 0, "abandoned": pending}
 
-    def close(self) -> None:
+    def close(self, join_timeout_s: float = 10.0) -> None:
         with self._mutex:
             self._closed = True
             pump = self._pump
         # join OUTSIDE the mutex: the exiting pump needs it to fail waiters
         if pump is None:
             return
-        pump.join(timeout=10.0)
+        pump.join(timeout=max(join_timeout_s, 0.0))
         if pump.is_alive():
             # a pump that won't die is a leaked thread pinning the engine —
             # surface it (stats()['pump_leaked']) instead of silently
             # dropping the reference like the join's return value invites
             logger.warning(
-                "paged decode pump %r did not exit within 10s "
+                "paged decode pump %r did not exit within %.1fs "
                 "(alive=%s, daemon=%s); thread leaked — see stats()",
-                pump.name, pump.is_alive(), pump.daemon,
+                pump.name, join_timeout_s, pump.is_alive(), pump.daemon,
             )
             with self._mutex:
                 self._pump_leaked += 1
         # drop the ref either way: close() is called twice on shutdown
-        # (drain, then container cleanup) — re-joining a leaked pump would
-        # stall another 10s and double-count the same leak
+        # (drain, then container cleanup) — re-joining a leaked (possibly
+        # wedged) pump would stall another join window and double-count
+        # the same leak; it is counted and logged exactly once above
         with self._mutex:
             if self._pump is pump:
                 self._pump = None
@@ -599,6 +756,8 @@ class PagedGenerationService:
                 "requeued": self._requeued,
                 "tick_failures": self._tick_failures,
                 "pump_leaked": self._pump_leaked,
+                "abandoned": int(self._abandoned),
+                "tick_stall_budget_s": self.tick_stall_budget_s,
             }
 
     def warmup(self, max_new_tokens: int = 4) -> dict:
@@ -623,6 +782,18 @@ class PagedGenerationService:
         job (``sentio audit``); a fence error after this warmup names the
         residual variant to add here. Returns the prompt count and the
         XLA compiles the burst triggered."""
+        with self._mutex:
+            # stall watchdog stands down for the duration: warmup ticks
+            # include multi-second cold compiles that would otherwise read
+            # as a wedged pump (heartbeat stale + pending work)
+            self._warming = True
+        try:
+            return self._warmup_impl(max_new_tokens)
+        finally:
+            with self._mutex:
+                self._warming = False
+
+    def _warmup_impl(self, max_new_tokens: int) -> dict:
         import threading
 
         from sentio_tpu.analysis.audit import fence
@@ -706,7 +877,9 @@ class PagedGenerationService:
         for t in threads:
             t.start()
         for t in threads:
-            t.join()
+            # each burst generate bounds itself at default_timeout_s; the
+            # join only outwaits that, never blocks forever on a wedged pump
+            t.join(timeout=self.default_timeout_s + 60.0)
         prompts += len(threads)
         with self._mutex:
             # warmup TTFTs are compile-dominated — seeding the admission
@@ -722,6 +895,11 @@ class PagedGenerationService:
         assert_held(self._mutex)
         if not self._pump_running:
             self._pump_running = True
+            # fresh burst, fresh liveness: without this stamp the watchdog
+            # would read the PREVIOUS burst's last heartbeat against the
+            # new burst's pending work and false-positive a stall in the
+            # spawn window
+            self._heartbeat_ts = time.perf_counter()
             self._pump = threading.Thread(
                 target=self._run, name="paged-decode-pump", daemon=True
             )
@@ -764,6 +942,11 @@ class PagedGenerationService:
         while True:
             now = time.perf_counter()
             with self._mutex:
+                # heartbeat: the watchdog's liveness signal. Stamped at the
+                # top of EVERY loop iteration, so a tick wedged inside the
+                # device dispatch below leaves the stamp aging while the
+                # backlog grows — exactly the stall signature
+                self._heartbeat_ts = now
                 for ticket in self._inbox:
                     if ticket.cancelled:
                         # abandoned before admission
@@ -976,6 +1159,7 @@ class PagedGenerationService:
                 logger.debug("tick telemetry failed", exc_info=True)
             now = time.perf_counter()
             with self._mutex:
+                self._heartbeat_ts = now  # tick survived: fresh liveness
                 self._ticks += 1
                 self._active_sum += active
                 self._max_active = max(self._max_active, active)
@@ -1095,16 +1279,7 @@ class PagedGenerationService:
         """Terminate a ticket with a TYPED error the caller re-raises
         (deadline expiry, shed-at-requeue) instead of a result."""
         assert_held(self._mutex)
-        if ticket.event.is_set():
-            return
-        ticket.error = exc
-        if ticket.request_id:
-            get_flight_recorder().finish_engine(
-                ticket.request_id, finish_reason=finish_reason, error=str(exc)
-            )
-        if ticket.stream_q is not None:
-            ticket.stream_q.put(("err", exc))
-        ticket.event.set()
+        finish_ticket_error(ticket, exc, finish_reason)
 
     def _fail_ticket_locked(self, ticket: _Ticket, reason: str) -> None:  # lock-held: _mutex
         """Terminate a ticket with the finish_reason='error' result (the

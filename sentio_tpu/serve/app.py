@@ -457,9 +457,22 @@ async def _chat_stream(request: web.Request, container: DependencyContainer, req
         put(("done", ""))
 
     task = loop.run_in_executor(None, produce)
+    # SSE liveness: while the producer is silent (long prefill, a slow —
+    # or wedged — decode pump), emit comment keepalives so the client can
+    # distinguish "still working" from a dead connection and apply its own
+    # timeout policy. Comments are invisible to EventSource consumers.
+    keepalive_s = getattr(container.settings.serve, "sse_keepalive_s", 0.0)
     try:
         while True:
-            kind, payload = await queue.get()
+            try:
+                if keepalive_s and keepalive_s > 0:
+                    kind, payload = await asyncio.wait_for(
+                        queue.get(), timeout=keepalive_s)
+                else:
+                    kind, payload = await queue.get()
+            except asyncio.TimeoutError:
+                await response.write(b": keepalive\n\n")
+                continue
             if kind == "done":
                 await response.write(b"data: [DONE]\n\n")
                 break

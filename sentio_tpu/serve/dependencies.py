@@ -354,6 +354,7 @@ class DependencyContainer:
                     ),
                     retry_budget=serve.crash_retry_budget,
                     replica_id=i,
+                    tick_stall_budget_s=serve.tick_stall_budget_s,
                 ))
             return ReplicaSet(
                 services,
@@ -378,6 +379,7 @@ class DependencyContainer:
                 rebuild_budget=serve.replica_rebuild_budget,
                 rebuild_drain_s=serve.replica_rebuild_drain_s,
                 failover_budget=serve.replica_failover_budget,
+                rebuild_workers=serve.replica_rebuild_workers,
             )
 
         return self._get("generation_service", build)

@@ -1,0 +1,34 @@
+"""Seeded violations: unbounded blocking calls a watchdog cannot see past.
+
+A thread wedged inside a zero-argument ``.join()``/``.wait()``/``.get()``
+raises nothing — the hang fault class the pump watchdog exists to detect.
+The framework's own supervisor threads must never block that way: this
+fixture is the regression the blocking checker must catch (one unbounded
+join anywhere, plus an unbounded wait and an unbounded queue get inside a
+supervisor-named loop).
+"""
+import queue
+import threading
+
+
+class BadWatchdog:
+    def __init__(self):
+        self._thread = threading.Thread(target=lambda: None)
+        self._work = queue.Queue()
+        self._wake = threading.Event()
+
+    def shutdown(self):
+        self._thread.join()  # blocks forever on a wedged thread
+
+    def _supervise_loop(self):
+        while True:
+            self._wake.wait()  # the detection loop itself can wedge here
+            item = self._work.get()  # and here
+            if item is None:
+                return
+
+    def bounded_ok(self):
+        # timeouts pass; str.join / dict.get style calls with args pass
+        self._thread.join(timeout=5.0)
+        self._wake.wait(0.5)
+        return ",".join(["a", "b"]) + str({}.get("k"))

@@ -58,7 +58,9 @@ def _assert_no_pump_threads(timeout_s: float = 15.0):
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         pumps = [t for t in threading.enumerate()
-                 if t.name in ("paged-decode-pump", "replica-supervisor")
+                 if t.name.startswith(("paged-decode-pump",
+                                       "replica-supervisor",
+                                       "replica-rebuild"))
                  and t.is_alive()]
         if not pumps:
             return
@@ -344,6 +346,180 @@ class TestChaosDrill:
             assert rs.stats()["health"]["replicas"][dead[0]]["state"] \
                 == HEALTH_HEALTHY
         finally:
+            faults.reset()
+            rs.close()
+        _assert_no_pump_threads()
+
+    def test_replica_stall_drill_watchdog_handoff_and_rebuild(self):
+        """ISSUE 10 acceptance drill (sanitizer armed for this module): one
+        of 2 replicas is WEDGED mid-traffic — its next decode tick blocks
+        inside a stall fault, raising nothing, exactly like a hung device
+        dispatch. The contract:
+
+        * the watchdog quarantines the stalled replica within 2x its
+          ``TICK_STALL_BUDGET_S`` (no exception required — heartbeat age
+          with pending work is the whole signal);
+        * the wedged replica's never-dispatched INBOX tickets are handed
+          off directly to the survivor and complete there WITHOUT their
+          callers observing any failure (failover budget untouched);
+        * its admitted ticket fails typed and fails over (one failover);
+        * every caller outcome is typed, pages conserve on the surviving
+          replica, the abandoned pump is accounted in ``stats()``
+          (pump_leaked survives the rebuild swap via carryover), and the
+          rebuilt replica serves again."""
+        from sentio_tpu.runtime.replica import HEALTH_HEALTHY, ReplicaSet
+
+        # generous budget: a LEGITIMATE tick on the survivor may include a
+        # multi-second cold XLA compile (a new prefill width/row variant
+        # for the adopted tickets) and must never read as a stall
+        budget_s = 5.0
+        e0 = ContinuousBatchingEngine(
+            max_slots=2, page_size=8, max_pages_per_seq=4, num_pages=65,
+            steps_per_tick=2,
+        )
+        e1 = ContinuousBatchingEngine(
+            params=e0.params, tokenizer=e0.tokenizer,
+            max_slots=2, page_size=8, max_pages_per_seq=4, num_pages=65,
+            steps_per_tick=2,
+        )
+        svc0 = PagedGenerationService(e0, retry_budget=1,
+                                      tick_stall_budget_s=budget_s)
+        svc1 = PagedGenerationService(e1, retry_budget=1,
+                                      tick_stall_budget_s=budget_s)
+        # pre-compile + seed a distinct radix session per replica: after
+        # the wedge, follow-ups on the wedged replica's session prefix
+        # route to it by affinity and pile into its (never-drained) inbox
+        sessions = ["session zero affinity head spanning pages easily ",
+                    "session one affinity head spanning pages easily "]
+        svc0.generate(sessions[0] + "seed", max_new_tokens=2, timeout_s=180)
+        svc1.generate(sessions[1] + "seed", max_new_tokens=2, timeout_s=180)
+        rs = ReplicaSet(
+            [svc0, svc1],
+            probe_interval_s=0.05, quarantine_backoff_s=0.1,
+            rebuild_drain_s=0.3, failover_budget=2,
+        )
+        release = threading.Event()
+        outcomes: dict[str, object] = {}
+
+        def call(tag, prompt):
+            try:
+                outcomes[tag] = rs.generate(prompt, max_new_tokens=4,
+                                            temperature=0.0, timeout_s=120)
+            except Exception as exc:  # noqa: BLE001 — typed errors terminal
+                outcomes[tag] = exc
+        try:
+            # one-shot wedge: the next decode tick anywhere blocks until
+            # release (120s worst-case cap); both pumps are idle, so the
+            # single request below deterministically picks the victim
+            rule = faults.FaultRule(stall_event=release, stall_s=120.0,
+                                    times=1)
+            faults.arm("paged.step", rule)
+            t_a = threading.Thread(target=call,
+                                   args=("admitted", "cold wedge probe"))
+            t_a.start()
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and rule.stalled == 0:
+                time.sleep(0.005)
+            assert rule.stalled == 1, "no pump ever wedged"
+            t_wedge = time.monotonic()
+            dead = max(range(2), key=lambda i: (svc0, svc1)[i].backlog())
+            wedged_svc = (svc0, svc1)[dead]
+            assert wedged_svc.backlog() >= 1
+            # inbox load for the wedged replica, routed there by affinity
+            inbox_callers = []
+            for k in range(2):
+                t = threading.Thread(
+                    target=call,
+                    args=(f"inbox{k}", sessions[dead] + f"turn {k}"))
+                t.start()
+                inbox_callers.append(t)
+            deadline = time.monotonic() + min(budget_s * 0.8, 2.0)
+            while time.monotonic() < deadline and wedged_svc.backlog() < 3:
+                time.sleep(0.005)
+            assert wedged_svc.backlog() >= 3, (
+                "inbox tickets did not land on the wedged replica before "
+                "detection"
+            )
+            # the watchdog quarantines on heartbeat age alone, within
+            # 2x the stall budget of the wedge
+            deadline = time.monotonic() + 3 * budget_s
+            quarantined_at = None
+            while time.monotonic() < deadline:
+                state = rs.health_summary()["replicas"][dead]["state"]
+                if state != HEALTH_HEALTHY:
+                    quarantined_at = time.monotonic()
+                    break
+                time.sleep(0.01)
+            assert quarantined_at is not None, "watchdog never fired"
+            assert quarantined_at - t_wedge <= 2 * budget_s, (
+                f"detection took {quarantined_at - t_wedge:.2f}s "
+                f"(budget {budget_s}s)"
+            )
+            t_a.join(timeout=120)
+            for t in inbox_callers:
+                t.join(timeout=120)
+            assert not t_a.is_alive() and not any(
+                t.is_alive() for t in inbox_callers), (
+                "caller thread hung across the stall"
+            )
+            # every caller terminated typed; the inbox tickets completed on
+            # the SURVIVOR without their callers failing over
+            assert len(outcomes) == 3
+            for name, out in outcomes.items():
+                if isinstance(out, Exception):
+                    assert isinstance(out, SentioError), (
+                        f"{name}: untyped {type(out).__name__}: {out}")
+                else:
+                    assert out.finish_reason in ("stop", "length"), (name, out)
+            for k in range(2):
+                assert isinstance(outcomes[f"inbox{k}"], PagedResult), (
+                    f"handed-off ticket inbox{k} did not complete: "
+                    f"{outcomes[f'inbox{k}']}"
+                )
+            stats = rs.stats()
+            assert stats["handed_off"] == 2, stats["handed_off"]
+            assert stats["stall_quarantines"] == 1
+            # only the ADMITTED ticket's caller spent failover budget; the
+            # handed-off tickets moved without touching it
+            assert stats["failovers"] <= 1, stats["failovers"]
+            # the supervisor abandons the wedged engine and rebuilds the
+            # slot in place; the abandoned pump is ACCOUNTED even though
+            # its service incarnation left rotation
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if rs.health_summary()["status"] == "healthy":
+                    break
+                time.sleep(0.05)
+            summary = rs.health_summary()
+            assert summary["status"] == "healthy", summary
+            assert summary["replicas"][dead]["rebuilds"] == 1, summary
+            assert rs.stats()["pump_leaked"] >= 1, (
+                "abandoned wedged pump vanished from stats"
+            )
+            # pages conserve on the surviving replica (sanitizer checked
+            # every tick; this is the end-state audit) and the REBUILT
+            # replica serves again
+            survivor_stats = rs.stats()["replicas"][1 - dead]
+            assert survivor_stats["free_pages"] \
+                + survivor_stats.get("prefix_cache_pages", 0) \
+                == survivor_stats["total_pages"] - 1, survivor_stats
+            rebuilt = rs._services[dead]
+            assert rebuilt is not wedged_svc
+            ok = rebuilt.generate("rebuilt after stall", max_new_tokens=3,
+                                  timeout_s=180)
+            assert ok.finish_reason in ("stop", "length")
+            ok2 = rs.generate("post stall routed sanity", max_new_tokens=3,
+                              timeout_s=120)
+            assert ok2.finish_reason in ("stop", "length")
+            # the stall was evented for operators
+            from sentio_tpu.infra.flight import get_flight_recorder
+
+            events = get_flight_recorder().timeline()
+            assert any(e.get("event") == "pump_stall" for e in events)
+            assert any(e.get("event") == "inbox_handoff"
+                       and e.get("handed_off") == 2 for e in events)
+        finally:
+            release.set()  # unwedge the abandoned pump so it can exit
             faults.reset()
             rs.close()
         _assert_no_pump_threads()

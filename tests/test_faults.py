@@ -149,6 +149,91 @@ class TestDegradationLadder:
         assert len(out) == 1
 
 
+class TestStallFaults:
+    """The hang fault class (ISSUE 10): a stall rule blocks INSIDE the
+    injection point — for a bounded duration, or until the test releases
+    an event — and composes with raise. This is how chaos wedges a pump
+    exactly like a hung device dispatch (nothing raises, nothing returns)."""
+
+    def test_stall_duration_bounded_by_budget(self):
+        import time
+
+        with faults.inject("p", stall_s=0.15) as rule:
+            t0 = time.perf_counter()
+            faults.hit("p")
+            dt = time.perf_counter() - t0
+        assert dt >= 0.15
+        assert rule.stalled == 1
+
+    def test_stall_event_released_mid_test_at_paged_step(self):
+        """A pump-shaped thread wedges at ``paged.step`` until the test
+        sets the release event; the stall_s cap bounds the worst case."""
+        import threading
+        import time
+
+        release = threading.Event()
+        unwedged = threading.Event()
+
+        def pump():
+            faults.hit("paged.step")
+            unwedged.set()
+
+        with faults.inject("paged.step", stall_event=release, stall_s=30.0,
+                           times=1) as rule:
+            t = threading.Thread(target=pump, daemon=True)
+            t.start()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and rule.stalled == 0:
+                time.sleep(0.005)
+            assert rule.stalled == 1, "pump never entered the stall"
+            assert not unwedged.is_set(), "stall did not actually block"
+            release.set()
+            t.join(timeout=5)
+            assert unwedged.is_set(), "release did not free the stalled hit"
+            # times=1: a second hit passes straight through
+            faults.hit("paged.step")
+            assert rule.stalled == 1
+
+    def test_stall_at_engine_reset(self):
+        """``engine.reset`` — the crash-containment path itself — can be
+        wedged: the reset blocks for the stall duration, then completes
+        normally (stall, unlike raise, does not fail the reset)."""
+        import time
+
+        from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+
+        engine = ContinuousBatchingEngine(
+            max_slots=2, page_size=8, max_pages_per_seq=4,
+        )
+        with faults.inject("engine.reset", stall_s=0.1, times=1) as rule:
+            t0 = time.perf_counter()
+            engine.reset()
+            assert time.perf_counter() - t0 >= 0.1
+        assert rule.stalled == 1
+        assert engine.allocator.free_pages == engine.allocator.num_pages - 1
+
+    def test_stall_then_raise_composition(self):
+        """stall + error on one rule: the hit blocks first, THEN raises —
+        a dispatch that hangs and then dies, the worst-case compound."""
+        import time
+
+        with faults.inject("p", stall_s=0.1,
+                           error=RuntimeError("died after the hang")) as rule:
+            t0 = time.perf_counter()
+            with pytest.raises(RuntimeError, match="died after the hang"):
+                faults.hit("p")
+            assert time.perf_counter() - t0 >= 0.1
+        assert rule.stalled == 1 and rule.fired == 1
+
+    def test_unfired_rule_never_stalls(self):
+        import time
+
+        with faults.inject("p", stall_s=5.0, times=0):
+            t0 = time.perf_counter()
+            faults.hit("p")
+            assert time.perf_counter() - t0 < 1.0
+
+
 class TestSupervisorFaultPoints:
     """The replica-supervision seams (ISSUE 8): ``engine.reset`` lets chaos
     force the crash-containment reset itself to fail (the path that latches

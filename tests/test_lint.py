@@ -57,6 +57,20 @@ class TestSeededFixtures:
         ]
         assert "_health" in got[0].message and "_mutex" in got[0].message
 
+    def test_watchdog_fixture_exact_findings(self):
+        """Unbounded blocking calls (the hang class the pump watchdog
+        detects in production): the no-timeout thread join fires anywhere;
+        the bare Event.wait / Queue.get fire inside supervisor-named code;
+        the timeout-carrying and str.join/dict.get calls produce nothing."""
+        got = _findings("watchdog_bad.py")
+        assert [(f.rule, f.line) for f in got] == [
+            ("join-no-timeout", 21),
+            ("supervisor-blocking-wait", 25),
+            ("supervisor-blocking-wait", 26),
+        ]
+        assert "timeout" in got[0].message
+        assert "watchdog" in got[1].message
+
     def test_clock_fixture_exact_finding(self):
         got = _findings("clock_bad.py")
         assert [(f.rule, f.line) for f in got] == [("wall-clock-duration", 6)]

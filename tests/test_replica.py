@@ -606,6 +606,208 @@ class TestSupervisor:
             rs.close()
 
 
+class TestStallTolerance:
+    """ISSUE 10: the watchdog/handoff/rebuild-pool layer in isolation
+    (the supervised end-to-end wedge is drilled in test_chaos)."""
+
+    def test_heartbeat_age_none_when_idle(self):
+        svc = PagedGenerationService(_engine(), tick_stall_budget_s=30.0)
+        try:
+            assert svc.heartbeat_age() is None  # no pump yet
+            svc.generate("heartbeat idle probe", max_new_tokens=2,
+                         timeout_s=180)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and svc.heartbeat_age() is not None:
+                time.sleep(0.01)
+            # pump drained and exited (or idles with zero pending): an idle
+            # service is never stalled
+            assert svc.heartbeat_age() is None
+        finally:
+            svc.close()
+
+    def test_recharge_keeps_accounting_balanced(self):
+        """The handoff's WFQ move: release + re-admit atomically — pending
+        unchanged, one admission recorded; an over-quota tenant sheds typed
+        with its reservation RESTORED so the caller's release balances."""
+        q = TenantFairQueue(capacity=8, headroom=0)  # lone-tenant quota: 8
+        q.admit("t", 10)
+        q.recharge("t", 10)
+        t = q.stats()["per_tenant"]["t"]
+        assert t["pending"] == 1 and t["admitted"] == 2
+        # t holds 6 while alone (within its lone quota of 8) ...
+        for _ in range(5):
+            q.admit("t", 10)
+        # ... then a second tenant activates, HALVING t's quota to 4: a
+        # handoff recharge now finds t over quota -> typed shed, with the
+        # original reservation restored (pending untouched)
+        q.admit("u", 10)
+        with pytest.raises(ServiceOverloaded) as exc_info:
+            q.recharge("t", 10)
+        assert exc_info.value.details["shed_reason"] == "tenant_quota"
+        assert q.stats()["per_tenant"]["t"]["pending"] == 6
+        # unknown / already-released tenants are a no-op, never a crash
+        q.recharge("ghost", 10)
+
+    def test_breaker_quarantine_hands_off_inbox(self):
+        """Quarantine (breaker flavor, not just stall) moves the dead
+        replica's queued-never-dispatched tickets to the survivor instead
+        of leaving them to ride each caller's failover loop: the blocked
+        caller just wakes with the survivor's result."""
+        e0 = _engine()
+        e1 = _engine(base=e0)
+        svc0 = PagedGenerationService(e0)
+        svc1 = PagedGenerationService(e1)
+        svc0.generate("handoff warm zero", max_new_tokens=2, timeout_s=180)
+        svc1.generate("handoff warm one", max_new_tokens=2, timeout_s=180)
+        rs = ReplicaSet([svc0, svc1], supervise=False)
+        try:
+            # plant a ticket straight into replica 0's inbox with WFQ
+            # metadata, as the router would on a submit that raced the
+            # breaker (the pump is idle-exited, so it stays undispatched
+            # until a pump would spawn — generate() in a thread)
+            outcome: dict = {}
+
+            def call():
+                try:
+                    outcome["r"] = svc0.generate(
+                        "wedged in flight", max_new_tokens=3,
+                        temperature=0.0, timeout_s=60,
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    outcome["r"] = exc
+
+            # hold replica 0's pump wedged so later tickets stay queued
+            release = threading.Event()
+            with faults.inject("paged.step", stall_event=release,
+                               stall_s=30.0, times=1) as rule:
+                t = threading.Thread(target=call)
+                t.start()
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline and rule.stalled == 0:
+                    time.sleep(0.005)
+                assert rule.stalled == 1
+                # second caller piles into the wedged inbox, carrying the
+                # WFQ metadata the router would have stamped (plus the
+                # caller-side charge it pairs with)
+                rs.tenants.admit(DEFAULT_TENANT, 8)
+                outcome2: dict = {}
+
+                def call2():
+                    try:
+                        outcome2["r"] = svc0.generate(
+                            "second queued ticket", max_new_tokens=3,
+                            temperature=0.0, timeout_s=60,
+                            tenant=DEFAULT_TENANT, cost_tokens=8,
+                        )
+                    except Exception as exc:  # noqa: BLE001
+                        outcome2["r"] = exc
+
+                t2 = threading.Thread(target=call2)
+                t2.start()
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline and len(svc0._inbox) < 1:
+                    time.sleep(0.005)
+                # breaker-flavor quarantine: inbox moves, admitted stays
+                rs._quarantine(0, "seeded breaker trip")
+                t2.join(timeout=60)
+                assert isinstance(outcome2["r"], PagedResult), outcome2["r"]
+                assert outcome2["r"].finish_reason in ("stop", "length")
+                assert rs.stats()["handed_off"] >= 1
+                # the first (admitted, wedged) ticket is NOT handed off —
+                # it still sits on the wedged engine
+                assert not outcome
+                release.set()
+                t.join(timeout=60)
+            # breaker quarantine leaves a WORKING service: the unwedged
+            # pump finishes its admitted ticket normally
+            assert isinstance(outcome.get("r"), PagedResult), outcome
+            tenants = rs.tenants.stats()["per_tenant"][DEFAULT_TENANT]
+            rs.tenants.release(DEFAULT_TENANT, 8)
+            # caller-side admit + the handoff's recharge, reservation held
+            # throughout (never double-counted, never leaked)
+            assert tenants["admitted"] == 2, tenants
+            assert tenants["pending"] == 1, tenants
+            _assert_pages_conserved(rs)
+        finally:
+            faults.reset()
+            rs.close()
+
+    def test_stalled_rebuild_does_not_delay_second_quarantine(self):
+        """Acceptance: a rebuild wedged via the ``replica.rebuild`` stall
+        fault occupies a WORKER, not the supervisor — the detection pass
+        keeps its cadence and quarantines a second replica promptly, even
+        with a single rebuild worker (the second rebuild just queues)."""
+        from sentio_tpu.runtime.replica import HEALTH_REBUILDING
+
+        e0 = _engine()
+        e1 = _engine(base=e0)
+        svc0 = PagedGenerationService(e0, retry_budget=0)
+        svc1 = PagedGenerationService(e1, retry_budget=0)
+        svc0.generate("pool warm zero", max_new_tokens=2, timeout_s=180)
+        svc1.generate("pool warm one", max_new_tokens=2, timeout_s=180)
+        rs = ReplicaSet(
+            [svc0, svc1],
+            probe_interval_s=0.02, quarantine_backoff_s=0.0,
+            rebuild_drain_s=0.2, failover_budget=1, rebuild_workers=1,
+        )
+        release = threading.Event()
+        try:
+            # wedge replica 0's rebuild on the worker
+            rule = faults.FaultRule(stall_event=release, stall_s=60.0,
+                                    times=1)
+            faults.arm("replica.rebuild", rule)
+            rs._quarantine(0, "seeded for wedged rebuild")
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and rule.stalled == 0:
+                time.sleep(0.01)
+            assert rule.stalled == 1, "rebuild never started on the worker"
+            assert rs.health_summary()["replicas"][0]["state"] \
+                == HEALTH_REBUILDING
+            # with the rebuild wedged, kill replica 1: the supervisor's
+            # detection pass must quarantine it promptly
+            with faults.inject("paged.step",
+                               error=RuntimeError("kill two"), times=1), \
+                 faults.inject("engine.reset",
+                               error=RuntimeError("reset denied"), times=1):
+                with pytest.raises(ReplicaUnavailable):
+                    rs.generate("doomed on replica one", max_new_tokens=4,
+                                timeout_s=120)
+            t_kill = time.monotonic()
+            deadline = time.monotonic() + 10
+            state = None
+            while time.monotonic() < deadline:
+                state = rs.health_summary()["replicas"][1]["state"]
+                if state == HEALTH_QUARANTINED:
+                    break
+                time.sleep(0.01)
+            assert state == HEALTH_QUARANTINED, (
+                f"second quarantine waited on the wedged rebuild: {state}"
+            )
+            assert time.monotonic() - t_kill < 5.0
+            # replica 0 is still wedged mid-rebuild the whole time
+            assert rs.health_summary()["replicas"][0]["state"] \
+                == HEALTH_REBUILDING
+            # release: replica 0's rebuild completes, then the worker picks
+            # up replica 1's queued rebuild; the set returns to health
+            release.set()
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if rs.health_summary()["status"] == "healthy":
+                    break
+                time.sleep(0.05)
+            summary = rs.health_summary()
+            assert summary["status"] == "healthy", summary
+            assert summary["replicas"][0]["rebuilds"] == 1
+            assert summary["replicas"][1]["rebuilds"] == 1
+            ok = rs.generate("post pool recovery", max_new_tokens=3,
+                             timeout_s=180)
+            assert ok.finish_reason in ("stop", "length")
+        finally:
+            release.set()
+            faults.reset()
+            rs.close()
+
+
 class TestVerifyTenantCharging:
     """ROADMAP item 1 leftover: verify-node decode admissions must be
     charged to the REQUESTING tenant's WFQ quota, not the shared default —

@@ -127,6 +127,29 @@ class TestChatEndpoint:
 
         run(with_client(fast_settings(), body))
 
+    def test_chat_stream_sse_keepalive_during_silence(self):
+        """ISSUE 10 satellite: while the producer is silent past the
+        configured interval (a slow — or wedged — decode), the SSE wire
+        carries comment keepalives so the client can tell 'still working'
+        from a dead connection; real events still follow."""
+        import time as _time
+
+        async def body(client, container):
+            def slow_stream(**kwargs):
+                _time.sleep(0.4)  # silence > several keepalive intervals
+                yield ("token", "late answer")
+
+            container.chat_handler.stream_chat_sync = slow_stream
+            resp = await client.post(
+                "/chat", json={"question": "slow stream", "stream": True})
+            assert resp.status == 200
+            raw = (await resp.read()).decode()
+            assert ": keepalive" in raw, raw
+            assert "late answer" in raw and "[DONE]" in raw
+
+        run(with_client(
+            fast_settings(serve=ServeConfig(sse_keepalive_s=0.05)), body))
+
 
 class TestEmbedAndClear:
     def test_embed_validates_and_indexes(self):

@@ -358,6 +358,64 @@ class TestRobustness:
         with pytest.raises((ReplicaUnavailable, ServiceOverloaded)):
             svc.generate("too late")
 
+    def test_drain_deadline_bounds_wedged_pump_join(self, contiguous):
+        """ISSUE 10 satellite: drain() must honor its deadline against a
+        pump wedged inside a device dispatch — the final pump join derives
+        from the drain deadline's remainder (not the old hardcoded 10s),
+        the wedged pump is counted leaked exactly once, and a second
+        close() neither re-joins nor double-counts."""
+        from sentio_tpu.infra import faults
+
+        svc = PagedGenerationService(self._engine(contiguous))
+        release = threading.Event()
+        rule = faults.FaultRule(stall_event=release, stall_s=60.0, times=1)
+        faults.arm("paged.step", rule)
+        try:
+            result: dict = {}
+
+            def call():
+                try:
+                    result["r"] = svc.generate("wedge me", max_new_tokens=4,
+                                               timeout_s=60)
+                except Exception as exc:  # noqa: BLE001
+                    result["r"] = exc
+
+            t = threading.Thread(target=call, daemon=True)
+            t.start()
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and rule.stalled == 0:
+                time.sleep(0.005)
+            assert rule.stalled == 1, "pump never wedged"
+            t0 = time.monotonic()
+            out = svc.drain(deadline_s=1.5)
+            elapsed = time.monotonic() - t0
+            # deadline honored: drain window + the (deadline-derived) join,
+            # nowhere near the old hardcoded 10s join on top
+            assert elapsed < 6.0, f"drain took {elapsed:.1f}s against a 1.5s deadline"
+            assert out["drained"] is False and out["abandoned"] >= 1
+            assert svc.stats()["pump_leaked"] == 1
+            # second close: counted and logged once, not re-joined
+            t0 = time.monotonic()
+            svc.close()
+            assert time.monotonic() - t0 < 1.0, "close() re-joined the leaked pump"
+            assert svc.stats()["pump_leaked"] == 1
+            # unwedge and let the abandoned pump die cleanly (it sees the
+            # closed latch, fails its waiters, exits) — the leak count
+            # keeps its history
+            release.set()
+            t.join(timeout=60)
+            assert result, "wedged caller never reached a terminal outcome"
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and any(
+                th.name == "paged-decode-pump" and th.is_alive()
+                for th in threading.enumerate()
+            ):
+                time.sleep(0.05)
+            assert svc.stats()["pump_leaked"] == 1
+        finally:
+            faults.disarm("paged.step")
+            release.set()
+
     def test_leaked_pump_surfaces_in_stats(self, contiguous):
         """A pump that outlives close()'s join shows up as pump_leaked
         instead of being silently dropped."""
