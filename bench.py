@@ -26,7 +26,10 @@ Env knobs: BENCH_FAST=1 (tiny models, quick smoke), BENCH_QUERIES=N,
 BENCH_CORPUS=N, BENCH_NEW_TOKENS=N, BENCH_CONCURRENCY=N,
 BENCH_SKIP_SCALE=1 (skip phase C), BENCH_SERVE_SCALE=1b|8b|moe,
 BENCH_SCALE_TOKENS=N, BENCH_SPECULATIVE=1 (add phase E: plain-vs-
-speculative decode on the serve-scale target, greedy-exact).
+speculative decode on the serve-scale target, greedy-exact),
+BENCH_VERIFY_SWEEP=1 (phase A once per VERIFY_MODE — sync|async|gated —
+reporting p50/p95 e2e, the answer_ms/verdict_ms split, and the gate skip
+rate; BENCH_VERIFY_THRESHOLD overrides the confidence gate).
 """
 
 from __future__ import annotations
@@ -162,9 +165,15 @@ def phase_0_rtt():
 
 
 def phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
-                new_tokens, concurrency, kv_quant="none"):
-    """Full graph with paged continuous batching, N concurrent clients."""
+                new_tokens, concurrency, kv_quant="none", verify_mode=None):
+    """Full graph with paged continuous batching, N concurrent clients.
+
+    ``verify_mode`` (sync|async|gated, default = the settings tree's value)
+    rebuilds the graph with that verification wiring — the
+    BENCH_VERIFY_SWEEP driver runs this phase once per mode on the same
+    corpus/queries so the off-critical-path claim lands as measurement."""
     import threading
+    from dataclasses import replace as _dc_replace
 
     from sentio_tpu.config import EmbedderConfig, GeneratorConfig, RerankConfig
     from sentio_tpu.graph.factory import GraphConfig, build_basic_graph
@@ -179,6 +188,12 @@ def phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
     from sentio_tpu.runtime.engine import GeneratorEngine
     from sentio_tpu.runtime.paged import ContinuousBatchingEngine
     from sentio_tpu.runtime.service import PagedGenerationService
+
+    if verify_mode is not None:
+        settings = settings.with_overrides(
+            generator=_dc_replace(settings.generator, verify_mode=verify_mode)
+        )
+    verify_mode = settings.generator.verify_mode
 
     log("phase A: building corpus + indexes ...")
     embedder = TpuEmbedder(
@@ -266,6 +281,7 @@ def phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
         fence.arm()
 
     latencies: list[float] = []
+    lat_pairs: list[tuple[int, float]] = []
     node_ms: dict[str, list[float]] = {}
     lock = threading.Lock()
     pending = [(i, queries[i % len(queries)]) for i in range(n_queries)]
@@ -278,12 +294,17 @@ def phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
                     return
                 i, q = pending.pop()
             t0 = time.perf_counter()
+            # ids namespaced per verify mode: the recorder is cleared per
+            # phase run, but a sweep must never risk one mode's late
+            # verify record merging onto another mode's id
             state = graph.invoke(create_initial_state(
-                q, metadata={"mode": "fast", "query_id": f"bench-{i}"}
+                q, metadata={"mode": "fast",
+                             "query_id": f"bench-{verify_mode}-{i}"}
             ))
             dt = (time.perf_counter() - t0) * 1000.0
             with lock:
                 latencies.append(dt)
+                lat_pairs.append((i, dt))
                 for node, ms in (state["metadata"].get("node_timings_ms") or {}).items():
                     node_ms.setdefault(node, []).append(ms)
 
@@ -294,11 +315,45 @@ def phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
     for t in threads:
         t.join()
     wall = time.perf_counter() - t_run
+    # detached verifies (async/gated) still decode on this service — join
+    # them before closing it, and so their verdict_ms land on the records
+    from sentio_tpu.graph.executor import wait_detached
+
+    wait_detached(timeout_s=120.0)
     stats = service.stats()
     if fence.enabled():
         fence.disarm()
     xla_compiles = fence.compiles_total() - compiles_before
     service.close()
+
+    # answer vs verdict split (ISSUE 11): answer_ms is what the CALLER
+    # waited for the answer (graph invoke — under async/gated the graph
+    # returns at the gate, so verify is already excluded; under sync the
+    # recorded verdict_ms is subtracted out), verdict_ms is the audit
+    # decode wherever it ran. gate_skip_rate counts skipped_confident.
+    recorder = get_flight_recorder()
+    answer_ms_list: list[float] = []
+    verdict_ms_list: list[float] = []
+    skipped = 0
+    verified = 0
+    for i, dt in lat_pairs:
+        verify_rec = (recorder.get(f"bench-{verify_mode}-{i}") or {}).get(
+            "verify") or {}
+        vms = verify_rec.get("verdict_ms")
+        outcome = verify_rec.get("outcome")
+        if outcome == "skipped_confident":
+            skipped += 1
+        elif outcome in ("pass", "warn", "fail"):
+            # real audit verdicts only: deadline/empty skips are neither a
+            # gate payoff nor a completed verification and must not skew
+            # the reported gate_skip_rate
+            verified += 1
+        if vms is not None:
+            verdict_ms_list.append(float(vms))
+        answer_ms_list.append(
+            dt - float(vms) if verify_mode == "sync" and vms is not None
+            else dt
+        )
 
     ticks = stats["ticks"] - stats_before["ticks"]
     active = stats["avg_active_slots"] * stats["ticks"] - (
@@ -323,6 +378,23 @@ def phase_a_rag(settings, enc_cfg, llm_cfg, docs, queries, n_queries,
             for k, v in sorted(node_ms.items())
         },
         **_flight_artifacts(),
+        "verify": {
+            "mode": verify_mode,
+            "answer_ms": {
+                "p50": round(_percentile(answer_ms_list, 0.50), 1),
+                "p95": round(_percentile(answer_ms_list, 0.95), 1),
+                "n": len(answer_ms_list),
+            },
+            "verdict_ms": {
+                "p50": round(_percentile(verdict_ms_list, 0.50), 1),
+                "p95": round(_percentile(verdict_ms_list, 0.95), 1),
+                "n": len(verdict_ms_list),
+            },
+            "gate_skip_rate": round(
+                skipped / max(skipped + verified, 1), 4),
+            "skipped": skipped,
+            "verified": verified,
+        },
         "avg_active_slots": round(active / max(ticks, 1), 2),
         "max_active_slots": stats["max_active_slots"],
         "ingest_docs_per_s": round(docs_per_s, 1),
@@ -1316,6 +1388,44 @@ def main() -> None:
     elif kv_sweep:
         log(f"BENCH_KV_QUANT_SWEEP ignored: KV_QUANT={kv_quant!r} already "
             f"pins the repr — unset it so the sweep can run bf16 AND int8")
+    # verification-mode sweep (ISSUE 11): phase A once per VERIFY_MODE on
+    # the same corpus/queries — sync pays the audit on the critical path,
+    # async overlaps it with delivery, gated also skips it outright for
+    # confident answers (BENCH_VERIFY_THRESHOLD overrides the gate)
+    verify_sweep = None
+    if os.environ.get("BENCH_VERIFY_SWEEP") == "1":
+        from dataclasses import replace as _dc_replace
+
+        sweep_settings = settings
+        threshold_raw = os.environ.get("BENCH_VERIFY_THRESHOLD")
+        if threshold_raw:
+            sweep_settings = settings.with_overrides(
+                generator=_dc_replace(
+                    settings.generator,
+                    verify_confidence_threshold=float(threshold_raw),
+                ))
+        # the sweep measures the LATENCY story (audit on vs off the
+        # caller's critical path), so it runs lightly loaded by default:
+        # under closed-loop saturation every mode is capacity-bound and
+        # detached audits simply compete with the next query's decode —
+        # throughput stays phase A's job. BENCH_VERIFY_CONCURRENCY raises
+        # it for a contended sweep.
+        sweep_conc = int(os.environ.get("BENCH_VERIFY_CONCURRENCY", "2"))
+        verify_sweep = {}
+        for mode in ("sync", "async", "gated"):
+            log(f"phase VERIFY_SWEEP: verify_mode={mode} ...")
+            r = phase_a_rag(sweep_settings, enc_cfg, llm_cfg, docs, queries,
+                            n_queries, new_tokens, sweep_conc,
+                            kv_quant=kv_quant, verify_mode=mode)
+            verify_sweep[mode] = {
+                "p50_ms": r["p50_ms"],
+                "p95_ms": r["p95_ms"],
+                "qps": r["qps"],
+                "answer_p50_ms": r["verify"]["answer_ms"]["p50"],
+                "verdict_p50_ms": r["verify"]["verdict_ms"]["p50"],
+                "gate_skip_rate": r["verify"]["gate_skip_rate"],
+            }
+        log(f"phase VERIFY_SWEEP: {verify_sweep}")
     baseline = phase_b_baseline(docs, queries, n_queries, dim=enc_cfg.dim)
     baseline_wan = None if fast else phase_b_baseline(
         docs, queries, n_queries, dim=enc_cfg.dim,
@@ -1372,6 +1482,7 @@ def main() -> None:
         **({"kernels": kernels} if kernels else {}),
         **({"longctx": longctx} if longctx else {}),
         **({"speculative": speculative} if speculative else {}),
+        **({"verify_sweep": verify_sweep} if verify_sweep else {}),
         **({"load": load} if load else {}),
         **({"chaos": chaos} if chaos else {}),
         "wall_s": round(total_s, 1),

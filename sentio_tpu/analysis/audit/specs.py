@@ -126,15 +126,21 @@ def _paged_args(eng, family: str, desc: dict):
             (eng.params, np.zeros(S, np.int32), np.zeros(S, np.int32),
              np.zeros(S, bool), eng._page_table.copy(), eng.pool.k,
              eng.pool.v, eng._rng, np.zeros(S, np.float32),
-             np.zeros(S, np.int32), np.zeros(S, np.int32)),
+             np.zeros(S, np.int32), np.zeros(S, np.int32),
+             # per-slot running logprob accumulators (sum / min / count) —
+             # traced [S] data like temps/budgets, no new variant axis
+             np.zeros(S, np.float32), np.zeros(S, np.float32),
+             np.zeros(S, np.int32)),
             {"steps": desc["steps"]},
         )
     if family == "paged.merge_admitted":
         r = desc["rows"]
         return (
             (np.zeros(S, np.int32), np.zeros(S, np.int32), np.zeros(S, bool),
-             np.zeros(r, np.int32), np.zeros(r, np.int32),
-             np.full(r, S, np.int32)),
+             np.zeros(S, np.float32), np.zeros(S, np.float32),
+             np.zeros(S, np.int32),
+             np.zeros(r, np.int32), np.zeros(r, np.float32),
+             np.zeros(r, np.int32), np.full(r, S, np.int32)),
             {},
         )
     if family == "paged.prefill_scatter":

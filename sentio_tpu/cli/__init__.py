@@ -89,6 +89,13 @@ def _cmd_trace(args: argparse.Namespace) -> int:
             args.query, metadata={"mode": args.mode, "query_id": query_id}
         )
     )
+    # async/gated verification: the graph returns before the detached
+    # audit lands — join it so the one-shot trace prints the verdict the
+    # flight record ends up with (the serving path never waits like this)
+    if state["metadata"].get("verify_pending"):
+        from sentio_tpu.graph.executor import wait_detached
+
+        wait_detached()
     trace = {
         "query": args.query,
         "request_id": query_id,
@@ -98,6 +105,10 @@ def _cmd_trace(args: argparse.Namespace) -> int:
         "num_reranked": len(state.get("reranked_documents") or []),
         "num_selected": len(state.get("selected_documents") or []),
         "answer": state.get("response"),
+        # verify verdict (or typed skipped_confident) as the graph saw it;
+        # the per-request verify record — mode, confidence, verdict
+        # latency, skip reason — rides trace["flight"]["verify"] below
+        "evaluation": state.get("evaluation") or None,
         "metadata": {
             k: v for k, v in state["metadata"].items()
             if k not in ("graph_path", "node_timings_ms")

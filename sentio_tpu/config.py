@@ -235,6 +235,16 @@ class GeneratorConfig:
     max_prompt_tokens: int = 4096
     use_verifier: bool = True
     verifier_max_tokens: int = 512
+    # confidence-gated / async verification (ops/confidence.py):
+    #   sync  — verify blocks the response (the reference behavior);
+    #   async — the answer returns immediately, verify runs detached and
+    #           the verdict lands on the flight record (/debug/flight/{id};
+    #           SSE streams get a trailing `verify` event after done);
+    #   gated — confidence >= verify_confidence_threshold short-circuits
+    #           with a typed `skipped_confident` verdict (zero verify
+    #           decode); below-threshold requests take the async path
+    verify_mode: str = "sync"  # sync | async | gated
+    verify_confidence_threshold: float = 0.75
     dtype: str = "bfloat16"
     kv_page_size: int = 128
     kv_max_pages_per_seq: int = 64
@@ -294,6 +304,10 @@ class GeneratorConfig:
             max_prompt_tokens=_env_int(["MAX_PROMPT_TOKENS"], 4096),
             use_verifier=_env_bool(["USE_VERIFIER"], True),
             verifier_max_tokens=_env_int(["VERIFIER_MAX_TOKENS"], 512),
+            verify_mode=_env_str(["VERIFY_MODE"], "sync"),
+            verify_confidence_threshold=_env_float(
+                ["VERIFY_CONFIDENCE_THRESHOLD"], 0.75
+            ),
             dtype=_env_str(["LLM_DTYPE"], "bfloat16"),
             kv_page_size=_env_int(["KV_PAGE_SIZE"], 128),
             kv_max_pages_per_seq=_env_int(["KV_MAX_PAGES_PER_SEQ"], 64),

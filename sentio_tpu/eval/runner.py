@@ -68,6 +68,8 @@ def run_eval(
     configs: Optional[set] = None,
     encoder_checkpoint: str = "",
     kv_quant: str = "none",
+    verify_mode: str = "sync",
+    verify_threshold: Optional[float] = None,
 ) -> dict:
     """Run the eval matrix; returns the EVAL.json payload (pure dict)."""
     import jax
@@ -107,6 +109,12 @@ def run_eval(
 
     settings = Settings()
     settings.generator.max_new_tokens = new_tokens
+    # confidence-gated verification (ops/confidence.py): the verify quality
+    # gate (tests/test_eval.py::TestVerifyGate) runs gated vs sync over the
+    # SAME bundle/params and compares per-query verdicts
+    settings.generator.verify_mode = verify_mode
+    if verify_threshold is not None:
+        settings.generator.verify_confidence_threshold = verify_threshold
     # the verifier emits a short JSON verdict; with random-init weights it
     # never hits EOS, so an uncapped budget would decode to the full default
     settings.generator.verifier_max_tokens = verifier_tokens
@@ -258,21 +266,59 @@ def run_eval(
             # cannot see it. list.append is atomic under the GIL, so the
             # concurrent "batched" config needs no extra lock.
             answer_chars: list[int] = []
+            # per-question FINAL verdicts (async/gated verdicts are awaited
+            # off the flight record) — what TestVerifyGate compares between
+            # a gated and an always-verify run; dict so the harness warmup
+            # repeat of question 0 just overwrites
+            verdicts: dict[str, str] = {}
+
+            def _await_verdict(query_id: str, timeout_s: float = 60.0):
+                """Poll the flight record for a detached verify's verdict
+                (VERIFY_MODE=async|gated leave the graph before the audit
+                lands)."""
+                from sentio_tpu.infra.flight import get_flight_recorder
+
+                deadline = time.perf_counter() + timeout_s
+                while time.perf_counter() < deadline:
+                    rec = get_flight_recorder().get(query_id) or {}
+                    outcome = rec.get("verify", {}).get("outcome")
+                    if outcome is not None:
+                        return outcome
+                    time.sleep(0.05)
+                return None
 
             def full(question: str):
-                state = graph.invoke(create_initial_state(question, metadata={"mode": "fast"}))
+                import uuid
+
+                query_id = f"eval-{uuid.uuid4().hex[:10]}"
+                state = graph.invoke(create_initial_state(
+                    question, metadata={"mode": "fast", "query_id": query_id}
+                ))
                 docs = state.get("reranked_documents") or state.get("retrieved_documents") or []
                 answer = state.get("response", "") or ""
                 answer_chars.append(len(answer))
+                verdict = (state.get("evaluation") or {}).get("verdict")
+                if verdict is None and state.get("metadata", {}).get(
+                        "verify_pending"):
+                    verdict = _await_verdict(query_id)
+                if verdict is not None:
+                    verdicts[question] = str(verdict)
                 return docs, answer
 
             if "full_paged" in want:
                 _log("eval: [4/5] full_paged ...")
                 answer_chars.clear()
+                verdicts.clear()
                 res4 = run_queries("4-full-graph-paged", full, queries)
                 if answer_chars:
                     res4.extras["answer_chars_mean"] = round(
                         sum(answer_chars) / len(answer_chars), 1)
+                if verdicts:
+                    res4.extras["verdicts"] = dict(verdicts)
+                    skipped = sum(1 for v in verdicts.values()
+                                  if v == "skipped_confident")
+                    res4.extras["verify_skip_rate"] = round(
+                        skipped / len(verdicts), 4)
                 rows.append(res4.row())
             if "batched" in want:
                 _log(f"eval: [5/5] batched x{concurrency} ...")
@@ -307,6 +353,11 @@ def run_eval(
             baseline_row = baseline.row()
     finally:
         if service is not None:
+            # detached verify threads (VERIFY_MODE=async|gated) still hold
+            # tickets on this service — join them before tearing it down
+            from sentio_tpu.graph.executor import wait_detached
+
+            wait_detached()
             service.close()
 
     payload: dict = {
@@ -329,6 +380,7 @@ def run_eval(
         "rtt_ms": rtt_ms,
         "wall_s": round(time.perf_counter() - t_start, 1),
         **({"kv_quant": kv_quant} if kv_quant != "none" else {}),
+        **({"verify_mode": verify_mode} if verify_mode != "sync" else {}),
         **extras,
     }
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import inspect
 import logging
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Mapping, Optional, Union
@@ -27,6 +28,30 @@ from typing import Any, Awaitable, Callable, Mapping, Optional, Union
 logger = logging.getLogger(__name__)
 
 END = "__end__"
+
+# live detached-node threads (async verify): bench/eval/tests join them via
+# wait_detached() before tearing the decode service down under their feet
+_detached_lock = threading.Lock()
+_detached_threads: list[threading.Thread] = []  # guarded-by: _detached_lock
+
+
+def wait_detached(timeout_s: float = 30.0) -> bool:
+    """Join every live detached-node thread (best effort, bounded by the
+    shared ``timeout_s`` wall clock). Returns whether all finished. The
+    serving path never calls this — detached nodes are fire-and-forget
+    there — but anything that closes the decode service right after a
+    graph run (bench sweeps, eval, tests) must, or the trailing verify
+    decode races the shutdown."""
+    deadline = time.perf_counter() + max(timeout_s, 0.0)
+    while True:
+        with _detached_lock:
+            _detached_threads[:] = [t for t in _detached_threads if t.is_alive()]
+            live = list(_detached_threads)
+        if not live:
+            return True
+        if time.perf_counter() >= deadline:
+            return False
+        live[0].join(timeout=min(max(deadline - time.perf_counter(), 0.0), 0.5))
 
 NodeFn = Callable[[dict], Union[Mapping[str, Any], Awaitable[Mapping[str, Any]], None]]
 RouterFn = Callable[[dict], str]
@@ -42,6 +67,13 @@ class _Node:
     name: str
     fn: NodeFn
     soft_fail: bool = True
+    # detached nodes run OFF the critical path: the executor snapshots the
+    # state, launches the node on a daemon thread, stamps
+    # metadata[f"{name}_pending"] = True, and follows the edge immediately.
+    # The node's return value is discarded — a detached node communicates
+    # through side effects (the async verify node writes its verdict to the
+    # flight recorder, where /debug/flight/{id} serves it)
+    detached: bool = False
 
 
 @dataclass
@@ -71,6 +103,29 @@ class CompiledGraph:
                 raise GraphError(f"step limit {self.max_steps} exceeded; path: {path}")
             node = self.nodes[current]
             path.append(current)
+            if node.detached:
+                # off-critical-path stage (async verify): snapshot the state
+                # so the thread never races later merges, launch, move on.
+                # The answer does not wait for the audit — this edge is what
+                # turns verify's ~500 ms from blocking latency into overlap.
+                snapshot = dict(state)
+                snapshot["metadata"] = dict(state.get("metadata", {}))
+                thread = threading.Thread(
+                    target=_run_detached, args=(node, snapshot),
+                    name=f"graph-detached-{node.name}", daemon=True,
+                )
+                with _detached_lock:
+                    _detached_threads[:] = [
+                        t for t in _detached_threads if t.is_alive()
+                    ]
+                    _detached_threads.append(thread)
+                thread.start()
+                state = _merge(
+                    state, {"metadata": {f"{node.name}_pending": True}}
+                )
+                edge = self.edges.get(current, END)
+                current = edge(state) if callable(edge) else edge
+                continue
             t0 = time.perf_counter()
             try:
                 update = node.fn(state)
@@ -112,6 +167,23 @@ class CompiledGraph:
         return asyncio.run(self.ainvoke(state, config))
 
 
+def _run_detached(node: _Node, state: dict) -> None:
+    """Drive one detached node to completion on its own thread (its own
+    event loop — the spawning loop is long gone by the time a slow audit
+    decode finishes). Exceptions are logged, never propagated: the caller
+    already has its answer."""
+    try:
+        update = node.fn(state)
+        if inspect.isawaitable(update):
+            asyncio.run(_await_detached(update))
+    except Exception:  # noqa: BLE001 — off-path stage must not crash anything
+        logger.exception("detached node %s failed", node.name)
+
+
+async def _await_detached(awaitable) -> None:
+    await awaitable
+
+
 def _merge(state: dict, update: Optional[Mapping[str, Any]]) -> dict:
     if not update:
         return state
@@ -136,12 +208,13 @@ class GraphBuilder:
     _entry: Optional[str] = None
     max_steps: int = 64
 
-    def add_node(self, name: str, fn: NodeFn, soft_fail: bool = True) -> "GraphBuilder":
+    def add_node(self, name: str, fn: NodeFn, soft_fail: bool = True,
+                 detached: bool = False) -> "GraphBuilder":
         if name == END:
             raise GraphError(f"{END!r} is reserved")
         if name in self._nodes:
             raise GraphError(f"duplicate node {name!r}")
-        self._nodes[name] = _Node(name, fn, soft_fail)
+        self._nodes[name] = _Node(name, fn, soft_fail, detached)
         return self
 
     def add_edge(self, src: str, dst: str) -> "GraphBuilder":

@@ -15,6 +15,8 @@ from typing import Optional
 from sentio_tpu.config import Settings, get_settings
 from sentio_tpu.graph.executor import END, CompiledGraph, GraphBuilder
 from sentio_tpu.graph.nodes import (
+    confidence_gate_router,
+    create_confidence_gate_node,
     create_document_selector_node,
     create_generator_node,
     create_reranker_node,
@@ -22,11 +24,21 @@ from sentio_tpu.graph.nodes import (
     create_verifier_node,
 )
 
+VERIFY_MODES = ("sync", "async", "gated")
+
 
 @dataclass
 class GraphConfig:
     use_reranker: bool = True
     use_verifier: bool = True
+    # sync  — verify blocks the response (reference behavior);
+    # async — verify runs as a DETACHED trailing node (the executor
+    #         launches it off-thread and ends the graph immediately;
+    #         verdict lands on the flight record);
+    # gated — a confidence gate (ops/confidence.py) short-circuits verify
+    #         entirely for confident answers; the rest go async.
+    # None = resolve from settings.generator.verify_mode at build time.
+    verify_mode: Optional[str] = None
     settings: Settings = field(default_factory=get_settings)
 
     @classmethod
@@ -35,6 +47,7 @@ class GraphConfig:
         return cls(
             use_reranker=settings.rerank.enabled,
             use_verifier=settings.generator.use_verifier,
+            verify_mode=settings.generator.verify_mode,
             settings=settings,
         )
 
@@ -57,16 +70,39 @@ def build_basic_graph(
     builder.add_node("select", create_document_selector_node(settings))
     builder.add_node("generate", create_generator_node(generator, settings))
     use_verify = config.use_verifier and verifier is not None
+    mode = config.verify_mode or settings.generator.verify_mode or "sync"
+    if mode not in VERIFY_MODES:
+        raise ValueError(
+            f"verify_mode must be one of {VERIFY_MODES}, got {mode!r}"
+        )
     if use_verify:
-        builder.add_node("verify", create_verifier_node(verifier, settings))
+        # async/gated: verify is a DETACHED trailing node — the executor
+        # fires it off-thread and the graph (hence the HTTP response)
+        # returns at the generate/gate boundary; the verdict lands on the
+        # flight record. gated additionally fronts it with the confidence
+        # gate, whose conditional edge ends the graph outright for
+        # confident answers (no verify admission at all).
+        builder.add_node(
+            "verify", create_verifier_node(verifier, settings, mode=mode),
+            detached=mode in ("async", "gated"),
+        )
+        if mode == "gated":
+            builder.add_node("verify_gate",
+                             create_confidence_gate_node(settings))
 
     builder.set_entry("retrieve")
     builder.add_edge("retrieve", "rerank" if use_rerank else "select")
     if use_rerank:
         builder.add_edge("rerank", "select")
     builder.add_edge("select", "generate")
-    builder.add_edge("generate", "verify" if use_verify else END)
-    if use_verify:
+    if not use_verify:
+        builder.add_edge("generate", END)
+    elif mode == "gated":
+        builder.add_edge("generate", "verify_gate")
+        builder.add_conditional_edge("verify_gate", confidence_gate_router)
+        builder.add_edge("verify", END)
+    else:
+        builder.add_edge("generate", "verify")
         builder.add_edge("verify", END)
     return builder.compile()
 

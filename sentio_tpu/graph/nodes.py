@@ -153,6 +153,10 @@ def create_generator_node(generator, settings: Optional[Settings] = None):
         # admission is charged against this tenant's fair-share quota
         tenant = meta.get("tenant")
         priority = meta.get("priority")
+        # logprob accumulators from the paged decode (runtime/paged.py):
+        # filled in place by the provider when the serving path carries
+        # them; the confidence gate scores them after this node
+        gen_stats: dict[str, Any] = {}
         t0 = time.perf_counter()
         try:
             # device generation is the longest stage — keep it off the event
@@ -166,6 +170,7 @@ def create_generator_node(generator, settings: Optional[Settings] = None):
                     deadline_ts=deadline,
                     tenant=str(tenant) if tenant else None,
                     priority=str(priority) if priority else None,
+                    stats=gen_stats,
                 ),
             )
         except Exception as exc:  # noqa: BLE001
@@ -173,30 +178,130 @@ def create_generator_node(generator, settings: Optional[Settings] = None):
                 raise  # shed/deadline errors surface as 429/503/504, not prose
             logger.exception("generation failed")
             return {"response": "", "metadata": {"generation_error": str(exc)}}
-        return {
-            "response": answer,
-            "metadata": {
-                "generation_ms": round((time.perf_counter() - t0) * 1000, 2),
-                "generation_mode": mode,
-                "generator": getattr(generator.provider, "name", "unknown"),
-            },
+        update_meta: dict[str, Any] = {
+            "generation_ms": round((time.perf_counter() - t0) * 1000, 2),
+            "generation_mode": mode,
+            "generator": getattr(generator.provider, "name", "unknown"),
         }
+        if gen_stats.get("logprob_count"):
+            update_meta["logprob_mean"] = round(gen_stats["logprob_mean"], 4)
+            update_meta["logprob_min"] = round(gen_stats["logprob_min"], 4)
+            update_meta["logprob_count"] = gen_stats["logprob_count"]
+        return {"response": answer, "metadata": update_meta}
 
     return generate_node
 
 
-def create_verifier_node(verifier, settings: Optional[Settings] = None):
+def _record_verify(request_id: Optional[str], mode: str, outcome: str,
+                   confidence: Optional[float] = None,
+                   verdict_ms: Optional[float] = None,
+                   skipped: Optional[str] = None) -> None:
+    """One per-request verify record, published to BOTH evidence surfaces:
+    the ``sentio_tpu_verify_total{mode,outcome}`` counter + confidence
+    histogram in /metrics, and the request's flight record (``verify``
+    section — what ``sentio trace`` and ``/debug/flight/{id}`` print).
+    Best-effort: telemetry must never fail a verdict."""
+    try:
+        from sentio_tpu.infra.flight import get_flight_recorder
+        from sentio_tpu.infra.metrics import get_metrics
+
+        get_metrics().record_verify(mode, outcome, confidence=confidence)
+        if request_id:
+            fields: dict[str, Any] = {"mode": mode, "outcome": outcome}
+            if confidence is not None:
+                fields["confidence"] = round(float(confidence), 4)
+            if verdict_ms is not None:
+                fields["verdict_ms"] = round(float(verdict_ms), 2)
+            if skipped is not None:
+                fields["skipped"] = skipped
+            get_flight_recorder().note_verify(str(request_id), **fields)
+    except Exception:  # noqa: BLE001
+        logger.debug("verify telemetry failed", exc_info=True)
+
+
+def confidence_skip_evaluation(confidence: float) -> dict[str, Any]:
+    """THE typed ``skipped_confident`` verdict shape — shared by the graph
+    gate node and the SSE streaming handler so the two surfaces can never
+    drift."""
+    return {
+        "verdict": "skipped_confident",
+        "citations_ok": True,
+        "confidence": round(float(confidence), 4),
+        "notes": [],
+    }
+
+
+def create_confidence_gate_node(settings: Optional[Settings] = None):
+    """The ``verify_gate`` node (VERIFY_MODE=gated): scores the generation's
+    logprob accumulators + retrieval fusion margins (ops/confidence.py) and,
+    at or above ``verify_confidence_threshold``, short-circuits verification
+    with a typed ``skipped_confident`` verdict — zero verify-decode
+    admissions, the whole audit round-trip saved. Below threshold (or with
+    no logprob signal at all) it stamps the score and routes on to the
+    detached verify node."""
+    settings = settings or get_settings()
+    threshold = settings.generator.verify_confidence_threshold
+
+    def gate_node(state: RAGState) -> dict[str, Any]:
+        from sentio_tpu.ops.confidence import confidence_score
+
+        meta = state.get("metadata", {})
+        request_id = meta.get("query_id")
+        answer = state.get("response", "")
+        if not answer:
+            # nothing to audit; the verify node's empty-answer warn applies
+            return {"metadata": {"verify_confidence": None}}
+        conf = confidence_score(
+            meta.get("logprob_mean"), meta.get("logprob_min"),
+            best_documents(state),
+        )
+        if conf is not None and conf >= threshold:
+            _record_verify(request_id, "gated", "skipped_confident",
+                           confidence=conf, skipped="confident")
+            return {
+                "evaluation": confidence_skip_evaluation(conf),
+                "metadata": {
+                    "verify_confidence": round(conf, 4),
+                    "verify_skipped": "confident",
+                },
+            }
+        return {"metadata": {
+            "verify_confidence": None if conf is None else round(conf, 4),
+        }}
+
+    return gate_node
+
+
+def confidence_gate_router(state: RAGState) -> str:
+    """Conditional edge after ``verify_gate``: confident answers end the
+    graph (no verify at all); everything else proceeds to ``verify``."""
+    from sentio_tpu.graph.executor import END
+
+    if state.get("metadata", {}).get("verify_skipped") == "confident":
+        return END
+    return "verify"
+
+
+def create_verifier_node(verifier, settings: Optional[Settings] = None,
+                         mode: str = "sync"):
     settings = settings or get_settings()
 
     async def verify_node(state: RAGState) -> dict[str, Any]:
         answer = state.get("response", "")
         if not answer:
+            # recorded like every other terminal outcome: in async/gated
+            # mode the caller holds verify_pending and polls the flight
+            # record — an unrecorded return would leave it pending forever
+            _record_verify(state.get("metadata", {}).get("query_id"),
+                           mode, "skipped_empty", skipped="empty")
             return {"evaluation": {"verdict": "warn", "notes": ["empty answer"]}}
         # verification is an optional quality stage: with the caller's
         # deadline already spent, running it would burn decode ticks on an
         # answer nobody may read in time — return the unverified answer
         remaining = deadline_remaining_s(state)
         if remaining is not None and remaining <= 0:
+            _record_verify(state.get("metadata", {}).get("query_id"),
+                           mode, "skipped_deadline", skipped="deadline")
             return {
                 "evaluation": {
                     "verdict": "skip",
@@ -232,14 +337,23 @@ def create_verifier_node(verifier, settings: Optional[Settings] = None):
                 priority=str(priority) if priority else None,
             ),
         )
+        verdict_ms = round((time.perf_counter() - t0) * 1000, 2)
+        _record_verify(
+            str(request_id) if request_id else None, mode, result.verdict,
+            confidence=meta.get("verify_confidence"), verdict_ms=verdict_ms,
+        )
         update: dict[str, Any] = {
             "evaluation": result.to_dict(),
             "metadata": {
-                "verify_ms": round((time.perf_counter() - t0) * 1000, 2),
+                "verify_ms": verdict_ms,
                 "verdict": result.verdict,
             },
         }
         if result.verdict == "fail" and result.revised_answer:
+            # sync mode only in practice: a detached verify's update is
+            # discarded by the executor — the answer already shipped, so a
+            # late rewrite has nowhere to go (the verdict still lands on
+            # the flight record for the caller to fetch)
             update["response"] = result.revised_answer
             update["metadata"]["answer_revised"] = True
         return update

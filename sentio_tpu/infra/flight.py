@@ -141,6 +141,20 @@ class FlightRecorder:
             engine["tick_last"] = self._tick_seq
             self._records.move_to_end(request_id)
 
+    def note_verify(self, request_id: str, **fields: Any) -> None:
+        """Merge fields into the request's ``verify`` section (mode,
+        confidence score, verdict, verdict latency, skipped reason).
+        Deliberately works on FINISHED records too: with VERIFY_MODE=async
+        or gated, the answer's record closes before the detached audit
+        lands its verdict — ``/debug/flight/{id}`` is where a caller holding
+        ``verify_pending`` fetches the late verdict."""
+        if not request_id:
+            return
+        with self._lock:
+            record = self._ensure_locked(request_id)
+            record.setdefault("verify", {}).update(fields)
+            self._records.move_to_end(request_id)
+
     def finish_request(self, request_id: str, **fields: Any) -> None:
         if not request_id:
             return

@@ -217,6 +217,21 @@ class MetricsCollector:
                 "replica health state machine position (1 = current state)",
                 ["replica", "state"], registry=r,
             ),
+            # confidence-gated verification (ops/confidence.py + the graph
+            # verify node): outcome per mode — skipped_confident is the
+            # gate paying off, a skip-rate anomaly alert rides this series
+            "verify_total": Counter(
+                "sentio_tpu_verify_total",
+                "answer verifications by mode and outcome",
+                ["mode", "outcome"], registry=r,
+            ),
+            "verify_confidence": Histogram(
+                "sentio_tpu_verify_confidence",
+                "confidence-gate score per scored answer",
+                [], buckets=(0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.75,
+                             0.8, 0.85, 0.9, 0.95, 1.0),
+                registry=r,
+            ),
             # stall watchdog: seconds since a replica's decode pump last
             # completed a loop iteration WITH pending work (0 = idle or
             # freshly ticked). A tick wedged inside a device dispatch
@@ -316,6 +331,22 @@ class MetricsCollector:
         self.memory.inc("shed", (reason,), n)
         if self._prom:
             self._prom["shed"].labels(reason).inc(n)
+
+    def record_verify(self, mode: str, outcome: str,
+                      confidence: Optional[float] = None) -> None:
+        """One answer-verification outcome (``mode``: sync | async | gated;
+        ``outcome``: pass | warn | fail | skipped_confident |
+        skipped_deadline), plus the gate's confidence score when one was
+        computed."""
+        if not self.enabled:
+            return
+        self.memory.inc("verify", (mode, outcome))
+        if confidence is not None:
+            self.memory.observe("verify_confidence", (), float(confidence))
+        if self._prom:
+            self._prom["verify_total"].labels(mode, outcome).inc()
+            if confidence is not None:
+                self._prom["verify_confidence"].observe(float(confidence))
 
     def record_tenant_admitted(self, tenant: str) -> None:
         """One request admitted through WFQ for ``tenant``."""

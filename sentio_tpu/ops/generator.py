@@ -94,11 +94,35 @@ class TpuProvider:
             out["priority"] = priority
         return out
 
+    @staticmethod
+    def _fill_stats(stats: Optional[dict], result) -> None:
+        """Copy a PagedResult's logprob accumulators into the caller's
+        stats dict (the confidence gate's signal — ops/confidence.py)."""
+        if stats is not None:
+            stats.update(result.stats_dict())
+
+    def _stream_takes_stats(self) -> bool:
+        """Whether the attached service's ``generate_stream`` accepts the
+        ``stats_out`` sink — introspected ONCE per provider, not per
+        streamed request (the probe sits on the hot path)."""
+        cached = getattr(self, "_stream_stats_ok", None)
+        if cached is None:
+            import inspect
+
+            try:
+                cached = "stats_out" in inspect.signature(
+                    self.service.generate_stream).parameters
+            except (TypeError, ValueError):
+                cached = False
+            object.__setattr__(self, "_stream_stats_ok", cached)
+        return cached
+
     def chat(self, prompt: str, max_new_tokens: int, temperature: float,
              request_id: Optional[str] = None,
              deadline_ts: Optional[float] = None,
              tenant: Optional[str] = None,
-             priority: Optional[str] = None) -> str:
+             priority: Optional[str] = None,
+             stats: Optional[dict] = None) -> str:
         if self.service is not None:
             try:
                 result = self.service.generate(
@@ -107,6 +131,7 @@ class TpuProvider:
                     **self._tenant_kwargs(tenant, priority),
                 )
                 if result.finish_reason != "error":
+                    self._fill_stats(stats, result)
                     return result.text
             except Exception as exc:  # noqa: BLE001 — contiguous engine is the escape hatch
                 if getattr(exc, "soft_fail_exempt", False):
@@ -134,14 +159,21 @@ class TpuProvider:
                request_id: Optional[str] = None,
                deadline_ts: Optional[float] = None,
                tenant: Optional[str] = None,
-               priority: Optional[str] = None) -> Iterator[str]:
+               priority: Optional[str] = None,
+               stats: Optional[dict] = None) -> Iterator[str]:
         if self.service is not None and hasattr(self.service, "generate_stream"):
             yielded_any = False
+            stream_kwargs = self._tenant_kwargs(tenant, priority)
+            if stats is not None and self._stream_takes_stats():
+                # only our own service implementations take stats_out; a
+                # test fake with the bare generate_stream signature keeps
+                # working (the gate then sees no logprobs and never skips)
+                stream_kwargs["stats_out"] = stats
             try:
                 for piece in self.service.generate_stream(
                     prompt, max_new_tokens=max_new_tokens, temperature=temperature,
                     request_id=request_id, deadline_ts=deadline_ts,
-                    **self._tenant_kwargs(tenant, priority),
+                    **stream_kwargs,
                 ):
                     yielded_any = True
                     yield piece
@@ -487,10 +519,11 @@ class LLMGenerator:
         deadline_ts: Optional[float] = None,
         tenant: Optional[str] = None,
         priority: Optional[str] = None,
+        stats: Optional[dict] = None,
     ) -> dict:
         """The optional per-request context kwargs (trace id, absolute
-        deadline, WFQ tenant key + priority tier) the provider's method is
-        able to receive."""
+        deadline, WFQ tenant key + priority tier, confidence-stats sink)
+        the provider's method is able to receive."""
         out: dict = {}
         if request_id and self._method_accepts(method, "request_id"):
             out["request_id"] = request_id
@@ -500,6 +533,8 @@ class LLMGenerator:
             out["tenant"] = tenant
         if priority is not None and self._method_accepts(method, "priority"):
             out["priority"] = priority
+        if stats is not None and self._method_accepts(method, "stats"):
+            out["stats"] = stats
         return out
 
     def generate(
@@ -513,6 +548,7 @@ class LLMGenerator:
         deadline_ts: Optional[float] = None,
         tenant: Optional[str] = None,
         priority: Optional[str] = None,
+        stats: Optional[dict] = None,
     ) -> str:
         prompt = self.build_prompt(query, documents)
         temp = temperature if temperature is not None else self.config.temperature(mode)
@@ -521,7 +557,7 @@ class LLMGenerator:
             max_new_tokens=max_new_tokens or self.config.max_new_tokens,
             temperature=temp,
             **self._trace_kwargs("chat", request_id, deadline_ts,
-                                 tenant, priority),
+                                 tenant, priority, stats),
         )
 
     def stream(
@@ -535,6 +571,7 @@ class LLMGenerator:
         deadline_ts: Optional[float] = None,
         tenant: Optional[str] = None,
         priority: Optional[str] = None,
+        stats: Optional[dict] = None,
     ) -> Iterator[str]:
         prompt = self.build_prompt(query, documents)
         temp = temperature if temperature is not None else self.config.temperature(mode)
@@ -543,7 +580,7 @@ class LLMGenerator:
             max_new_tokens=max_new_tokens or self.config.max_new_tokens,
             temperature=temp,
             **self._trace_kwargs("stream", request_id, deadline_ts,
-                                 tenant, priority),
+                                 tenant, priority, stats),
         )
 
     def chat_raw(self, prompt: str, max_new_tokens: int, temperature: float,

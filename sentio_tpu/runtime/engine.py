@@ -197,7 +197,7 @@ class GeneratorEngine:
                 params, cfg, tok, positions=lens[:, None], cache=cache, cache_index=lens
             )
             rng, sub = jax.random.split(rng)
-            nxt = sample_tokens(logits[:, -1], sub, temperature, top_k=top_k)
+            nxt, _lp = sample_tokens(logits[:, -1], sub, temperature, top_k=top_k)
             return nxt, cache, rng
 
         @jit_family("engine.generate_fused",
@@ -219,7 +219,7 @@ class GeneratorEngine:
             row_valid = pad_mask.any(axis=1, keepdims=True)  # junk bucket rows
             last = jnp.take_along_axis(logits, (lens - 1)[:, None, None], axis=1)[:, 0]
             rng, sub = jax.random.split(rng)
-            first = sample_tokens(last, sub, temperature, top_k=top_k)
+            first, _first_lp = sample_tokens(last, sub, temperature, top_k=top_k)
 
             def body(carry, _):
                 tok, lens, cache, rng, done = carry
@@ -231,7 +231,7 @@ class GeneratorEngine:
                     pad_mask=row_valid & ~done[:, None],
                 )
                 rng, sub = jax.random.split(rng)
-                nxt = sample_tokens(logits[:, -1], sub, temperature, top_k=top_k)
+                nxt, _lp = sample_tokens(logits[:, -1], sub, temperature, top_k=top_k)
                 nxt = jnp.where(done, eos_id, nxt)
                 done = done | (nxt == eos_id)
                 return (nxt, lens + 1, cache, rng, done), nxt
@@ -453,7 +453,7 @@ class GeneratorEngine:
         from sentio_tpu.runtime.sampling import sample_tokens
 
         self._rng, sub = jax.random.split(self._rng)
-        tok = sample_tokens(last, sub, temp, top_k=top_k)
+        tok, _lp = sample_tokens(last, sub, temp, top_k=top_k)
         lens = jnp.asarray(lens)
         emitted: list[int] = []
         flushed = ""

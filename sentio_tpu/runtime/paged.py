@@ -422,6 +422,32 @@ class PagedResult:
     # prompt_tokens) — the per-request evidence of prefill work skipped
     prefill_tokens: int = 0
     prefix_hit_tokens: int = 0
+    # sampled-token logprob accumulators (sum / min / sample count over
+    # every token this request sampled, EOS included) — the raw signal the
+    # verify confidence gate (ops/confidence.py) scores. count == 0 means
+    # no logprobs were observed (cancelled pre-decode, spec-tick path).
+    logprob_sum: float = 0.0
+    logprob_min: float = 0.0
+    logprob_count: int = 0
+
+    @property
+    def logprob_mean(self) -> Optional[float]:
+        if self.logprob_count <= 0:
+            return None
+        return self.logprob_sum / self.logprob_count
+
+    def stats_dict(self) -> dict:
+        """The confidence-gate signal as one dict — THE shape every
+        ``stats``/``stats_out`` sink (TpuProvider, generate_stream) fills,
+        so the streaming and non-streaming gates can never diverge."""
+        return {
+            "logprob_sum": self.logprob_sum,
+            "logprob_min": self.logprob_min,
+            "logprob_count": self.logprob_count,
+            "logprob_mean": self.logprob_mean,
+            "tokens": len(self.tokens),
+            "finish_reason": self.finish_reason,
+        }
 
 
 class ContinuousBatchingEngine:
@@ -658,6 +684,12 @@ class ContinuousBatchingEngine:
         self._temps = np.zeros(max_slots, np.float32)
         self._top_ks = np.zeros(max_slots, np.int32)
         self._last_tok = np.zeros(max_slots, np.int32)
+        # per-slot logprob accumulator mirrors: seeded into the first
+        # dispatch after a reset, refreshed at harvest from the tick's
+        # packed lp_state fetch, read by _retire into the PagedResult
+        self._lp_sum = np.zeros(max_slots, np.float32)
+        self._lp_min = np.zeros(max_slots, np.float32)
+        self._lp_cnt = np.zeros(max_slots, np.int32)
         # Pallas paged-attention kernel walks page tables in VMEM on TPU;
         # the XLA gather path is the universal fallback (and CPU test path).
         # The kernel is representation-aware: int8 pools route to the quant
@@ -688,23 +720,34 @@ class ContinuousBatchingEngine:
         @jit_family("paged.step_n", static_argnames=("steps",),
                     donate_argnums=(5, 6))
         def step_n(params, tok, lens, halted, page_table, k_pages, v_pages,
-                   rng, temps, top_ks, budgets, steps):
+                   rng, temps, top_ks, budgets, lp_sum, lp_min, lp_cnt,
+                   steps):
             """``steps`` decode sub-steps fused into one dispatch (lax.scan).
 
             Per-row ``budgets`` bound how far each row may advance (token
             budget / page capacity, mirrored host-side); rows halt early on
             EOS. Frozen rows keep their lens/tok and write to scratch.
-            Returns per-step sampled tokens [1+steps, B] — the ONLY array
-            the host fetches per tick — plus the carried (tok, lens, halted)
-            DEVICE state, so the next tick can dispatch without waiting for
-            this tick's fetch (pipelining) and without re-uploading host
-            mirrors. The execution mask is not returned: the host replay
-            reconstructs it exactly from its own budgets plus first-EOS.
+            Returns per-step sampled tokens [1+steps, B] plus one packed
+            [3, B] float32 logprob-state array — the ONLY arrays the host
+            fetches per tick — and the carried (tok, lens, halted, lp_sum,
+            lp_min, lp_cnt) DEVICE state, so the next tick can dispatch
+            without waiting for this tick's fetch (pipelining) and without
+            re-uploading host mirrors. The execution mask is not returned:
+            the host replay reconstructs it exactly from its own budgets
+            plus first-EOS.
+
+            ``lp_sum``/``lp_min``/``lp_cnt`` are per-slot RUNNING logprob
+            accumulators (sum, min, sample count over every token this
+            request sampled, including an EOS) carried in the scan body as
+            traced state — the confidence gate's raw signal, accumulated
+            with zero extra dispatches. Admission seeds them with the first
+            token's logprob via ``merge_admitted``.
             """
             from sentio_tpu.runtime.sampling import sample_tokens
 
             def body(carry, idx):
-                tok, lens, k_pages, v_pages, rng, halted = carry
+                (tok, lens, k_pages, v_pages, rng, halted,
+                 lp_sum, lp_min, lp_cnt) = carry
                 active = (~halted) & (idx < budgets)
                 logits, k_pages, v_pages = paged_decode_forward(
                     params, cfg, tok, lens, page_table, k_pages, v_pages,
@@ -714,39 +757,56 @@ class ContinuousBatchingEngine:
                 # temperature AND top-k sample INSIDE the scan body — the
                 # tick is one dispatch, never logits-then-sample. top_ks is
                 # traced [B] int32; k<=0 rows keep the full distribution.
-                nxt = sample_tokens(logits, sub, temps, top_k=top_ks)
+                nxt, lp = sample_tokens(logits, sub, temps, top_k=top_ks)
                 tok = jnp.where(active, nxt, tok)
                 lens = jnp.where(active, lens + 1, lens)
+                lp_sum = jnp.where(active, lp_sum + lp, lp_sum)
+                lp_min = jnp.where(active, jnp.minimum(lp_min, lp), lp_min)
+                lp_cnt = jnp.where(active, lp_cnt + 1, lp_cnt)
                 if not ignore_eos:
                     halted = halted | (active & (nxt == eos_id))
-                return (tok, lens, k_pages, v_pages, rng, halted), nxt
+                return (tok, lens, k_pages, v_pages, rng, halted,
+                        lp_sum, lp_min, lp_cnt), nxt
 
             tok_in = tok
             # rows whose (deferred) first token is already EOS never run
             if not ignore_eos:
                 halted = halted | (tok == eos_id)
-            init = (tok, lens, k_pages, v_pages, rng, halted)
-            (tok, lens, k_pages, v_pages, rng, halted), toks = jax.lax.scan(
+            init = (tok, lens, k_pages, v_pages, rng, halted,
+                    lp_sum, lp_min, lp_cnt)
+            (tok, lens, k_pages, v_pages, rng, halted,
+             lp_sum, lp_min, lp_cnt), toks = jax.lax.scan(
                 body, init, jnp.arange(steps)
             )
             # packed [1 + steps, B]: row 0 echoes the INPUT tokens so freshly
             # admitted rows' device-resident first tokens reach the host in
             # the same single fetch as the tick outputs
             packed = jnp.concatenate([tok_in[None, :], toks], axis=0)
-            return packed, tok, lens, halted, k_pages, v_pages, rng
+            # one [3, B] fetch (not three): final accumulators, harvested
+            # into the host mirrors the retiring PagedResult reads
+            lp_state = jnp.stack(
+                [lp_sum, lp_min, lp_cnt.astype(jnp.float32)], axis=0
+            )
+            return (packed, lp_state, tok, lens, halted,
+                    lp_sum, lp_min, lp_cnt, k_pages, v_pages, rng)
 
         self._step_n = step_n
 
         @jit_family("paged.merge_admitted")
-        def merge_admitted(tok, lens, halted, first, new_lens, idxs):
+        def merge_admitted(tok, lens, halted, lp_sum, lp_min, lp_cnt,
+                           first, first_lp, new_lens, idxs):
             """Scatter admission's device-resident first tokens (plus their
-            prompt lengths, and a cleared halt flag) into the carried decode
-            state. ``idxs`` pads to ``first``'s length with an out-of-range
-            index; mode='drop' discards the pad rows."""
+            prompt lengths, a cleared halt flag, and the first token's
+            logprob seeding the per-slot confidence accumulators) into the
+            carried decode state. ``idxs`` pads to ``first``'s length with
+            an out-of-range index; mode='drop' discards the pad rows."""
             tok = tok.at[idxs].set(first, mode="drop")
             lens = lens.at[idxs].set(new_lens, mode="drop")
             halted = halted.at[idxs].set(False, mode="drop")
-            return tok, lens, halted
+            lp_sum = lp_sum.at[idxs].set(first_lp, mode="drop")
+            lp_min = lp_min.at[idxs].set(first_lp, mode="drop")
+            lp_cnt = lp_cnt.at[idxs].set(1, mode="drop")
+            return tok, lens, halted, lp_sum, lp_min, lp_cnt
 
         self._merge_admitted = merge_admitted
 
@@ -754,7 +814,8 @@ class ContinuousBatchingEngine:
         def prefill_scatter(params, ids, positions, lens, rng, temps, scat,
                             k_pages, v_pages, top_ks):
             """Batched admission in ONE dispatch: contiguous prefill forward,
-            cache scatter into each row's pages, first-token sample from each
+            cache scatter into each row's pages, first-token sample (token +
+            its logprob, seeding the confidence accumulators) from each
             row's last prompt logit. Pad rows scatter to scratch page 0."""
             from sentio_tpu.models.llama import init_cache
             from sentio_tpu.runtime.sampling import sample_tokens
@@ -773,8 +834,8 @@ class ContinuousBatchingEngine:
             )
             last = jnp.take_along_axis(logits, (lens - 1)[:, None, None], axis=1)[:, 0]
             rng, sub = jax.random.split(rng)
-            first = sample_tokens(last, sub, temps, top_k=top_ks)
-            return first, k_pages, v_pages, rng
+            first, first_lp = sample_tokens(last, sub, temps, top_k=top_ks)
+            return first, first_lp, k_pages, v_pages, rng
 
         self._prefill_scatter = prefill_scatter
 
@@ -847,10 +908,11 @@ class ContinuousBatchingEngine:
                 last = jnp.take_along_axis(
                     logits, (lens - 1)[:, None, None], axis=1)[:, 0]
                 rng, sub = jax.random.split(rng)
-                first = sample_tokens(last, sub, temps, top_k=top_ks)
+                first, first_lp = sample_tokens(last, sub, temps, top_k=top_ks)
             else:
                 first = jnp.zeros((b,), jnp.int32)
-            return first, k_pages, v_pages, rng
+                first_lp = jnp.zeros((b,), jnp.float32)
+            return first, first_lp, k_pages, v_pages, rng
 
         self._prior_prefill_scatter = prior_prefill_scatter
 
@@ -964,10 +1026,11 @@ class ContinuousBatchingEngine:
             [(toks[:full], 0.0, 0, [0] * (matched // self.page_size) + pages)],
             width,
         )
-        _first, self.pool.k, self.pool.v, self._rng = self._prefill_scatter(
-            self.params, ids, positions, lens, self._rng, temps, scat,
-            self.pool.k, self.pool.v, top_ks,
-        )
+        _first, _first_lp, self.pool.k, self.pool.v, self._rng = \
+            self._prefill_scatter(
+                self.params, ids, positions, lens, self._rng, temps, scat,
+                self.pool.k, self.pool.v, top_ks,
+            )
         _node, donated = self._radix.insert(toks[:full], matched, pages)
         leftover = set(pages) - set(donated)
         if leftover:  # span raced into the tree between match and insert
@@ -1052,6 +1115,9 @@ class ContinuousBatchingEngine:
         self._temps[:] = 0.0
         self._top_ks[:] = 0
         self._last_tok[:] = 0
+        self._lp_sum[:] = 0.0
+        self._lp_min[:] = 0.0
+        self._lp_cnt[:] = 0
         self._rng = jax.random.PRNGKey(int(np.random.default_rng().integers(2**31)))
 
     # FamilyFn instances owned by THIS engine (fresh jit wrappers per
@@ -1499,15 +1565,16 @@ class ContinuousBatchingEngine:
              for slot_idx, req, tok_ids in chunk],
             width,
         )
-        first, self.pool.k, self.pool.v, self._rng = self._prefill_scatter(
-            self.params, ids, positions, lens, self._rng, temps, scat,
-            self.pool.k, self.pool.v, top_ks,
-        )
+        first, first_lp, self.pool.k, self.pool.v, self._rng = \
+            self._prefill_scatter(
+                self.params, ids, positions, lens, self._rng, temps, scat,
+                self.pool.k, self.pool.v, top_ks,
+            )
         self.prefill_tokens_total += sum(len(t) for _i, _r, t in chunk)
         slot_idxs = [slot_idx for slot_idx, _req, _ids in chunk]
         for slot_idx in slot_idxs:
             self.slots[slot_idx].pending_first = True
-        self._pending_first.append((first, slot_idxs))
+        self._pending_first.append((first, first_lp, slot_idxs))
         # the dispatch above writes these rows' full prompt KV — their
         # full-page spans now seed the radix cache for later requests
         for slot_idx, _req, tok_ids in chunk:
@@ -1537,16 +1604,17 @@ class ContinuousBatchingEngine:
         ids, lens, temps, top_ks, scat, positions = self._assemble_prefill(
             rows_data, width, pos_offset=n_prior[:, None],
         )
-        first, self.pool.k, self.pool.v, self._rng = self._prior_prefill_scatter(
-            self.params, ids, positions, lens, self._rng, temps, scat,
-            self.pool.k, self.pool.v, prior_tables, n_prior, top_ks,
-            do_sample=True,
-        )
+        first, first_lp, self.pool.k, self.pool.v, self._rng = \
+            self._prior_prefill_scatter(
+                self.params, ids, positions, lens, self._rng, temps, scat,
+                self.pool.k, self.pool.v, prior_tables, n_prior, top_ks,
+                do_sample=True,
+            )
         self.prefill_tokens_total += sum(len(t) - s for _i, _r, t, s in chunk)
         slot_idxs = [slot_idx for slot_idx, _req, _ids, _sh in chunk]
         for slot_idx in slot_idxs:
             self.slots[slot_idx].pending_first = True
-        self._pending_first.append((first, slot_idxs))
+        self._pending_first.append((first, first_lp, slot_idxs))
         for slot_idx, _req, tok_ids, shared in chunk:
             self._radix_insert(slot_idx, tok_ids, shared)
 
@@ -1584,7 +1652,7 @@ class ContinuousBatchingEngine:
             pnb = self._prior_bucket(pb)
             prior_table = np.zeros((1, pnb), np.int32)
             prior_table[0, :pb] = self._page_table[i, :pb]
-            first, self.pool.k, self.pool.v, self._rng = \
+            first, first_lp, self.pool.k, self.pool.v, self._rng = \
                 self._prior_prefill_scatter(
                     self.params, ids, positions, lens, self._rng, temps,
                     scat, self.pool.k, self.pool.v, prior_table,
@@ -1594,7 +1662,7 @@ class ContinuousBatchingEngine:
             if is_last:
                 slot.prefill_todo = None
                 slot.pending_first = True
-                self._pending_first.append((first, [i]))
+                self._pending_first.append((first, first_lp, [i]))
                 # the final segment completes the prompt's KV — its
                 # full-page span can now enter the radix cache
                 self._radix_insert(i, slot.prompt_ids, slot.shared_tokens)
@@ -1661,7 +1729,7 @@ class ContinuousBatchingEngine:
         if self.force_tick_steps in self.tick_step_sizes():
             steps = self.force_tick_steps  # warmup rung pin, never off-ladder
         budgets = np.minimum(remaining, steps).astype(np.int32)
-        pending_slots = [i for _, idxs in pending for i in idxs
+        pending_slots = [i for _f, _lp, idxs in pending for i in idxs
                          if self.slots[i].active]
         # rows sharing THIS fused dispatch — the honest occupancy number
         # (post-tick slot counts miss requests that retire inside the tick)
@@ -1675,14 +1743,18 @@ class ContinuousBatchingEngine:
             # (e.g. a max_new_tokens=1 burst): fetch them directly instead
             # of dispatching a fully-masked scan that would stream the
             # weights steps-many times just to echo the inputs back
-            for first_dev, slot_idxs in pending:
+            for first_dev, first_lp_dev, slot_idxs in pending:
                 vals = np.asarray(first_dev)
+                lps = np.asarray(first_lp_dev)
                 for r, i in enumerate(slot_idxs):
                     if not self.slots[i].active:
                         continue
                     self.slots[i].pending_first = False
                     self._note_ttft(self.slots[i])
                     self._last_tok[i] = int(vals[r])
+                    self._lp_sum[i] = lps[r]
+                    self._lp_min[i] = lps[r]
+                    self._lp_cnt[i] = 1
                     result = self._fold_and_maybe_retire(i)
                     if result is not None:
                         self._finished_buffer.append(result)
@@ -1697,17 +1769,23 @@ class ContinuousBatchingEngine:
             tok_in = self._last_tok.copy()
             lens_in = self._lens.copy()
             halted_in = np.zeros(self.max_slots, bool)
+            lp_sum_in = self._lp_sum.copy()
+            lp_min_in = self._lp_min.copy()
+            lp_cnt_in = self._lp_cnt.copy()
         else:
-            tok_in, lens_in, halted_in = self._dev_state
-        for first_dev, slot_idxs in pending:
+            (tok_in, lens_in, halted_in,
+             lp_sum_in, lp_min_in, lp_cnt_in) = self._dev_state
+        for first_dev, first_lp_dev, slot_idxs in pending:
             idxs = np.full(first_dev.shape[0], self.max_slots, np.int32)
             idxs[: len(slot_idxs)] = slot_idxs
             new_lens = np.zeros(first_dev.shape[0], np.int32)
             new_lens[: len(slot_idxs)] = [
                 self.slots[i].length for i in slot_idxs
             ]
-            tok_in, lens_in, halted_in = self._merge_admitted(
-                tok_in, lens_in, halted_in, first_dev, new_lens, idxs
+            (tok_in, lens_in, halted_in,
+             lp_sum_in, lp_min_in, lp_cnt_in) = self._merge_admitted(
+                tok_in, lens_in, halted_in, lp_sum_in, lp_min_in, lp_cnt_in,
+                first_dev, first_lp_dev, new_lens, idxs
             )
 
         if self._spec_tick is not None:
@@ -1724,29 +1802,42 @@ class ContinuousBatchingEngine:
                     k=self.spec_k, out_w=int(steps) + self.spec_k + 1,
                 )
             spec = True
+            # the spec tick has its own accept/correct rule and samples no
+            # per-token logprobs; the accumulators thread through UNCHANGED
+            # (stale first-token seeds) and the host mirrors stay zeroed, so
+            # spec results report logprob_count == 0 — the confidence gate
+            # reads that as "no signal" and never skips verify on spec mode
+            lp_state = None
+            lp_sum_out, lp_min_out, lp_cnt_out = lp_sum_in, lp_min_in, lp_cnt_in
         else:
-            packed, tok_out, lens_out, halted_out, self.pool.k, self.pool.v, \
-                self._rng = self._step_n(
-                    self.params,
-                    tok_in,
-                    lens_in,
-                    halted_in,
-                    self._page_table.copy(),
-                    self.pool.k,
-                    self.pool.v,
-                    self._rng,
-                    self._temps.copy(),
-                    self._top_ks.copy(),
-                    budgets,
-                    steps=steps,
-                )
+            (packed, lp_state, tok_out, lens_out, halted_out,
+             lp_sum_out, lp_min_out, lp_cnt_out,
+             self.pool.k, self.pool.v, self._rng) = self._step_n(
+                self.params,
+                tok_in,
+                lens_in,
+                halted_in,
+                self._page_table.copy(),
+                self.pool.k,
+                self.pool.v,
+                self._rng,
+                self._temps.copy(),
+                self._top_ks.copy(),
+                budgets,
+                lp_sum_in,
+                lp_min_in,
+                lp_cnt_in,
+                steps=steps,
+            )
             self.total_sub_steps += steps
             spec = False
-        self._dev_state = (tok_out, lens_out, halted_out)
+        self._dev_state = (tok_out, lens_out, halted_out,
+                           lp_sum_out, lp_min_out, lp_cnt_out)
         for i, slot in enumerate(self.slots):
             if slot.active:
                 slot.inflight_steps += int(budgets[i])
         return {"packed": packed, "budgets": budgets, "spec": spec,
+                "lp_state": lp_state,
                 "pending_slots": set(pending_slots),
                 # request ids pin each lane: a slot retired at harvest time
                 # and re-admitted before THIS record is harvested must not
@@ -1763,6 +1854,12 @@ class ContinuousBatchingEngine:
         budgets = record["budgets"]
         packed = np.asarray(record["packed"])
         spec = record.get("spec", False)
+        # the tick's final logprob accumulators ([3, B]: sum / min / count),
+        # one fetch riding the same dispatch as the packed tokens; refreshed
+        # into the host mirrors so a retire inside this harvest reports the
+        # request's full-trajectory confidence signal
+        lp_state = record.get("lp_state")
+        lp_rows = np.asarray(lp_state) if lp_state is not None else None
         finished: list[PagedResult] = []
         for i, slot in enumerate(self.slots):
             if not slot.active or slot.request_id != record["rids"][i]:
@@ -1772,6 +1869,10 @@ class ContinuousBatchingEngine:
                 slot.inflight_steps = max(slot.inflight_steps - consumed, 0)
             else:
                 continue
+            if lp_rows is not None:
+                self._lp_sum[i] = lp_rows[0, i]
+                self._lp_min[i] = lp_rows[1, i]
+                self._lp_cnt[i] = int(lp_rows[2, i])
             if slot.pending_first and i in record["pending_slots"]:
                 slot.pending_first = False
                 self._note_ttft(slot)
@@ -1842,6 +1943,9 @@ class ContinuousBatchingEngine:
             finish_reason=reason,
             prefill_tokens=slot.prompt_tokens - slot.shared_tokens,
             prefix_hit_tokens=slot.shared_tokens,
+            logprob_sum=float(self._lp_sum[i]),
+            logprob_min=float(self._lp_min[i]),
+            logprob_count=int(self._lp_cnt[i]),
         )
         if slot.donated:
             donated = set(slot.donated)
@@ -1865,6 +1969,9 @@ class ContinuousBatchingEngine:
         self._temps[i] = 0.0
         self._top_ks[i] = 0
         self._last_tok[i] = 0
+        self._lp_sum[i] = 0.0
+        self._lp_min[i] = 0.0
+        self._lp_cnt[i] = 0
         return result
 
     # ---------------------------------------------------------------- stats

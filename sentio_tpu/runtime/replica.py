@@ -800,6 +800,7 @@ class ReplicaSet:
         top_k: int = 0,
         tenant: Optional[str] = None,
         priority: str = PRIORITY_INTERACTIVE,
+        stats_out: Optional[dict] = None,
     ) -> Iterator[str]:
         toks = self._route_tokens(prompt)
         idx, _hit = self._route(toks)
@@ -808,10 +809,15 @@ class ReplicaSet:
             timeout_s=timeout_s, request_id=request_id,
             deadline_s=deadline_s, deadline_ts=deadline_ts, top_k=top_k,
             # WFQ handoff metadata (see generate): streams charge at first
-            # next(), so the RAW tenant key is stamped — an
-            # overflow-bucketed tenant simply skips the recharge
+            # next(), so the ticket is stamped provisionally with the raw
+            # key here and RE-STAMPED with the charged (possibly overflow-
+            # bucketed) key inside _stream_impl once admit() resolves it —
+            # a quarantine-handoff recharge looks the ticket's key up in
+            # the fair queue, and the raw key of a bucketed tenant is
+            # unknown there (the PR 10 recharge gap)
             tenant=tenant or DEFAULT_TENANT, priority=priority,
             cost_tokens=len(toks) + max_new_tokens,
+            stats_out=stats_out,
         )
         # the replica's own generate_stream runs its CALL-time validation
         # (top_k vs paged speculation) here, before any SSE 200 commits;
@@ -831,6 +837,16 @@ class ReplicaSet:
         tried = {idx}
         while True:
             charged = self.tenants.admit(tenant, cost, priority=priority)
+            if kwargs.get("tenant") != charged:
+                # the reservation landed under a DIFFERENT key than the one
+                # stamped at call time (overflow bucketing): re-create the
+                # not-yet-started inner iterator with the charged key, so a
+                # quarantine inbox handoff can recharge the reservation it
+                # actually holds instead of silently skipping it. The
+                # discarded iterator never ran (generator bodies defer to
+                # first next()), so no ticket or admission leaks.
+                kwargs["tenant"] = charged
+                inner = svc.generate_stream(prompt, **kwargs)
             delivered = False
             try:
                 for piece in inner:

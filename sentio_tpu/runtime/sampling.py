@@ -20,14 +20,22 @@ def sample_tokens(
     temperature: Array | float = 0.0,
     top_k: Array | int = 0,
     top_p: float = 1.0,
-) -> Array:
-    """[B, V] → [B] int32. ``temperature`` may be a traced scalar or a [B]
-    vector (continuous batching mixes generator/verifier rows at different
-    temperatures); 0 = greedy. ``top_k`` may be a static Python int (0 =
-    off, compiled in) or a TRACED int32 scalar / [B] vector — the serving
-    engines pass it traced so per-request values share ONE compiled program
-    instead of recompiling the decode loop per distinct k; <= 0 disables
-    per row. top_p is static (compiled in)."""
+) -> tuple[Array, Array]:
+    """[B, V] → ([B] int32 tokens, [B] float32 logprobs). ``temperature``
+    may be a traced scalar or a [B] vector (continuous batching mixes
+    generator/verifier rows at different temperatures); 0 = greedy.
+    ``top_k`` may be a static Python int (0 = off, compiled in) or a TRACED
+    int32 scalar / [B] vector — the serving engines pass it traced so
+    per-request values share ONE compiled program instead of recompiling
+    the decode loop per distinct k; <= 0 disables per row. top_p is static
+    (compiled in).
+
+    The returned logprob is the chosen token's log-probability under the
+    UNMODIFIED model distribution (float32 log-softmax of the raw logits,
+    before temperature scaling or top-k/top-p filtering) — a sampling-
+    hyperparameter-independent confidence signal the verify gate
+    (ops/confidence.py) consumes. Callers that only need tokens discard
+    the second element; XLA dead-code-eliminates the log-softmax then."""
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
@@ -70,4 +78,10 @@ def sample_tokens(
         scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
 
     sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
-    return jnp.where(jnp.broadcast_to(temp, greedy.shape) <= 0.0, greedy, sampled)
+    chosen = jnp.where(
+        jnp.broadcast_to(temp, greedy.shape) <= 0.0, greedy, sampled
+    )
+    logprobs = jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), chosen[:, None], axis=-1
+    )[:, 0]
+    return chosen, logprobs

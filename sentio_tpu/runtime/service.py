@@ -321,6 +321,7 @@ class PagedGenerationService:
         tenant: Optional[str] = None,
         priority: Optional[str] = None,
         cost_tokens: int = 0,
+        stats_out: Optional[dict] = None,
     ) -> Iterator[str]:
         """Streaming variant: yields decoded text increments as the shared
         decode batch produces them (chunks of up to steps_per_tick tokens —
@@ -328,7 +329,13 @@ class PagedGenerationService:
         monopolizing a contiguous-cache engine). UTF-8 safe: bytes buffer
         until they decode cleanly. Deadline semantics match
         :meth:`generate`; a deadline that passes mid-stream raises
-        :class:`DeadlineExceededError` from the iterator."""
+        :class:`DeadlineExceededError` from the iterator.
+
+        ``stats_out``: optional caller-owned dict filled with the finished
+        request's logprob accumulators (logprob_mean/min/count, tokens)
+        right before the final yield — a text iterator cannot return the
+        PagedResult, and the confidence gate needs the numbers after the
+        stream drains."""
         # validated HERE, not in the generator body: a generator function
         # defers its body to the first next(), which would surface this
         # after an SSE handler already committed its 200
@@ -336,6 +343,7 @@ class PagedGenerationService:
         return self._generate_stream_impl(
             prompt, max_new_tokens, temperature, timeout_s, request_id,
             deadline_s, deadline_ts, top_k, tenant, priority, cost_tokens,
+            stats_out,
         )
 
     def _generate_stream_impl(
@@ -351,6 +359,7 @@ class PagedGenerationService:
         tenant: Optional[str] = None,
         priority: Optional[str] = None,
         cost_tokens: int = 0,
+        stats_out: Optional[dict] = None,
     ) -> Iterator[str]:
         # NB: admission below is still deferred to the first next() (the
         # long-standing stream contract — SSE handlers pre-check via
@@ -411,6 +420,10 @@ class PagedGenerationService:
                             details={"replica": self.replica_id},
                         )
                     emitted = list(result.tokens)  # authoritative final sequence
+                    if stats_out is not None:
+                        # filled BEFORE the final yield so the consumer sees
+                        # the numbers as soon as the iterator is exhausted
+                        stats_out.update(result.stats_dict())
                 text = tokenizer.decode(emitted)
                 if kind == "done":
                     # final flush is unconditional: the finished answer may

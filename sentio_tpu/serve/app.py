@@ -441,7 +441,11 @@ async def _chat_stream(request: web.Request, container: DependencyContainer, req
         # pipeline + degradation live in the handler, mirroring /chat; the
         # handler yields typed events — ("sources", [...]) before the first
         # token, ("token", str) increments, ("verdict", {...}) after the
-        # stream (full graph-stage parity: select + verify ride the stream)
+        # stream (full graph-stage parity: select + verify ride the stream).
+        # With VERIFY_MODE=async|gated the handler yields ("done", "")
+        # itself as soon as the answer completes, then a trailing
+        # ("verify", {...}) verdict — the internal ("eos", "") sentinel
+        # (never written to the wire) marks producer exhaustion either way.
         for kind, payload in container.chat_handler.stream_chat_sync(
             question=req.question,
             top_k=req.top_k,
@@ -454,7 +458,7 @@ async def _chat_stream(request: web.Request, container: DependencyContainer, req
         ):
             if not put((kind, payload)):
                 return
-        put(("done", ""))
+        put(("eos", ""))
 
     task = loop.run_in_executor(None, produce)
     # SSE liveness: while the producer is silent (long prefill, a slow —
@@ -462,6 +466,7 @@ async def _chat_stream(request: web.Request, container: DependencyContainer, req
     # distinguish "still working" from a dead connection and apply its own
     # timeout policy. Comments are invisible to EventSource consumers.
     keepalive_s = getattr(container.settings.serve, "sse_keepalive_s", 0.0)
+    wrote_done = False
     try:
         while True:
             try:
@@ -474,7 +479,15 @@ async def _chat_stream(request: web.Request, container: DependencyContainer, req
                 await response.write(b": keepalive\n\n")
                 continue
             if kind == "done":
+                # answer complete; the connection STAYS OPEN when a
+                # trailing async-verify verdict is still coming (the
+                # keepalive loop above bridges the audit decode)
                 await response.write(b"data: [DONE]\n\n")
+                wrote_done = True
+                continue
+            if kind == "eos":
+                if not wrote_done:
+                    await response.write(b"data: [DONE]\n\n")
                 break
             await response.write(f"data: {json.dumps({kind: payload})}\n\n".encode())
     finally:

@@ -109,7 +109,18 @@ class ChatHandler:
             }
             if state.get("evaluation"):
                 result["metadata"]["evaluation"] = state["evaluation"]
-            cache.set_query_response(question, result)
+            # NB: with VERIFY_MODE=async (or gated, below threshold) the
+            # executor-stamped metadata.verify_pending flag rides meta_out
+            # into the LIVE response — the answer ships NOW and the verdict
+            # is fetchable at /debug/flight/{query_id} once it lands. The
+            # CACHED copy must drop it: a cache replay serves a different
+            # query_id with no detached verify behind it, so a baked-in
+            # pending flag would promise a verdict that can never arrive.
+            cache.set_query_response(question, {
+                **result,
+                "metadata": {k: v for k, v in result["metadata"].items()
+                             if k != "verify_pending"},
+            })
             disk_cache, _ = self.fallback
             disk_cache.put(question, answer)
             recorder.finish_request(
@@ -208,6 +219,11 @@ class ChatHandler:
                    if deadline_ts is not None else {}),
             )
         timings: dict[str, float] = {}
+        # set once the ANSWER's flight record has been finished (async/gated
+        # close it at [DONE] time): the disconnect/degrade handlers below
+        # must not re-finish it — that would clobber the answer-latency
+        # 'done' record with an audit-inclusive 'disconnected'/'degraded'
+        record_closed = False
         try:
             t = time.perf_counter()
             docs = self.container.retriever.retrieve(
@@ -231,11 +247,12 @@ class ChatHandler:
                  "score": d.score()} for d in selected
             ])
             chunks: list[str] = []
+            gen_stats: dict = {}
             t = time.perf_counter()
             for piece in self.container.generator.stream(
                 question, selected, mode=mode, temperature=temperature,
                 request_id=request_id, deadline_ts=deadline_ts,
-                tenant=tenant, priority=priority,
+                tenant=tenant, priority=priority, stats=gen_stats,
             ):
                 chunks.append(piece)
                 yield ("token", piece)
@@ -247,13 +264,83 @@ class ChatHandler:
             # with the caller's deadline so the pump can cancel it
             deadline_ok = (deadline_ts is None
                            or time.perf_counter() < deadline_ts)
+            verify_mode = self.settings.generator.verify_mode
             if verifier is not None and answer and deadline_ok:
-                t = time.perf_counter()
-                result = verifier.verify(question, answer, selected,
-                                         request_id=request_id,
-                                         deadline_ts=deadline_ts)
-                timings["verify"] = round((time.perf_counter() - t) * 1e3, 3)
-                yield ("verdict", result.to_dict())
+                from sentio_tpu.graph.nodes import _record_verify
+                from sentio_tpu.ops.confidence import confidence_score
+
+                conf = None
+                confident = False
+                if verify_mode == "gated":
+                    conf = confidence_score(
+                        gen_stats.get("logprob_mean"),
+                        gen_stats.get("logprob_min"), selected,
+                    )
+                    threshold = (
+                        self.settings.generator.verify_confidence_threshold
+                    )
+                    confident = conf is not None and conf >= threshold
+                if confident:
+                    # gate pays off: typed skipped verdict, zero audit
+                    # decode — same verdict shape as the graph gate node
+                    from sentio_tpu.graph.nodes import (
+                        confidence_skip_evaluation,
+                    )
+
+                    _record_verify(request_id, "gated", "skipped_confident",
+                                   confidence=conf, skipped="confident")
+                    yield ("verdict", confidence_skip_evaluation(conf))
+                elif verify_mode in ("async", "gated"):
+                    # answer first: the client gets [DONE] NOW and the
+                    # flight record closes at ANSWER latency; the audit
+                    # decodes while the connection idles (keepalives keep
+                    # it warm) and the verdict trails as a `verify` event
+                    yield ("done", "")
+                    if request_id:
+                        recorder.add_node_timings(request_id, timings)
+                        recorder.finish_request(
+                            request_id, status="done",
+                            latency_ms=round(
+                                (time.perf_counter() - t0) * 1e3, 1),
+                        )
+                    record_closed = True
+                    # past this point the answer is DELIVERED and its
+                    # record closed: a trailing-audit failure must degrade
+                    # to a warn verdict, never to the apology ladder (which
+                    # would append prose after [DONE]) and never touch the
+                    # finished record (the verifier itself soft-fails to
+                    # warn; this guards the telemetry around it too)
+                    try:
+                        t = time.perf_counter()
+                        result = verifier.verify(question, answer, selected,
+                                                 request_id=request_id,
+                                                 deadline_ts=deadline_ts)
+                        verdict_ms = round((time.perf_counter() - t) * 1e3, 3)
+                        if request_id:
+                            recorder.add_node_timings(
+                                request_id, {"verify": verdict_ms})
+                        _record_verify(request_id, verify_mode,
+                                       result.verdict, confidence=conf,
+                                       verdict_ms=verdict_ms)
+                        trailing = result.to_dict()
+                    except Exception as exc:  # noqa: BLE001
+                        logger.warning("trailing verify failed (%s)", exc)
+                        trailing = {"verdict": "warn", "citations_ok": True,
+                                    "notes": [f"verify failed: {exc}"]}
+                    if conf is not None:
+                        trailing["confidence"] = round(conf, 4)
+                    yield ("verify", trailing)
+                    return
+                else:
+                    t = time.perf_counter()
+                    result = verifier.verify(question, answer, selected,
+                                             request_id=request_id,
+                                             deadline_ts=deadline_ts)
+                    verdict_ms = round((time.perf_counter() - t) * 1e3, 3)
+                    timings["verify"] = verdict_ms
+                    _record_verify(request_id, "sync", result.verdict,
+                                   verdict_ms=verdict_ms)
+                    yield ("verdict", result.to_dict())
             if request_id:
                 recorder.add_node_timings(request_id, timings)
                 recorder.finish_request(
@@ -264,8 +351,12 @@ class ChatHandler:
             # client disconnected mid-stream and the SSE pump closed this
             # generator — close the flight record (it would otherwise sit
             # status='active' until LRU eviction, making disconnect-heavy
-            # traffic look like a pile of stuck requests in /debug/flight)
-            if request_id:
+            # traffic look like a pile of stuck requests in /debug/flight).
+            # A disconnect AFTER the answer finished (e.g. an async-mode
+            # client that closes on [DONE] while the trailing verdict is
+            # still decoding) keeps the 'done' record: the answer WAS
+            # delivered at the recorded latency.
+            if request_id and not record_closed:
                 recorder.add_node_timings(request_id, timings)
                 recorder.finish_request(
                     request_id, status="disconnected",
@@ -273,6 +364,11 @@ class ChatHandler:
                 )
             raise
         except Exception as exc:  # noqa: BLE001 — ladder, never a raw error
+            if record_closed:
+                # answer already delivered and its record closed: nothing
+                # left to degrade — surface nothing after [DONE]
+                logger.warning("post-answer stream stage failed (%s)", exc)
+                return
             if getattr(exc, "soft_fail_exempt", False):
                 # shed / expired mid-stream: the SSE status is already on
                 # the wire, so no 429/503 — but appending an apology after

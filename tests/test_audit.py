@@ -95,6 +95,61 @@ class TestCommittedManifestGate:
         assert set(fams["paged.step_n@int8"]["variants"]) \
             == set(fams["paged.step_n"]["variants"])
 
+    def test_logprob_plumbing_adds_no_variant_axes(self, audit_result,
+                                                   manifest):
+        """The confidence gate's logprob accumulators ride the sampling
+        dispatches as TRACED [S] data (runtime/sampling.py returning the
+        chosen token's logprob, step_n's carried sum/min/count): the
+        variant axes of every sampling family must stay exactly the
+        declared bucket sets — steps ladder for step_n, width x rows
+        (x pnb x do_sample) for the prefill scatters — in BOTH the fresh
+        report and the committed manifest. A logprob knob that became a
+        static arg would show up here as a new axis name."""
+        allowed = {
+            "paged.step_n": {"steps"},
+            "paged.step_n@int8": {"steps"},
+            "paged.prefill_scatter": {"width", "rows"},
+            "paged.prefill_scatter@int8": {"width", "rows"},
+            "paged.prior_prefill_scatter": {"width", "rows", "pnb",
+                                            "do_sample"},
+            "paged.prior_prefill_scatter@int8": {"width", "rows", "pnb",
+                                                 "do_sample"},
+            "paged.merge_admitted": {"rows"},
+        }
+        for source, where in ((audit_result.report, "report"),
+                              (manifest, "manifest")):
+            fams = source["families"]
+            for name, axes in allowed.items():
+                for vkey in fams[name]["variants"]:
+                    seen = {part.split("=", 1)[0]
+                            for part in vkey.split("|")}
+                    assert seen <= axes, (
+                        f"{where}: {name} variant {vkey!r} carries an axis "
+                        f"outside the declared set {sorted(axes)}")
+            # step_n's ladder must be the 3-4 rung set, not a fresh
+            # program per logprob state
+            assert fams["paged.step_n"]["variant_count"] <= 4
+
+    def test_logprob_plumbing_drops_no_donated_pool_leaf(self, audit_result):
+        """Growing step_n/prefill_scatter's outputs (packed logprob state,
+        first-token logprobs) must not break donation: every declared
+        donated pool leaf still aliases an output — bf16 (2 leaves per
+        pool pair) and int8 ({'q','s'} pytree: 4 leaves) both."""
+        fams = audit_result.report["families"]
+        expect_leaves = {
+            "paged.step_n": 2, "paged.prefill_scatter": 2,
+            "paged.prior_prefill_scatter": 2,
+            "paged.step_n@int8": 4, "paged.prefill_scatter@int8": 4,
+            "paged.prior_prefill_scatter@int8": 4,
+        }
+        for name, leaves in expect_leaves.items():
+            for vkey, variant in fams[name]["variants"].items():
+                assert variant["donated_leaves"] == leaves, (name, vkey, variant)
+                assert variant["aliased"] >= leaves, (
+                    f"{name}[{vkey}] aliases {variant['aliased']} of "
+                    f"{leaves} donated pool leaves — the logprob output "
+                    f"change broke in-place pool updates")
+
     def test_quantized_pool_footprint_at_most_0_6x(self, audit_result,
                                                    manifest):
         """The footprint claim, gated twice: the fresh report AND the

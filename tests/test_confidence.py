@@ -1,0 +1,302 @@
+"""Confidence-gated verification (ISSUE 11): the score (ops/confidence.py),
+the gate node + router (graph/nodes.py), the detached-node executor leg
+(graph/executor.py), and the async verify_pending surface — everything the
+serve-level acceptance tests (test_serve.py::TestConfidenceGatedVerify)
+assume, tested in isolation without an engine."""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from sentio_tpu.config import GeneratorConfig, Settings
+from sentio_tpu.models.document import Document
+from sentio_tpu.ops.confidence import confidence_score, retrieval_support
+
+
+def _doc(score: float, text: str = "doc") -> Document:
+    return Document(text=text, metadata={"score": score})
+
+
+class TestConfidenceScore:
+    def test_no_logprob_signal_is_none_never_a_number(self):
+        # absence of evidence is not confidence: the gate must verify
+        assert confidence_score(None, None, [_doc(1.0)]) is None
+
+    def test_confident_decode_with_separated_source_clears_default(self):
+        # near-certain tokens (mean prob ~0.98, worst ~0.95) + a dominant
+        # top document: above the committed default threshold of 0.75
+        conf = confidence_score(-0.02, -0.05, [_doc(0.9), _doc(0.1)])
+        assert conf is not None
+        assert conf > GeneratorConfig().verify_confidence_threshold
+
+    def test_uncertain_decode_scores_low(self):
+        # near-uniform token probability (random-init decodes): tiny score
+        conf = confidence_score(-5.0, -8.0, [_doc(0.9), _doc(0.1)])
+        assert conf is not None and conf < 0.3
+
+    def test_bad_worst_token_drags_an_otherwise_confident_answer(self):
+        good = confidence_score(-0.02, -0.05, [_doc(0.9), _doc(0.1)])
+        spiky = confidence_score(-0.02, -6.0, [_doc(0.9), _doc(0.1)])
+        assert spiky < good
+
+    def test_score_clamped_to_unit_interval(self):
+        assert 0.0 <= confidence_score(0.0, 0.0, [_doc(1.0)]) <= 1.0
+        assert 0.0 <= confidence_score(-100.0, -100.0, []) <= 1.0
+
+    def test_retrieval_support_shapes(self):
+        assert retrieval_support([]) == 0.0
+        assert retrieval_support([_doc(0.5)]) == 0.5
+        dominant = retrieval_support([_doc(1.0), _doc(0.05)])
+        flat = retrieval_support([_doc(0.5), _doc(0.5)])
+        assert dominant > 0.9
+        assert abs(flat - 0.5) < 1e-6
+        assert retrieval_support([_doc(0.0), _doc(0.0)]) == 0.0
+
+
+class TestGateNode:
+    def _settings(self, threshold: float) -> Settings:
+        s = Settings()
+        s.generator.verify_confidence_threshold = threshold
+        return s
+
+    def _state(self, logprob_mean=-0.02, logprob_min=-0.05):
+        meta = {"query_id": "gate-test"}
+        if logprob_mean is not None:
+            meta["logprob_mean"] = logprob_mean
+            meta["logprob_min"] = logprob_min
+        return {
+            "query": "q", "response": "an answer",
+            "selected_documents": [_doc(0.9), _doc(0.1)],
+            "metadata": meta,
+        }
+
+    def test_confident_answer_short_circuits_with_typed_verdict(self):
+        from sentio_tpu.graph.nodes import (
+            confidence_gate_router,
+            create_confidence_gate_node,
+        )
+
+        gate = create_confidence_gate_node(self._settings(0.1))
+        update = gate(self._state())
+        assert update["evaluation"]["verdict"] == "skipped_confident"
+        assert update["metadata"]["verify_skipped"] == "confident"
+        merged = dict(self._state())
+        merged["metadata"] = {**merged["metadata"], **update["metadata"]}
+        from sentio_tpu.graph.executor import END
+
+        assert confidence_gate_router(merged) == END
+
+    def test_below_threshold_routes_to_verify(self):
+        from sentio_tpu.graph.nodes import (
+            confidence_gate_router,
+            create_confidence_gate_node,
+        )
+
+        gate = create_confidence_gate_node(self._settings(1.1))
+        update = gate(self._state())
+        assert "evaluation" not in update
+        assert update["metadata"]["verify_confidence"] is not None
+        assert confidence_gate_router(self._state()) == "verify"
+
+    def test_no_logprobs_never_skips(self):
+        from sentio_tpu.graph.nodes import create_confidence_gate_node
+
+        gate = create_confidence_gate_node(self._settings(0.0))
+        update = gate(self._state(logprob_mean=None))
+        assert "evaluation" not in update
+        assert update["metadata"]["verify_confidence"] is None
+
+
+class TestDetachedExecutor:
+    def test_detached_node_runs_off_path_and_joins(self):
+        from sentio_tpu.graph.executor import END, GraphBuilder, wait_detached
+
+        release = threading.Event()
+        ran: list[str] = []
+
+        async def slow_audit(state):
+            release.wait(timeout=10.0)
+            ran.append(state["query"])
+            return {"evaluation": {"verdict": "pass"}}  # discarded
+
+        def fast_head(state):
+            return {"response": "answer"}
+
+        graph = (
+            GraphBuilder()
+            .add_node("head", fast_head)
+            .add_node("audit", slow_audit, detached=True)
+            .add_edge("head", "audit")
+            .add_edge("audit", END)
+            .set_entry("head")
+            .compile()
+        )
+        t0 = time.perf_counter()
+        state = graph.invoke({"query": "q", "metadata": {}})
+        returned_ms = (time.perf_counter() - t0) * 1e3
+        # the graph returned WITHOUT waiting for the held audit ...
+        assert returned_ms < 5_000
+        assert state["metadata"]["audit_pending"] is True
+        # ... the detached node's update was NOT merged ...
+        assert not state.get("evaluation")
+        assert ran == []
+        # ... and joins once released
+        release.set()
+        assert wait_detached(timeout_s=10.0)
+        assert ran == ["q"]
+
+    def test_detached_failure_is_contained(self):
+        from sentio_tpu.graph.executor import END, GraphBuilder, wait_detached
+
+        async def boom(state):
+            raise RuntimeError("detached audit exploded")
+
+        graph = (
+            GraphBuilder()
+            .add_node("head", lambda s: {"response": "x"})
+            .add_node("audit", boom, detached=True)
+            .add_edge("head", "audit")
+            .add_edge("audit", END)
+            .set_entry("head")
+            .compile()
+        )
+        state = graph.invoke({"query": "q", "metadata": {}})
+        assert state["response"] == "x"
+        assert wait_detached(timeout_s=10.0)
+
+
+class TestPagedLogprobSurfacing:
+    """Leg 1 of the tentpole: the paged engine's fused decode scan carries
+    per-slot logprob accumulators and every PagedResult reports them."""
+
+    def test_run_all_carries_logprob_accumulators(self):
+        from sentio_tpu.models.llama import LlamaConfig
+        from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+
+        eng = ContinuousBatchingEngine(
+            model_config=LlamaConfig.tiny(), max_slots=2, page_size=16,
+            max_pages_per_seq=4, steps_per_tick=4,
+        )
+        results = eng.run_all(
+            ["logprob surfacing probe", "second logprob probe"],
+            max_new_tokens=6, temperature=0.0,
+        )
+        for r in results:
+            # every sampled token contributes one observation: the emitted
+            # tokens plus the EOS sample when the request stopped on EOS
+            expected = len(r.tokens) + (1 if r.finish_reason == "stop" else 0)
+            assert r.logprob_count == expected, r
+            assert r.logprob_count >= 1
+            # log-probabilities: all non-positive, min bounds the mean,
+            # the sum of non-positives cannot exceed the worst single one
+            assert r.logprob_min <= 0.0
+            assert r.logprob_min <= r.logprob_mean <= 0.0
+            assert r.logprob_sum <= r.logprob_min + 1e-6
+            # a byte-vocab softmax cannot be flat-zero: the signal is real
+            assert r.logprob_mean < -1e-6
+
+    def test_pipelined_ticks_report_same_accumulators(self):
+        """pipeline_depth=2 harvests a tick late — the lp fetch must come
+        from the SAME record as the folded tokens, so depth 1 and depth 2
+        greedy runs agree exactly."""
+        from sentio_tpu.models.llama import LlamaConfig
+        from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+
+        cfg = LlamaConfig.tiny()
+        d1 = ContinuousBatchingEngine(
+            model_config=cfg, max_slots=2, page_size=16,
+            max_pages_per_seq=4, steps_per_tick=4, pipeline_depth=1,
+        )
+        d2 = ContinuousBatchingEngine(
+            model_config=cfg, params=d1.params, tokenizer=d1.tokenizer,
+            max_slots=2, page_size=16, max_pages_per_seq=4,
+            steps_per_tick=4, pipeline_depth=2,
+        )
+        (r1,) = d1.run_all(["pipelined logprob parity"], max_new_tokens=8)
+        (r2,) = d2.run_all(["pipelined logprob parity"], max_new_tokens=8)
+        assert r1.tokens == r2.tokens
+        assert r1.logprob_count == r2.logprob_count
+        assert abs(r1.logprob_sum - r2.logprob_sum) < 1e-4
+        assert abs(r1.logprob_min - r2.logprob_min) < 1e-5
+
+
+class TestGraphWiring:
+    class _FakeRetriever:
+        name = "fake"
+
+        async def aretrieve(self, query, top_k=10):
+            return [_doc(0.9, "alpha"), _doc(0.1, "beta")]
+
+    class _FakeVerifier:
+        def __init__(self):
+            self.calls = []
+
+        def verify(self, query, answer, documents, **kwargs):
+            from sentio_tpu.ops.verifier import VerifyResult
+
+            self.calls.append(answer)
+            return VerifyResult(verdict="pass")
+
+    def _generator(self):
+        from sentio_tpu.ops.generator import LLMGenerator
+
+        return LLMGenerator()
+
+    def _settings(self, mode: str, threshold: float = 0.75) -> Settings:
+        s = Settings()
+        s.generator.verify_mode = mode
+        s.generator.verify_confidence_threshold = threshold
+        return s
+
+    def _build(self, mode: str, verifier, threshold: float = 0.75):
+        from sentio_tpu.graph.factory import GraphConfig, build_basic_graph
+
+        settings = self._settings(mode, threshold)
+        return build_basic_graph(
+            self._FakeRetriever(), self._generator(), reranker=None,
+            verifier=verifier,
+            config=GraphConfig(use_reranker=False, settings=settings),
+        )
+
+    def test_invalid_mode_rejected(self):
+        with pytest.raises(ValueError, match="verify_mode"):
+            self._build("yolo", self._FakeVerifier())
+
+    def test_gated_graph_has_gate_and_detached_verify(self):
+        graph = self._build("gated", self._FakeVerifier())
+        assert "verify_gate" in graph.nodes
+        assert graph.nodes["verify"].detached is True
+        sync = self._build("sync", self._FakeVerifier())
+        assert "verify_gate" not in sync.nodes
+        assert sync.nodes["verify"].detached is False
+
+    def test_async_mode_returns_with_verify_pending_then_verdict_lands(self):
+        from sentio_tpu.graph.executor import wait_detached
+        from sentio_tpu.graph.state import create_initial_state
+        from sentio_tpu.infra.flight import FlightRecorder, set_flight_recorder
+
+        recorder = FlightRecorder()
+        set_flight_recorder(recorder)
+        try:
+            verifier = self._FakeVerifier()
+            graph = self._build("async", verifier)
+            state = graph.invoke(create_initial_state(
+                "what is alpha?",
+                metadata={"mode": "fast", "query_id": "asyncv1"},
+            ))
+            assert state["metadata"]["verify_pending"] is True
+            # answer returned before (or without) the audit's merge
+            assert state.get("response")
+            assert not state.get("evaluation")
+            assert wait_detached(timeout_s=30.0)
+            assert verifier.calls, "detached verify never ran"
+            record = recorder.get("asyncv1")
+            assert record["verify"]["outcome"] == "pass"
+            assert record["verify"]["mode"] == "async"
+            assert record["verify"]["verdict_ms"] >= 0.0
+        finally:
+            set_flight_recorder(None)

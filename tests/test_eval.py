@@ -223,3 +223,59 @@ class TestQuantQualityGate:
         assert i8_chars >= gate["answer_chars_min_ratio"] * bf_chars, (
             f"int8 mean answer length {i8_chars} vs bf16 {bf_chars} "
             f"(gate ratio {gate['answer_chars_min_ratio']})")
+
+
+class TestVerifyGate:
+    """VERIFY_MODE=gated quality gate: a gated full-graph eval run is
+    measured against an always-verify (sync) run over the same bundle in
+    the same process, and per-query FINAL verdicts (async verdicts awaited
+    off the flight record) are gated by the COMMITTED tolerances in
+    eval/verify_gate.json — a confidence-calibration regression that skips
+    audits which would have warned/failed drops agreement and fails tier-1
+    here instead of shipping silently."""
+
+    GATE_ARGS = dict(
+        scale="tiny", n_docs=48, n_queries=4, concurrency=2,
+        new_tokens=8, verifier_tokens=4, skip_baseline=True,
+        configs={"full_paged"},
+    )
+
+    def test_gated_verdicts_agree_with_always_verify(self):
+        import json
+        from pathlib import Path
+
+        gate_path = (Path(__file__).resolve().parents[1] / "sentio_tpu"
+                     / "eval" / "verify_gate.json")
+        gate = json.loads(gate_path.read_text())
+
+        sync = run_eval(**self.GATE_ARGS, verify_mode="sync")
+        gated = run_eval(**self.GATE_ARGS, verify_mode="gated")
+        (sync_row,) = sync["rows"]
+        (gated_row,) = gated["rows"]
+        assert gated["verify_mode"] == "gated"
+        assert gated_row.get("errors", 0) <= gate["errors_max"], gated_row
+
+        sync_v = sync_row.get("verdicts") or {}
+        gated_v = gated_row.get("verdicts") or {}
+        assert sync_v and gated_v, (
+            f"both runs must record per-query verdicts: {sync_row} "
+            f"vs {gated_row}")
+        common = set(sync_v) & set(gated_v)
+        assert common, (sync_v, gated_v)
+        # a skipped audit asserts the answer would have PASSED — count it
+        # as agreement only against a sync pass
+        agree = sum(
+            1 for q in common
+            if gated_v[q] == sync_v[q]
+            or (gated_v[q] == "skipped_confident" and sync_v[q] == "pass")
+        )
+        agreement = agree / len(common)
+        assert agreement >= gate["min_verdict_agreement"], (
+            f"gated-vs-sync verdict agreement {agreement:.3f} below the "
+            f"committed gate {gate['min_verdict_agreement']}: "
+            f"{gated_v} vs {sync_v}")
+        skip_rate = gated_row.get("verify_skip_rate", 0.0)
+        assert skip_rate <= gate["max_skip_rate"], (
+            f"gated skip rate {skip_rate} exceeds the committed ceiling "
+            f"{gate['max_skip_rate']} — the confidence score is calling "
+            f"random-init decodes confident")

@@ -74,9 +74,9 @@ class TestSampling:
 
         logits = jnp.asarray([[1.0, 5.0, 2.0]])
         rng = jax.random.PRNGKey(0)
-        assert int(sample_tokens(logits, rng, 0.0)[0]) == 1
+        assert int(sample_tokens(logits, rng, 0.0)[0][0]) == 1
         # top_k=1 forces argmax even at high temperature
-        assert int(sample_tokens(logits, rng, 10.0, top_k=1)[0]) == 1
+        assert int(sample_tokens(logits, rng, 10.0, top_k=1)[0][0]) == 1
 
     def test_top_p_restricts_support(self):
         import jax
@@ -86,10 +86,30 @@ class TestSampling:
 
         logits = jnp.asarray([[10.0, 0.0, -10.0, -10.0]])
         picks = {
-            int(sample_tokens(logits, jax.random.PRNGKey(i), 2.0, top_p=0.5)[0])
+            int(sample_tokens(logits, jax.random.PRNGKey(i), 2.0, top_p=0.5)[0][0])
             for i in range(20)
         }
         assert picks == {0}
+
+    def test_logprob_is_chosen_tokens_raw_log_softmax(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from sentio_tpu.runtime.sampling import sample_tokens
+
+        logits = jnp.asarray([[1.0, 5.0, 2.0]])
+        rng = jax.random.PRNGKey(0)
+        tok, lp = sample_tokens(logits, rng, 0.0)
+        expect = jax.nn.log_softmax(logits, axis=-1)[0, int(tok[0])]
+        assert np.isclose(float(lp[0]), float(expect), atol=1e-6)
+        assert float(lp[0]) < 0.0
+        # the logprob reports the UNSCALED distribution: high temperature
+        # with top_k=1 still picks argmax, and the logprob must match the
+        # raw log-softmax, not the temperature-flattened one
+        tok_t, lp_t = sample_tokens(logits, rng, 10.0, top_k=1)
+        assert int(tok_t[0]) == int(tok[0])
+        assert np.isclose(float(lp_t[0]), float(expect), atol=1e-6)
 
 
 class TestPrompts:

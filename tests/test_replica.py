@@ -648,6 +648,52 @@ class TestStallTolerance:
         # unknown / already-released tenants are a no-op, never a crash
         q.recharge("ghost", 10)
 
+    def test_stream_ticket_stamps_bucketed_tenant_key(self, monkeypatch):
+        """The PR 10 recharge gap: stream tickets used to stamp the RAW
+        tenant key, so a quarantine-handoff recharge of an overflow-
+        bucketed stream tenant looked up a key the fair queue had never
+        registered and silently skipped the re-charge. The ticket must
+        carry the CHARGED key admit() actually resolved."""
+        monkeypatch.setattr(TenantFairQueue, "MAX_TRACKED", 1)
+        e0 = _engine()
+        svc = PagedGenerationService(e0)
+        rs = ReplicaSet([svc], supervise=False)
+        try:
+            # fill the (shrunken) tenant table so the next fresh key buckets
+            rs.generate("seed tenant table", max_new_tokens=2,
+                        tenant="first", timeout_s=180)
+            stamped = []
+            orig = svc.generate_stream
+
+            def spy(prompt, **kwargs):
+                stamped.append(kwargs.get("tenant"))
+                return orig(prompt, **kwargs)
+
+            monkeypatch.setattr(svc, "generate_stream", spy)
+            out = "".join(rs.generate_stream(
+                "bucketed stream tenant probe", max_new_tokens=2,
+                tenant="fresh-stream-tenant", timeout_s=180,
+            ))
+            assert isinstance(out, str)
+            # call-time iterator carries the raw key; admission resolves the
+            # overflow bucket and the ticket is re-created with THAT key
+            assert stamped[0] == "fresh-stream-tenant"
+            assert stamped[-1] == TenantFairQueue.OVERFLOW_TENANT
+            # the key on the ticket must be rechargeable while HELD — a
+            # handoff moves a still-pending ticket, and its recharge must
+            # record an admission instead of no-op'ing on an unknown key
+            # (the raw "fresh-stream-tenant" key would hit exactly that)
+            charged = rs.tenants.admit("second-fresh-tenant", 4)
+            assert charged == TenantFairQueue.OVERFLOW_TENANT == stamped[-1]
+            per_before = rs.tenants.stats()["per_tenant"][charged]
+            rs.tenants.recharge(stamped[-1], 4)
+            per_after = rs.tenants.stats()["per_tenant"][charged]
+            assert per_after["admitted"] == per_before["admitted"] + 1
+            assert per_after["pending"] == per_before["pending"]
+            rs.tenants.release(charged, 4)
+        finally:
+            rs.close()
+
     def test_breaker_quarantine_hands_off_inbox(self):
         """Quarantine (breaker flavor, not just stall) moves the dead
         replica's queued-never-dispatched tickets to the survivor instead
