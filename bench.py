@@ -48,6 +48,29 @@ def log(*args) -> None:
     print(*args, file=sys.stderr, flush=True)
 
 
+def device_platform() -> str:
+    """cpu | tpu | gpu — stamped into EVERY artifact section so a fallback
+    round can never again be mistaken for a device round (the r04–r06
+    "not comparable to the TPU baseline" confusion, made structural)."""
+    import jax
+
+    return jax.default_backend()
+
+
+def warn_cpu_fallback(reason: str) -> None:
+    """Loud, unmissable stderr banner when a TPU-requested run fell back
+    to host CPU. Printed at fallback time AND as the run's last stderr
+    output so it cannot scroll away under phase logs."""
+    bar = "!" * 72
+    log(bar)
+    log("!! BENCH FELL BACK TO CPU — AN ACCELERATOR WAS REQUESTED")
+    log(f"!! reason: {reason}")
+    log("!! These numbers are NOT comparable to TPU rounds (BENCH_r01-r03).")
+    log("!! The artifact is marked device_fallback, and device_platform=cpu")
+    log("!! is stamped into every section.")
+    log(bar)
+
+
 def build_corpus(n: int) -> list:
     from sentio_tpu.models.document import Document
 
@@ -771,12 +794,32 @@ def phase_load(llm_cfg, new_tokens):
         for s in range(8)
     ]
 
+    from sentio_tpu.infra.phases import duty_fractions
+
+    def _duty_snapshot(rs) -> list[tuple[dict, float]]:
+        """(phase_seconds, duty_elapsed_s) per replica, for level diffs."""
+        return [
+            (dict(s.get("phase_seconds") or {}), s.get("duty_elapsed_s", 0.0))
+            for s in rs.stats()["replicas"]
+        ]
+
+    def _duty_delta(before, after) -> list[dict]:
+        """Per-replica host/device/idle fractions over the window between
+        two snapshots — the per-level time-attribution evidence."""
+        out = []
+        for (b_phase, b_t), (a_phase, a_t) in zip(before, after):
+            deltas = {k: a_phase.get(k, 0.0) - b_phase.get(k, 0.0)
+                      for k in a_phase}
+            out.append(duty_fractions(deltas, a_t - b_t))
+        return out
+
     def run_level(rs, qps: float, rng: random.Random) -> dict:
         stats = {"arrivals": 0, "ok": 0, "shed": 0, "expired": 0, "error": 0}
         e2e: list[float] = []
         ttft: list[float] = []
         tpot: list[float] = []
         lock = threading.Lock()
+        duty_before = _duty_snapshot(rs)
 
         def gen_worker(prompt: str) -> None:
             t0 = time.perf_counter()
@@ -861,6 +904,11 @@ def phase_load(llm_cfg, new_tokens):
             "errors": stats["error"] + hung,
             "shed_rate": round(stats["shed"] / max(stats["arrivals"], 1), 4),
             "wall_s": round(wall, 2),
+            # per-replica host/device/idle over THIS level's window: how
+            # much of each pump's wall time was GIL-holding host work vs
+            # blocked-on-device vs idle (infra/phases.py)
+            "duty_cycle_per_replica": _duty_delta(
+                duty_before, _duty_snapshot(rs)),
         }
         for label, vals in (("e2e_ms", e2e), ("ttft_ms", ttft),
                             ("tpot_ms", tpot)):
@@ -883,10 +931,12 @@ def phase_load(llm_cfg, new_tokens):
         "by_replicas": {},
     }
     sustained: dict[int, float] = {}
+    duty_by_count: dict[int, list[dict]] = {}
     for n in replica_counts:
         log(f"phase LOAD: building {n}-replica set ...")
         engs = get_engines(n)
-        rs = ReplicaSet([PagedGenerationService(eng) for eng in engs])
+        svcs = [PagedGenerationService(eng) for eng in engs]
+        rs = ReplicaSet(svcs)
         log(f"phase LOAD: warmup ({n} replicas) ...")
         t0 = time.perf_counter()
         warm = rs.warmup(max_new_tokens=gen_tokens)
@@ -895,6 +945,10 @@ def phase_load(llm_cfg, new_tokens):
             f"{time.perf_counter() - t0:.1f}s")
         get_flight_recorder().clear()
         set_metrics(MetricsCollector())  # per-count isolation
+        for svc in svcs:
+            # ladder duty windows must exclude warmup's compile-dominated
+            # ticks, which would swamp the host fraction
+            svc.reset_duty_cycle()
         curve = []
         sustained_n = 0.0
         for qps in qps_ladder:
@@ -926,10 +980,15 @@ def phase_load(llm_cfg, new_tokens):
             s.get("prefix_hit_tokens", 0) - hits_before[i]
             for i, s in enumerate(set_stats["replicas"])
         ]
+        # whole-ladder duty per replica (warmup excluded via the reset):
+        # the host fraction here, times N, is the single-process GIL load
+        ladder_duty = [svc.duty_cycle() for svc in svcs]
+        duty_by_count[n] = ladder_duty
         result["by_replicas"][str(n)] = {
             "levels": curve,
             "sustained_qps_at_slo": sustained_n,
             "routing": set_stats["routing"],
+            "duty_cycle_per_replica": ladder_duty,
             "per_replica_prefix_hit_token_ratio": [
                 s.get("prefix_hit_token_ratio", 0.0)
                 for s in set_stats["replicas"]
@@ -950,6 +1009,25 @@ def phase_load(llm_cfg, new_tokens):
                 "sustained_qps": [sustained[lo], sustained[hi]],
                 "ratio": round(sustained[hi] / sustained[lo], 3),
             }
+    if duty_by_count:
+        # THE GIL probe (ROADMAP item 1): per-replica host fraction at each
+        # replica count, next to the measured scaling ratio. All N pumps
+        # share one Python process — summed host fraction approaching 1 is
+        # the quantified ceiling the multi-process replica tier removes.
+        result["gil_probe"] = {
+            "host_fraction_by_replicas": {
+                str(n): [round(d["host"], 4) for d in duties]
+                for n, duties in duty_by_count.items()
+            },
+            "host_fraction_sum_by_replicas": {
+                str(n): round(sum(d["host"] for d in duties), 4)
+                for n, duties in duty_by_count.items()
+            },
+            **({"scaling_ratio": result["throughput_ratio"]["ratio"]}
+               if "throughput_ratio" in result else {}),
+            "note": ("summed host fraction ~1.0 means the pumps saturate "
+                     "one GIL — the single-process scaling ceiling"),
+        }
     set_metrics(MetricsCollector())  # leave a clean collector behind
     log(f"phase LOAD: sustained {sustained}")
     return result
@@ -1313,6 +1391,8 @@ def ensure_live_backend(probe_timeout_s: float = 180.0) -> str:
 def main() -> None:
     t_start = time.perf_counter()
     fallback_reason = ensure_live_backend()
+    if fallback_reason:
+        warn_cpu_fallback(fallback_reason)
     # A wedged-device fallback means every phase runs on host CPU, where the
     # full-scale corpus/warmup alone exceed the driver budget (round 4: 402 s
     # embed + 742 s warmup → rc=124, no artifact). Downscale the MODELS and
@@ -1487,7 +1567,18 @@ def main() -> None:
         **({"chaos": chaos} if chaos else {}),
         "wall_s": round(total_s, 1),
     }
+    # platform stamped top-level AND into every phase section: a section
+    # copied out of the artifact in isolation still names its platform
+    plat = device_platform()
+    payload["device_platform"] = plat
+    for section in (rag, rag_int8, baseline, baseline_wan, scale, kernels,
+                    longctx, speculative, load, chaos):
+        if isinstance(section, dict):
+            section["device_platform"] = plat
     print(json.dumps(payload))
+    if fallback_reason:
+        # repeated LAST so the banner cannot scroll away under phase logs
+        warn_cpu_fallback(fallback_reason)
 
 
 if __name__ == "__main__":

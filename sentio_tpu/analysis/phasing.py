@@ -1,0 +1,111 @@
+"""Phase-timer discipline: no phase region entered while holding a lock.
+
+The tick-phase attribution layer (infra/phases.py) decomposes pump wall
+time into named phases. A phase-timer region (``PhaseTimer``/``.phase(...)``
+context) opened while an annotated lock is held silently folds LOCK-WAIT
+and critical-section time into whatever phase happens to be open — the
+decomposition then under-reports contention exactly where it matters.
+The discipline: start the timer BEFORE acquiring (the lock wait is then
+part of the phase being measured, e.g. ``inbox_drain`` covering its mutex
+section), never the other way around; if lock-wait itself needs a number,
+it gets a dedicated phase, not a side effect.
+
+``phase-timer-under-lock``
+    A ``with <timer>.phase(...)`` (or ``with PhaseTimer(...)``) entered
+    while a ``with self.<lock>:`` block is lexically open, where
+    ``<lock>`` is any lock named by a ``# guarded-by:`` annotation in the
+    same module (the same source of truth as the lock-discipline checker,
+    analysis/locks.py). Methods whose name ends in ``_locked`` — or that
+    carry a ``# lock-held:`` marker — hold their caller's lock by
+    contract, so a phase region anywhere in their body fires too.
+
+Suppression: the standard inline ``# lint: allow(<rule>)`` marker.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from sentio_tpu.analysis.findings import Finding, SourceFile
+from sentio_tpu.analysis.locks import _method_held_locks, collect_guarded
+
+__all__ = ["check_phase_timer"]
+
+RULE_PHASE_LOCK = "phase-timer-under-lock"
+
+
+def _is_phase_ctx(expr: ast.expr) -> bool:
+    """``<anything>.phase(...)`` or ``PhaseTimer(...)`` used as a context
+    expression."""
+    if not isinstance(expr, ast.Call):
+        return False
+    func = expr.func
+    if isinstance(func, ast.Attribute) and func.attr == "phase":
+        return True
+    if isinstance(func, ast.Name) and func.id == "PhaseTimer":
+        return True
+    if isinstance(func, ast.Attribute) and func.attr == "PhaseTimer":
+        return True
+    return False
+
+
+def _is_lock_item(expr: ast.expr, lock_names: set[str]) -> bool:
+    """``self.<lock>`` for an annotated lock name."""
+    return (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+        and expr.attr in lock_names
+    )
+
+
+def check_phase_timer(tree: ast.Module, src: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+    lock_names: set[str] = set()
+    for gc in collect_guarded(tree, src).values():
+        lock_names.update(gc.guarded.values())
+    if not lock_names:
+        # no annotated locks in this module — nothing to hold
+        return findings
+
+    def report(node: ast.AST) -> None:
+        f = src.finding(
+            RULE_PHASE_LOCK, node.lineno,
+            "phase-timer region entered while holding an annotated lock — "
+            "lock wait/hold time silently folds into the open phase; start "
+            "the timer before acquiring (timing lock-wait is a dedicated "
+            "phase, not a side effect)",
+        )
+        if f is not None:
+            findings.append(f)
+
+    def visit(node: ast.AST, held: bool) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            # items evaluate left to right: `with self._mutex, t.phase():`
+            # enters the phase region with the lock already held
+            inner_held = held
+            for item in node.items:
+                expr = item.context_expr
+                if inner_held and _is_phase_ctx(expr):
+                    report(expr)
+                if _is_lock_item(expr, lock_names):
+                    inner_held = True
+            for stmt in node.body:
+                visit(stmt, inner_held)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs run later on whatever thread calls them; only
+            # their own markers (`_locked` suffix, `# lock-held:`) declare
+            # a held lock
+            nested_held = bool(_method_held_locks(node, src))
+            for child in ast.iter_child_nodes(node):
+                visit(child, nested_held)
+            return
+        if isinstance(node, ast.Lambda):
+            visit(node.body, False)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    visit(tree, False)
+    return findings

@@ -26,6 +26,7 @@ from sentio_tpu.analysis.findings import (
 from sentio_tpu.analysis.blocking import check_blocking
 from sentio_tpu.analysis.hygiene import check_hygiene
 from sentio_tpu.analysis.locks import check_locks
+from sentio_tpu.analysis.phasing import check_phase_timer
 from sentio_tpu.analysis.retrace import check_retrace
 
 __all__ = ["lint_paths", "run_gate", "main", "DEFAULT_BASELINE"]
@@ -34,7 +35,8 @@ PACKAGE_ROOT = Path(__file__).resolve().parents[1]  # sentio_tpu/
 REPO_ROOT = PACKAGE_ROOT.parent
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
 
-RULES = (check_retrace, check_locks, check_hygiene, check_blocking)
+RULES = (check_retrace, check_locks, check_hygiene, check_blocking,
+         check_phase_timer)
 
 
 def _iter_py_files(path: Path):

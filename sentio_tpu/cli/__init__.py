@@ -122,6 +122,16 @@ def _cmd_trace(args: argparse.Namespace) -> int:
             k: v for k, v in flight.items()
             if k not in ("node_timings_ms", "graph_path", "request_id")
         }
+    if args.chrome:
+        # the WHOLE flight timeline (every tick with its phase split, every
+        # request span, verify verdicts) as a Chrome/Perfetto trace — open
+        # the file in ui.perfetto.dev
+        from sentio_tpu.infra.chrome_trace import flight_to_chrome
+
+        with open(args.chrome, "w") as fh:
+            json.dump(flight_to_chrome(), fh)
+        print(f"chrome trace written to {args.chrome} "
+              f"(open in ui.perfetto.dev)", file=sys.stderr)
     if args.documents:
         trace["selected_documents"] = [
             {"id": d.id, "text": d.text[:200], "metadata": d.metadata}
@@ -329,6 +339,9 @@ def main(argv: list[str] | None = None) -> int:
                          choices=["fast", "balanced", "quality", "creative"])
     p_trace.add_argument("--documents", action="store_true",
                          help="include selected document previews")
+    p_trace.add_argument("--chrome", default="", metavar="OUT_JSON",
+                         help="also dump the full flight timeline as a "
+                              "Chrome/Perfetto trace (ui.perfetto.dev)")
     p_trace.set_defaults(fn=_cmd_trace)
 
     p_conv = sub.add_parser("convert", help="convert a local HF checkpoint dir")

@@ -92,6 +92,13 @@ class CompiledGraph:
             meta.setdefault("graph_config", dict(config))
         state["metadata"] = meta
 
+        # OTel node spans (infra/tracing.py): resolved once per run; the
+        # single `enabled` bool keeps the default (tracing-off) path free
+        # of any span or context-manager overhead per node
+        from sentio_tpu.infra.tracing import get_tracing
+
+        tracing = get_tracing()
+
         current = self.entry
         steps = 0
         path: list[str] = []
@@ -128,9 +135,23 @@ class CompiledGraph:
                 continue
             t0 = time.perf_counter()
             try:
-                update = node.fn(state)
-                if inspect.isawaitable(update):
-                    update = await update
+                if tracing.enabled:
+                    # span per node, carrying the trace id and (once the
+                    # generate node stamped it) the serving replica — the
+                    # correlation keys that join graph spans to flight
+                    # ticks and XLA step annotations
+                    with tracing.span(
+                        f"graph.{node.name}",
+                        request_id=str(meta.get("query_id", "")),
+                        replica_id=int(state["metadata"].get("replica_id", -1)),
+                    ):
+                        update = node.fn(state)
+                        if inspect.isawaitable(update):
+                            update = await update
+                else:
+                    update = node.fn(state)
+                    if inspect.isawaitable(update):
+                        update = await update
             except Exception as exc:  # noqa: BLE001 — soft-fail ladder by design
                 # typed shed/deadline errors opt OUT of soft-fail: turning a
                 # 429/503/504 into a degraded 200 would hide overload from
@@ -172,7 +193,21 @@ def _run_detached(node: _Node, state: dict) -> None:
     event loop — the spawning loop is long gone by the time a slow audit
     decode finishes). Exceptions are logged, never propagated: the caller
     already has its answer."""
+    from sentio_tpu.infra.tracing import get_tracing
+
+    tracing = get_tracing()
     try:
+        if tracing.enabled:
+            with tracing.span(
+                f"graph.{node.name}", detached=True,
+                request_id=str(state.get("metadata", {}).get("query_id", "")),
+                replica_id=int(
+                    state.get("metadata", {}).get("replica_id", -1)),
+            ):
+                update = node.fn(state)
+                if inspect.isawaitable(update):
+                    asyncio.run(_await_detached(update))
+            return
         update = node.fn(state)
         if inspect.isawaitable(update):
             asyncio.run(_await_detached(update))

@@ -187,6 +187,10 @@ def create_generator_node(generator, settings: Optional[Settings] = None):
             update_meta["logprob_mean"] = round(gen_stats["logprob_mean"], 4)
             update_meta["logprob_min"] = round(gen_stats["logprob_min"], 4)
             update_meta["logprob_count"] = gen_stats["logprob_count"]
+        if gen_stats.get("replica_id") is not None:
+            # which serving replica decoded the answer: downstream node
+            # spans (verify) and traces carry it as a correlation key
+            update_meta["replica_id"] = gen_stats["replica_id"]
         return {"response": answer, "metadata": update_meta}
 
     return generate_node

@@ -118,6 +118,10 @@ class FlightRecorder:
         with self._lock:
             engine = self._ensure_locked(request_id).setdefault("engine", {})
             engine.setdefault("tick_first", self._tick_seq)
+            # timeline-origin submit stamp: lets the Chrome-trace exporter
+            # place the engine span / first-token mark on the same clock as
+            # tick events (t_start_s is the HTTP-layer open, not submit)
+            engine.setdefault("t_submit_s", round(self._now(), 6))
             for key, value in fields.items():
                 engine.setdefault(key, value)
 
@@ -175,13 +179,34 @@ class FlightRecorder:
 
     def record_tick(self, **fields: Any) -> int:
         """Append one engine-tick event; returns its sequence number. The
-        pump owns tick cadence — one call per ``engine.step()``."""
+        pump owns tick cadence — one call per ``engine.step()``, made
+        BEFORE result delivery so a request finishing this tick records a
+        ``tick_last`` that still includes it (the window filter in
+        :meth:`get` is ``first < tick <= last``)."""
         with self._lock:
             self._tick_seq += 1
             event = {"tick": self._tick_seq, "t_s": round(self._now(), 4)}
             event.update(fields)
             self._ticks.append(event)
             return self._tick_seq
+
+    def amend_tick(self, tick: int, restamp: bool = True,
+                   **fields: Any) -> int:
+        """Merge late fields into an already-recorded tick event — the pump
+        records the tick before delivering results (window semantics above)
+        and amends the COMPLETED phase decomposition afterwards. ``restamp``
+        moves ``t_s`` to now, keeping the convention that a tick's stamp
+        marks the END of the span it covers (the Chrome exporter subtracts
+        ``pump_ms`` to find the start). Returns 1 when the event was found
+        (it is normally the ring's tail; a full ring may have evicted it)."""
+        with self._lock:
+            for event in reversed(self._ticks):
+                if event["tick"] == tick:
+                    event.update(fields)
+                    if restamp:
+                        event["t_s"] = round(self._now(), 4)
+                    return 1
+        return 0
 
     # ---------------------------------------------------------------- reads
 
@@ -212,6 +237,16 @@ class FlightRecorder:
         with self._lock:
             events = [dict(e) for e in self._ticks]
         return events[-last:] if last else events
+
+    def records(self) -> list[dict]:
+        """Shallow copies of every retained request record, insertion order
+        (the Chrome-trace exporter's request-span source)."""
+        with self._lock:
+            return [
+                dict(record, engine=dict(record["engine"]))
+                if "engine" in record else dict(record)
+                for record in self._records.values()
+            ]
 
     def snapshot(self) -> dict:
         """Aggregate view for bench artifacts / debugging."""

@@ -242,6 +242,27 @@ class MetricsCollector:
                 "decode pump heartbeat age under pending work",
                 ["replica"], registry=r,
             ),
+            # tick-phase attribution (infra/phases.py): per-replica
+            # host/device/idle wall-time split (fractions sum to 1) and
+            # the per-tick phase latency distributions. Host fraction
+            # near 1 under load = the pump is GIL/dispatch-bound, not
+            # device-bound — monitoring.yaml's SentioTpuPumpHostBound
+            # alert and ROADMAP item 1's multi-process argument both
+            # read this series.
+            "pump_duty_cycle": Gauge(
+                "sentio_tpu_pump_duty_cycle",
+                "fraction of wall time the decode pump spends per state "
+                "(host / device / idle; sums to 1 per replica)",
+                ["replica", "state"], registry=r,
+            ),
+            "tick_phase": Histogram(
+                "sentio_tpu_tick_phase_seconds",
+                "pump-iteration time per named phase",
+                ["phase"],
+                buckets=(1e-5, 1e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 0.01,
+                         0.025, 0.05, 0.1, 0.25, 0.5, 1, 5),
+                registry=r,
+            ),
         }
 
     # ------------------------------------------------------------- recording
@@ -312,6 +333,38 @@ class MetricsCollector:
         self.set_serving_stat("tick_queue_depth", float(queue_depth))
         if self._prom:
             self._prom["tick_duration"].observe(duration_s)
+
+    def record_tick_phases(self, phase_s: dict) -> None:
+        """One pump iteration's phase split (seconds per phase, keys from
+        :data:`sentio_tpu.infra.phases.TICK_PHASES`). Unknown keys are
+        DROPPED — the ``phase`` label space is a fixed bounded set and a
+        typo'd phase name must not mint a new metric series."""
+        if not self.enabled:
+            return
+        from sentio_tpu.infra.phases import TICK_PHASES
+
+        hist = self._prom.get("tick_phase")
+        for key in TICK_PHASES:
+            value = phase_s.get(key)
+            if value is None:
+                continue
+            self.memory.observe("tick_phase", (key,), float(value))
+            if hist is not None:
+                hist.labels(phase=key).observe(float(value))
+
+    def record_duty_cycle(self, replica: int, fractions: dict) -> None:
+        """Publish one replica's host/device/idle duty-cycle fractions
+        (:func:`sentio_tpu.infra.phases.duty_fractions` output — they sum
+        to 1). Bounded: only the three known states are exported."""
+        if not self.enabled:
+            return
+        gauge = self._prom.get("pump_duty_cycle")
+        for state in ("host", "device", "idle"):
+            value = float(fractions.get(state, 0.0))
+            self.memory.set_gauge("pump_duty_cycle", (str(replica), state),
+                                  value)
+            if gauge is not None:
+                gauge.labels(replica=str(replica), state=state).set(value)
 
     def record_compiles(self, family: str, n: int = 1) -> None:
         """``n`` XLA compilations at jit family ``family`` (fed by the audit

@@ -1,0 +1,170 @@
+"""Tick-phase time attribution: where a pump-loop millisecond goes.
+
+The flight recorder (infra/flight.py) times ticks as opaque wholes; this
+module gives every pump iteration a named-phase decomposition so host work
+is separable from device compute — the measurement ROADMAP item 1's
+multi-process argument needs (host-fraction x N replicas is the direct GIL
+ceiling). Phases are plain ``perf_counter`` deltas: no spans, no context
+objects on the hot path beyond one tiny ``_PhaseSpan``, nothing when a
+section simply stamps two clocks.
+
+The phase set is FIXED and BOUNDED (``TICK_PHASES``): per-tick ``phase_ms``
+dicts on flight tick records and the ``sentio_tpu_tick_phase_seconds``
+histogram label space can never grow by a typo'd key (metrics cardinality
+guard — unknown keys are dropped at the recording seam).
+
+Phase glossary (one pump iteration, in canonical order):
+
+``inbox_drain``
+    Service-side mutex section at the loop top: heartbeat stamp, cancelled/
+    expired sweeps, engine ``submit`` for every inbox ticket.
+``admission_build``
+    Host-side admission work inside ``engine.step()``: tokenization, radix
+    matching, page allocation, padded numpy array assembly — everything in
+    ``_admit``/``_advance_prefill`` EXCEPT the jit dispatch calls.
+``prefill_dispatch``
+    Host call time of the prefill/scatter jit dispatches (async on device;
+    this is what the dispatch costs the PUMP THREAD — the GIL-held part).
+``decode_dispatch``
+    Host call time of the fused decode dispatch (``step_n``/spec tick) plus
+    its merge/budget prep — again host-side cost of an async dispatch.
+``device_wait``
+    Time blocked on device results: the harvest's packed-token fetch
+    (``np.asarray`` on a not-yet-ready array) and any blocking first-token
+    fold. With ``pipeline_depth=2`` the dispatch overlaps the previous
+    fetch, so the wait measured in iteration N is for the tick dispatched
+    at N-1 — it is charged to the iteration that HARVESTS it, which is
+    where the wall clock actually went (per-iteration conservation holds).
+``deliver``
+    Service-side mutex section after the tick: TTFT stamping, stream-queue
+    pushes, result/event completion.
+``other``
+    Everything else measured inside the iteration (sanitizer invariant
+    walks, telemetry recording) — kept explicit so per-tick conservation
+    (``sum(phase_ms) == pump_ms``) holds by construction, not by tolerance.
+
+``idle`` is not a tick phase: it is the duty-cycle complement (wall time
+with no pump iteration running — pump down, or gaps between bursts).
+"""
+
+from __future__ import annotations
+
+import time
+
+__all__ = [
+    "TICK_PHASES",
+    "ENGINE_PHASES",
+    "HOST_PHASES",
+    "DUTY_STATES",
+    "PhaseTimer",
+    "duty_fractions",
+    "phases_to_ms",
+]
+
+# the one bounded key set — flight `phase_ms`, the tick-phase histogram's
+# `phase` label, and the conservation test all pin against this tuple
+TICK_PHASES = (
+    "inbox_drain",
+    "admission_build",
+    "prefill_dispatch",
+    "decode_dispatch",
+    "device_wait",
+    "deliver",
+    "other",
+)
+
+# the subset engine.step() itself attributes (the service adds the rest)
+ENGINE_PHASES = (
+    "admission_build",
+    "prefill_dispatch",
+    "decode_dispatch",
+    "device_wait",
+    "other",
+)
+
+# duty-cycle rollup: every phase that burns the host thread (and, with N
+# replicas in one process, contends for the one GIL) vs. blocked-on-device
+HOST_PHASES = tuple(p for p in TICK_PHASES if p != "device_wait")
+
+DUTY_STATES = ("host", "device", "idle")
+
+
+class _PhaseSpan:
+    """Tiny enter/exit timer — two perf_counter calls and a dict add."""
+
+    __slots__ = ("_timer", "_key", "_t0")
+
+    def __init__(self, timer: "PhaseTimer", key: str) -> None:
+        self._timer = timer
+        self._key = key
+
+    def __enter__(self) -> "_PhaseSpan":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._timer.add(self._key, time.perf_counter() - self._t0)
+        return False
+
+
+class PhaseTimer:
+    """Per-iteration phase accumulator. NOT thread-safe by design — one
+    timer belongs to one pump/engine thread; cross-thread aggregation
+    happens on snapshots. A region may be entered many times per tick
+    (every prefill dispatch adds to ``prefill_dispatch``); keys outside
+    the constructor's set are rejected so the bounded-set guarantee is
+    enforced at the writer, not just the exporter."""
+
+    __slots__ = ("acc",)
+
+    def __init__(self, keys: tuple = TICK_PHASES) -> None:
+        self.acc: dict[str, float] = dict.fromkeys(keys, 0.0)
+
+    def reset(self) -> None:
+        for key in self.acc:
+            self.acc[key] = 0.0
+
+    def add(self, key: str, seconds: float) -> None:
+        # KeyError on an unknown phase is deliberate: a typo'd phase name
+        # must fail the tick that introduced it, not mint a metric series
+        self.acc[key] += seconds
+
+    def phase(self, key: str) -> _PhaseSpan:
+        """Context manager timing one region into ``key``."""
+        if key not in self.acc:
+            raise KeyError(f"unknown phase {key!r} (bounded set: {tuple(self.acc)})")
+        return _PhaseSpan(self, key)
+
+    def total(self) -> float:
+        return sum(self.acc.values())
+
+    def snapshot_ms(self) -> dict[str, float]:
+        """Bounded ``phase_ms`` dict for a flight tick record (zero phases
+        included — a fixed shape diffs and plots cleanly)."""
+        return phases_to_ms(self.acc)
+
+
+def phases_to_ms(phase_s: dict) -> dict:
+    """Seconds-per-phase → the ``phase_ms`` wire shape (ms, 3 decimals).
+    ONE definition — the pump's flight records and PhaseTimer.snapshot_ms
+    must never drift (the chrome-trace golden fixture pins the format)."""
+    return {k: round(v * 1e3, 3) for k, v in phase_s.items()}
+
+
+def duty_fractions(phase_totals: dict, elapsed_s: float) -> dict:
+    """Fold cumulative phase seconds into host/device/idle fractions of
+    ``elapsed_s`` wall time, summing to exactly 1.0 (the gauge contract:
+    ``sentio_tpu_pump_duty_cycle{state}`` over one replica sums to 1).
+    Measurement skew (busy marginally exceeding elapsed on a coarse clock)
+    clamps idle at 0 and renormalizes."""
+    if elapsed_s <= 0:
+        return {"host": 0.0, "device": 0.0, "idle": 1.0}
+    host = sum(phase_totals.get(k, 0.0) for k in HOST_PHASES)
+    device = phase_totals.get("device_wait", 0.0)
+    idle = max(elapsed_s - host - device, 0.0)
+    total = host + device + idle
+    return {
+        "host": round(host / total, 6),
+        "device": round(device / total, 6),
+        "idle": round(idle / total, 6),
+    }

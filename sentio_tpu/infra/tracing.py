@@ -14,6 +14,8 @@ from __future__ import annotations
 import asyncio
 import functools
 import logging
+import sys
+import threading
 import time
 from contextlib import contextmanager
 from typing import Any, Optional
@@ -45,6 +47,13 @@ class TracingManager:
         self.config = config or get_settings().observability
         self._tracer = None
         self._provider = None
+        # THE hot-path guard: serving code (graph executor, serve
+        # middleware, decode pump) tests this single bool before touching
+        # span()/profile_step(). False when tracing is configured off OR
+        # when OTel is absent — the mock-span fallback exists for direct
+        # span() callers, but the hot path must stay a true no-op rather
+        # than paying context-manager overhead to feed a mock.
+        self.enabled = False
         if self.config.tracing_enabled:
             self._setup()
 
@@ -77,10 +86,12 @@ class TracingManager:
             trace.set_tracer_provider(provider)
             self._provider = provider
             self._tracer = trace.get_tracer(self.config.service_name)
+            self.enabled = True
             logger.info("tracing enabled for %s", self.config.service_name)
         except ImportError:
             logger.info("opentelemetry not installed; tracing is a no-op")
             self._tracer = None
+            self.enabled = False
 
     @contextmanager
     def span(self, name: str, **attributes: Any):
@@ -97,24 +108,40 @@ class TracingManager:
 
     @contextmanager
     def profile_step(self, name: str, step: int = 0):
-        """Correlate a device dispatch with the XLA profiler timeline."""
+        """Correlate a device dispatch with the XLA profiler timeline.
+        ONLY the annotation setup is guarded: an exception raised by the
+        traced body must propagate unmangled — the decode pump's crash
+        containment and the chaos drills key off the original exception
+        type (a broad except around the yield would re-enter the generator
+        after a throw and replace a device fault with contextlib's
+        \"generator didn't stop after throw()\")."""
+        annotation = None
         try:
             import jax
 
-            with jax.profiler.StepTraceAnnotation(name, step_num=step):
-                with self.span(f"tpu.{name}", step=step):
-                    yield
+            annotation = jax.profiler.StepTraceAnnotation(name, step_num=step)
+            annotation.__enter__()
         except Exception:
+            annotation = None  # profiler unavailable: span-only fallback
+        try:
             with self.span(f"tpu.{name}", step=step):
                 yield
+        finally:
+            if annotation is not None:
+                try:
+                    annotation.__exit__(*sys.exc_info())
+                except Exception:
+                    logger.debug("StepTraceAnnotation exit failed",
+                                 exc_info=True)
 
-    def start_profiler(self) -> bool:
-        if not self.config.profiler_dir:
+    def start_profiler(self, log_dir: Optional[str] = None) -> bool:
+        target = log_dir or self.config.profiler_dir
+        if not target:
             return False
         try:
             import jax
 
-            jax.profiler.start_trace(self.config.profiler_dir)
+            jax.profiler.start_trace(target)
             return True
         except Exception:
             logger.warning("jax profiler start failed", exc_info=True)
@@ -176,3 +203,44 @@ def get_tracing() -> TracingManager:
 def set_tracing(manager: Optional[TracingManager]) -> None:
     global _tracing
     _tracing = manager
+
+
+# ------------------------------------------------------- windowed profiler
+
+_profile_lock = threading.Lock()
+_profile_active = False  # guarded-by: _profile_lock
+
+
+def profile_window(seconds: float, log_dir: str) -> dict:
+    """Arm ``jax.profiler`` for a bounded window and stop it — the
+    ``/debug/profile?seconds=N`` implementation. Single-flight: the jax
+    profiler is process-global, so a second concurrent window is refused
+    rather than corrupting the first's trace. Blocking (sleeps for the
+    window) — callers run it on a worker thread. Returns what happened;
+    never raises (an unprofileable backend is an operator answer, not a
+    500)."""
+    global _profile_active
+    with _profile_lock:
+        if _profile_active:
+            return {"started": False,
+                    "error": "a profile window is already active"}
+        _profile_active = True
+    try:
+        import jax
+
+        try:
+            jax.profiler.start_trace(log_dir)
+        except Exception as exc:  # noqa: BLE001 — surface, don't crash
+            return {"started": False, "error": f"start_trace failed: {exc}"}
+        try:
+            time.sleep(max(float(seconds), 0.0))
+        finally:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                logger.warning("jax profiler stop failed", exc_info=True)
+        return {"started": True, "seconds": float(seconds),
+                "log_dir": log_dir}
+    finally:
+        with _profile_lock:
+            _profile_active = False

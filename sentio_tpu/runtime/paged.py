@@ -58,6 +58,7 @@ import numpy as np
 from sentio_tpu.analysis.audit.registry import jit_family
 from sentio_tpu.analysis.sanitizer import check_engine_invariants, engine_guard
 from sentio_tpu.infra import faults
+from sentio_tpu.infra.phases import ENGINE_PHASES, PhaseTimer
 from sentio_tpu.models.llama import LlamaConfig
 from sentio_tpu.parallel.batcher import bucket_size
 
@@ -429,6 +430,10 @@ class PagedResult:
     logprob_sum: float = 0.0
     logprob_min: float = 0.0
     logprob_count: int = 0
+    # which serving replica's engine produced this result (-1 = a bare
+    # engine outside any service); stamped by PagedGenerationService at
+    # completion so tracing spans and stats sinks can name the replica
+    replica_id: int = -1
 
     @property
     def logprob_mean(self) -> Optional[float]:
@@ -440,7 +445,7 @@ class PagedResult:
         """The confidence-gate signal as one dict — THE shape every
         ``stats``/``stats_out`` sink (TpuProvider, generate_stream) fills,
         so the streaming and non-streaming gates can never diverge."""
-        return {
+        out = {
             "logprob_sum": self.logprob_sum,
             "logprob_min": self.logprob_min,
             "logprob_count": self.logprob_count,
@@ -448,6 +453,9 @@ class PagedResult:
             "tokens": len(self.tokens),
             "finish_reason": self.finish_reason,
         }
+        if self.replica_id >= 0:
+            out["replica_id"] = self.replica_id
+        return out
 
 
 class ContinuousBatchingEngine:
@@ -610,6 +618,14 @@ class ContinuousBatchingEngine:
 
         self.slots = [_Slot() for _ in range(max_slots)]  # guarded-by: engine-thread
         self.last_tick_active = 0
+        # tick-phase attribution (infra/phases.py): reset at the top of
+        # every step(), accumulated by the dispatch helpers, closed out at
+        # the bottom of step() into last_step_phases (seconds per phase,
+        # keys == ENGINE_PHASES) — the serving pump merges its own
+        # inbox_drain/deliver sections in and records the full phase_ms
+        # dict on the flight tick event. Plain perf_counter deltas.
+        self._phase = PhaseTimer(ENGINE_PHASES)  # guarded-by: engine-thread
+        self.last_step_phases: dict = dict.fromkeys(ENGINE_PHASES, 0.0)  # guarded-by: engine-thread
         # device sub-steps actually executed (the scan runs its full static
         # length; every sub-step streams the weights once) — throughput and
         # HBM-utilization math must use this, not ticks x steps_per_tick
@@ -1200,11 +1216,22 @@ class ContinuousBatchingEngine:
         # chaos-drill injection point: a raised fault propagates exactly like
         # a real failed device dispatch (the serving pump resets + requeues)
         faults.hit("paged.step")
+        acc = self._phase.acc
+        self._phase.reset()
+        t0 = time.perf_counter()
         self.last_tick_active = 0
         self._admit()
         if self.prefill_chunk is not None:
             self._advance_prefill()
+        t_admit = time.perf_counter()
+        # the admission span minus its jit dispatch calls is pure host build
+        # work (tokenize, radix match, page alloc, padded array assembly)
+        acc["admission_build"] += (t_admit - t0) - acc["prefill_dispatch"]
         record = self._dispatch_tick() if any(s.active for s in self.slots) else None
+        t_dispatch = time.perf_counter()
+        # decode dispatch is HOST CALL time of an async dispatch; any
+        # blocking first-token fold inside it already went to device_wait
+        acc["decode_dispatch"] += (t_dispatch - t_admit) - acc["device_wait"]
         # buffer swap AFTER dispatch: defensive retires made while budgeting
         # must ride THIS step's results (there may not be a next step)
         out, self._finished_buffer = self._finished_buffer, []
@@ -1215,10 +1242,18 @@ class ContinuousBatchingEngine:
             prev, self._inflight = self._inflight, record
             if prev is not None:
                 out.extend(self._harvest(prev))
+        t_harvest = time.perf_counter()
+        # the harvest span is dominated by the blocking packed-token fetch;
+        # with pipeline_depth=2 this wait belongs to the PREVIOUS tick's
+        # dispatch but is charged to the iteration that harvests it — that
+        # is where the wall clock went, so per-tick conservation holds
+        acc["device_wait"] += t_harvest - t_dispatch
         if self._san is not None:
             # page conservation + radix refcounts, checked on the tick that
             # broke them — not at pool exhaustion three workloads later
             check_engine_invariants(self)
+        acc["other"] += time.perf_counter() - t_harvest
+        self.last_step_phases = dict(acc)
         return out
 
     # -------------------------------------------------------------- private
@@ -1520,10 +1555,11 @@ class ContinuousBatchingEngine:
                     ids[r, : len(tok_ids)] = tok_ids
                     lens[r] = len(tok_ids)
                     rows_idx[r] = slot_idx
-                self._spec_dk, self._spec_dv = self._draft_prefill(
-                    self.draft_params, ids, self._spec_dk, self._spec_dv,
-                    rows_idx, lens,
-                )
+                with self._phase.phase("prefill_dispatch"):
+                    self._spec_dk, self._spec_dv = self._draft_prefill(
+                        self.draft_params, ids, self._spec_dk, self._spec_dv,
+                        rows_idx, lens,
+                    )
 
     def _assemble_prefill(self, rows_data, width: int, pos_offset: int = 0):
         """Build the padded admission arrays ONE way for every prefill
@@ -1565,11 +1601,12 @@ class ContinuousBatchingEngine:
              for slot_idx, req, tok_ids in chunk],
             width,
         )
-        first, first_lp, self.pool.k, self.pool.v, self._rng = \
-            self._prefill_scatter(
-                self.params, ids, positions, lens, self._rng, temps, scat,
-                self.pool.k, self.pool.v, top_ks,
-            )
+        with self._phase.phase("prefill_dispatch"):
+            first, first_lp, self.pool.k, self.pool.v, self._rng = \
+                self._prefill_scatter(
+                    self.params, ids, positions, lens, self._rng, temps, scat,
+                    self.pool.k, self.pool.v, top_ks,
+                )
         self.prefill_tokens_total += sum(len(t) for _i, _r, t in chunk)
         slot_idxs = [slot_idx for slot_idx, _req, _ids in chunk]
         for slot_idx in slot_idxs:
@@ -1604,12 +1641,13 @@ class ContinuousBatchingEngine:
         ids, lens, temps, top_ks, scat, positions = self._assemble_prefill(
             rows_data, width, pos_offset=n_prior[:, None],
         )
-        first, first_lp, self.pool.k, self.pool.v, self._rng = \
-            self._prior_prefill_scatter(
-                self.params, ids, positions, lens, self._rng, temps, scat,
-                self.pool.k, self.pool.v, prior_tables, n_prior, top_ks,
-                do_sample=True,
-            )
+        with self._phase.phase("prefill_dispatch"):
+            first, first_lp, self.pool.k, self.pool.v, self._rng = \
+                self._prior_prefill_scatter(
+                    self.params, ids, positions, lens, self._rng, temps, scat,
+                    self.pool.k, self.pool.v, prior_tables, n_prior, top_ks,
+                    do_sample=True,
+                )
         self.prefill_tokens_total += sum(len(t) - s for _i, _r, t, s in chunk)
         slot_idxs = [slot_idx for slot_idx, _req, _ids, _sh in chunk]
         for slot_idx in slot_idxs:
@@ -1652,12 +1690,13 @@ class ContinuousBatchingEngine:
             pnb = self._prior_bucket(pb)
             prior_table = np.zeros((1, pnb), np.int32)
             prior_table[0, :pb] = self._page_table[i, :pb]
-            first, first_lp, self.pool.k, self.pool.v, self._rng = \
-                self._prior_prefill_scatter(
-                    self.params, ids, positions, lens, self._rng, temps,
-                    scat, self.pool.k, self.pool.v, prior_table,
-                    n_prior, top_ks, do_sample=is_last,
-                )
+            with self._phase.phase("prefill_dispatch"):
+                first, first_lp, self.pool.k, self.pool.v, self._rng = \
+                    self._prior_prefill_scatter(
+                        self.params, ids, positions, lens, self._rng, temps,
+                        scat, self.pool.k, self.pool.v, prior_table,
+                        n_prior, top_ks, do_sample=is_last,
+                    )
             self.prefill_tokens_total += len(seg)
             if is_last:
                 slot.prefill_todo = None
@@ -1744,8 +1783,11 @@ class ContinuousBatchingEngine:
             # of dispatching a fully-masked scan that would stream the
             # weights steps-many times just to echo the inputs back
             for first_dev, first_lp_dev, slot_idxs in pending:
-                vals = np.asarray(first_dev)
-                lps = np.asarray(first_lp_dev)
+                # a direct fetch of not-yet-ready device arrays BLOCKS —
+                # this is device wait, not dispatch cost
+                with self._phase.phase("device_wait"):
+                    vals = np.asarray(first_dev)
+                    lps = np.asarray(first_lp_dev)
                 for r, i in enumerate(slot_idxs):
                     if not self.slots[i].active:
                         continue

@@ -113,6 +113,7 @@ from sentio_tpu.infra.exceptions import (
     ServiceOverloaded,
 )
 from sentio_tpu.infra.metrics import get_metrics
+from sentio_tpu.infra.phases import duty_fractions
 from sentio_tpu.runtime.service import (
     PagedGenerationService,
     finish_ticket_error,
@@ -1130,6 +1131,9 @@ class ReplicaSet:
             try:
                 get_metrics().record_heartbeat_age(
                     idx, age if age is not None else 0.0)
+                # duty cycle rides the same supervisor cadence, so the
+                # host/device/idle gauge stays fresh between scrapes
+                get_metrics().record_duty_cycle(idx, svc.duty_cycle())
             except Exception:  # noqa: BLE001 — telemetry best-effort
                 pass
             if age is not None and age > budget:
@@ -1530,6 +1534,21 @@ class ReplicaSet:
         if spec_v:
             agg["spec_tokens_per_verify"] = round(
                 agg.get("spec_emitted", 0) / spec_v, 2)
+        # tick-phase attribution (infra/phases.py): phase seconds sum
+        # across replicas; the set-level duty cycle is summed busy time
+        # over summed wall time — i.e. the per-replica AVERAGE split (the
+        # per-replica rows below keep the individual gauges honest)
+        phase_totals: dict = {}
+        duty_elapsed = 0.0
+        for s in per:
+            for key, val in (s.get("phase_seconds") or {}).items():
+                phase_totals[key] = phase_totals.get(key, 0.0) + val
+            duty_elapsed += s.get("duty_elapsed_s", 0.0)
+        if duty_elapsed > 0:
+            agg["phase_seconds"] = {k: round(v, 6)
+                                    for k, v in phase_totals.items()}
+            agg["duty_elapsed_s"] = round(duty_elapsed, 6)
+            agg["duty_cycle"] = duty_fractions(phase_totals, duty_elapsed)
         first = per[0]
         agg["page_size"] = first.get("page_size")
         agg["kv_quant"] = first.get("kv_quant")

@@ -62,6 +62,7 @@ from sentio_tpu.infra.exceptions import (
 )
 from sentio_tpu.infra.flight import get_flight_recorder
 from sentio_tpu.infra.metrics import get_metrics
+from sentio_tpu.infra.phases import TICK_PHASES, duty_fractions, phases_to_ms
 from sentio_tpu.runtime.paged import ContinuousBatchingEngine, PagedResult
 
 logger = logging.getLogger(__name__)
@@ -227,6 +228,16 @@ class PagedGenerationService:
         self._active_sum = 0  # guarded-by: _mutex
         self._max_active = 0  # guarded-by: _mutex
         self._completed = 0  # guarded-by: _mutex
+        # tick-phase attribution (infra/phases.py): cumulative seconds per
+        # phase across every pump iteration, single-writer (the pump);
+        # readers (stats/duty_cycle, any thread) take GIL-atomic snapshots
+        # of float values — slight skew between keys is acceptable for a
+        # duty-cycle gauge, and a mutex here would put a lock acquisition
+        # on every pump iteration for telemetry's sake
+        self._phase_totals = dict.fromkeys(TICK_PHASES, 0.0)  # guarded-by: pump-thread
+        # duty-cycle wall-clock origin; reset_duty_cycle() re-bases it so
+        # bench windows exclude warmup compiles
+        self._duty_t0 = time.perf_counter()  # guarded-by: pump-thread
 
     # ------------------------------------------------------------------ api
 
@@ -745,10 +756,34 @@ class PagedGenerationService:
             if self._pump is pump:
                 self._pump = None
 
+    def duty_cycle(self) -> dict:
+        """host/device/idle fractions of wall time since construction (or
+        the last :meth:`reset_duty_cycle`), summing to 1. ``host`` is every
+        phase that burns the pump thread — with N replicas in one process,
+        host-fraction x N is the direct GIL ceiling ROADMAP item 1 argues
+        from. Reads the pump-thread-owned totals GIL-atomically; per-key
+        skew of at most one in-flight tick is acceptable for a gauge."""
+        totals = dict(self._phase_totals)
+        return duty_fractions(totals, time.perf_counter() - self._duty_t0)
+
+    def reset_duty_cycle(self) -> None:
+        """Re-base the duty-cycle window (e.g. after warmup, whose
+        compile-dominated ticks would otherwise swamp the host fraction).
+        Telemetry-grade: a tick racing the reset may leak one iteration's
+        phases into the new window."""
+        for key in list(self._phase_totals):
+            self._phase_totals[key] = 0.0
+        self._duty_t0 = time.perf_counter()
+
     def stats(self) -> dict:
         # engine fields are read without a lock: the pump owns the engine,
         # and these are GIL-atomic reads of ints/lists used for telemetry
         engine_stats = self.engine.stats()
+        # phase totals are pump-thread-owned (see duty_cycle): snapshot
+        # outside the mutex like the engine fields
+        phase_seconds = {k: round(v, 6) for k, v in self._phase_totals.items()}
+        duty = self.duty_cycle()
+        duty_elapsed = round(time.perf_counter() - self._duty_t0, 6)
         with self._mutex:
             return {
                 **engine_stats,
@@ -771,6 +806,12 @@ class PagedGenerationService:
                 "pump_leaked": self._pump_leaked,
                 "abandoned": int(self._abandoned),
                 "tick_stall_budget_s": self.tick_stall_budget_s,
+                # tick-phase attribution: cumulative seconds per phase and
+                # the host/device/idle duty cycle over the current window
+                # (bench diffs phase_seconds snapshots for per-level duty)
+                "phase_seconds": phase_seconds,
+                "duty_elapsed_s": duty_elapsed,
+                "duty_cycle": duty,
             }
 
     def warmup(self, max_new_tokens: int = 4) -> dict:
@@ -928,6 +969,12 @@ class PagedGenerationService:
         self.engine.pressure_hint = lambda: len(self._inbox)  # lint: allow(lock-discipline)
         recorder = get_flight_recorder()
         metrics = get_metrics()
+        # tracing manager resolved ONCE per pump: when tracing is off
+        # (default) the per-tick cost is a single bool test — no span
+        # objects, no context managers on the hot path
+        from sentio_tpu.infra.tracing import get_tracing
+
+        tracing = get_tracing()
         # baselines for diffing the engine's lifetime counters into per-tick
         # attributions (pump-local: a restarted pump re-baselines, so the
         # first tick of a new burst never inherits the previous burst's work)
@@ -953,7 +1000,7 @@ class PagedGenerationService:
         last_hit_toks = self.engine.prefix_hit_tokens_total
         last_miss_toks = self.engine.prefix_miss_tokens_total
         while True:
-            now = time.perf_counter()
+            t_iter = now = time.perf_counter()
             with self._mutex:
                 # heartbeat: the watchdog's liveness signal. Stamped at the
                 # top of EVERY loop iteration, so a tick wedged inside the
@@ -1017,10 +1064,20 @@ class PagedGenerationService:
                     return
             # device work runs WITHOUT any lock: the pump is the engine's
             # only driver, and submitters must never wait on a decode tick
+            t_drain = time.perf_counter()
             try:
-                t_tick = time.perf_counter()
-                finished = self.engine.step()
-                tick_dur_s = time.perf_counter() - t_tick
+                if tracing.enabled:
+                    # StepTraceAnnotation around the tick: an armed XLA
+                    # profiler window (/debug/profile) lines its device
+                    # traces up with flight ticks by step number
+                    with tracing.profile_step(
+                        "decode_tick",
+                        step=self._ticks + 1,  # lint: allow(lock-discipline) — GIL-atomic read
+                    ):
+                        finished = self.engine.step()
+                else:
+                    finished = self.engine.step()
+                tick_dur_s = time.perf_counter() - t_drain
             except Exception:
                 logger.exception(
                     "paged decode tick failed; attempting crash containment")
@@ -1107,9 +1164,17 @@ class PagedGenerationService:
             active = getattr(self.engine, "last_tick_active", None)
             if active is None:
                 active = sum(s.active for s in self.engine.slots)
-            # flight-recorder tick event: what THIS fused dispatch did.
-            # Telemetry is strictly best-effort — an exception here must
-            # never kill the pump (waiters would hang on a dead thread).
+            t_step_end = time.perf_counter()
+            # flight-recorder tick event BEFORE delivery: finish_engine in
+            # the deliver section stamps tick_last from the recorder's
+            # sequence, and the request-window filter (first < tick <=
+            # last) must include the tick a request FINISHED in — recording
+            # after delivery would silently drop every request's final tick
+            # from /debug/flight. The completed phase decomposition cannot
+            # exist yet (delivery hasn't happened); it is AMENDED onto this
+            # event below. Telemetry is strictly best-effort — an exception
+            # here must never kill the pump (waiters would hang).
+            tick_seq = None
             try:
                 engine = self.engine
                 queued = len(engine._queue)
@@ -1135,7 +1200,7 @@ class PagedGenerationService:
                         if e["family"].startswith(("paged.", "paged_spec."))
                     ]
                 last_compiles = compiles_now
-                recorder.record_tick(
+                tick_seq = recorder.record_tick(
                     **compile_fields,
                     replica=self.replica_id,
                     dur_ms=round(tick_dur_s * 1e3, 3),
@@ -1170,7 +1235,8 @@ class PagedGenerationService:
                 metrics.record_tick(tick_dur_s, int(active), queued + inbox)
             except Exception:  # noqa: BLE001
                 logger.debug("tick telemetry failed", exc_info=True)
-            now = time.perf_counter()
+            t_deliver_start = time.perf_counter()
+            now = t_deliver_start
             with self._mutex:
                 self._heartbeat_ts = now  # tick survived: fresh liveness
                 self._ticks += 1
@@ -1202,6 +1268,9 @@ class PagedGenerationService:
                         )
                         ticket.sent_tokens = len(slot.emitted)
                 for result in finished:
+                    # which replica produced this result, for stats sinks
+                    # and tracing spans downstream (PagedResult defaults -1)
+                    result.replica_id = self.replica_id
                     ticket = self._tickets.pop(result.request_id, None)
                     if ticket is None:
                         continue
@@ -1228,6 +1297,40 @@ class PagedGenerationService:
                     if ticket.stream_q is not None:
                         ticket.stream_q.put(("done", result))
                     ticket.event.set()
+            t_deliver_end = time.perf_counter()
+            # tick-phase decomposition (infra/phases.py): the engine's own
+            # section timings plus this pump's inbox_drain/deliver spans.
+            # Residual (the telemetry block above, mutex waits, call
+            # overhead) folds into "other", so sum(phase_ms) == pump_ms
+            # holds by CONSTRUCTION — the tier-1 conservation test pins it,
+            # and Perfetto slices built from phase_ms nest exactly inside
+            # their tick. The dict is AMENDED onto the already-recorded
+            # tick event (amend_tick restamps t_s to this span's end, the
+            # convention the Chrome exporter subtracts pump_ms from).
+            phase_s = dict(self.engine.last_step_phases)
+            phase_s["inbox_drain"] = t_drain - t_iter
+            phase_s["deliver"] = t_deliver_end - t_deliver_start
+            pump_s = t_deliver_end - t_iter
+            phase_s["other"] = phase_s.get("other", 0.0) + max(
+                pump_s - sum(phase_s.values()), 0.0
+            )
+            try:
+                if tick_seq is not None:
+                    recorder.amend_tick(
+                        tick_seq,
+                        pump_ms=round(pump_s * 1e3, 3),
+                        phase_ms=phases_to_ms(phase_s),
+                    )
+                metrics.record_tick_phases(phase_s)
+            except Exception:  # noqa: BLE001
+                logger.debug("phase telemetry failed", exc_info=True)
+            # the amend/metrics cost itself rides the duty-cycle totals as
+            # "other" (it cannot ride the record it just amended). Totals
+            # are pump-thread-owned floats; readers snapshot them
+            # GIL-atomically (see duty_cycle()).
+            phase_s["other"] += time.perf_counter() - t_deliver_end
+            for key, val in phase_s.items():
+                self._phase_totals[key] = self._phase_totals.get(key, 0.0) + val
 
     def _note_ttft_locked(self, ttft_s: float) -> None:  # lock-held: _mutex
         """Fold one observed TTFT into the EMA admission control projects

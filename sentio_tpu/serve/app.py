@@ -217,7 +217,18 @@ def _make_observability_middleware(container: DependencyContainer):
                 endpoint = "/embed" if path in ("/embed", "/upload") else "*"
                 ip = _client_ip(request, trust_proxy=container.settings.serve.trust_proxy_headers)
                 container.rate_limiter.check(ip, endpoint)
-            response = await handler(request)
+            # request-level OTel span (infra/tracing.py), joining the graph
+            # node spans under one trace. The single `enabled` bool keeps
+            # the tracing-off path free of span/context overhead.
+            from sentio_tpu.infra.tracing import get_tracing
+
+            tracing = get_tracing()
+            if tracing.enabled and work:
+                with tracing.span(f"http {request.method} {path}",
+                                  path=path, method=request.method):
+                    response = await handler(request)
+            else:
+                response = await handler(request)
             status = response.status
             return response
         except SchemaError:
@@ -737,6 +748,14 @@ def _publish_serving_gauges(container: DependencyContainer):
                   "failovers"):
         if event in stats:
             m.bump_serving_total(event, float(stats[event]))
+    # pump duty cycle (infra/phases.py): host/device/idle fractions per
+    # replica — host-fraction is THE GIL-pressure signal. A bare service
+    # exports its own replica row; a ReplicaSet exports one per member.
+    replica_rows = stats.get("replicas") or [stats]
+    for row in replica_rows:
+        duty = row.get("duty_cycle")
+        if duty:
+            m.record_duty_cycle(row.get("replica", 0), duty)
     # multi-replica tier: the aggregate keeps every dashboard working; the
     # replica-labeled gauge says WHICH replica is hot (occupancy/queue/pool
     # per replica — the signals that justify or indict the router)
@@ -777,11 +796,26 @@ async def debug_flight(request: web.Request) -> web.Response:
     """One completed (or in-flight) request's flight record: graph node
     timings joined with the engine-tick window its decode rode (occupancy,
     queue depth, prefill/decode splits, page-pool levels) plus TTFT/TPOT.
-    Auth-gated when auth is enabled — /debug is NOT in the open-paths list,
-    unlike /metrics — because records quote request shape and timing."""
+    ``?format=chrome`` returns the record's window as a Chrome/Perfetto
+    trace instead (open the JSON in ui.perfetto.dev): the tick slices with
+    their phase decomposition, the request span, and the verify verdict on
+    one timeline. Auth-gated when auth is enabled — /debug is NOT in the
+    open-paths list, unlike /metrics — because records quote request shape
+    and timing."""
     from sentio_tpu.infra.flight import get_flight_recorder
 
     request_id = request.match_info["request_id"]
+    if request.query.get("format") == "chrome":
+        from sentio_tpu.infra.chrome_trace import flight_to_chrome
+
+        trace = flight_to_chrome(request_id=request_id)
+        if trace is None:
+            raise web.HTTPNotFound(
+                text=json.dumps(
+                    {"error": f"no flight record for {request_id!r}"}),
+                content_type="application/json",
+            )
+        return web.json_response(trace)
     record = get_flight_recorder().get(request_id)
     if record is None:
         raise web.HTTPNotFound(
@@ -789,6 +823,38 @@ async def debug_flight(request: web.Request) -> web.Response:
             content_type="application/json",
         )
     return web.json_response(record)
+
+
+async def debug_profile(request: web.Request) -> web.Response:
+    """On-demand windowed XLA profiling: arm ``jax.profiler`` for
+    ``?seconds=N`` (0.1–60, default 3) and stop it, writing the device
+    trace under ``?dir=`` / ``JAX_PROFILER_DIR`` / a tmp directory. The
+    decode pump wraps every tick in a ``StepTraceAnnotation`` when tracing
+    is enabled, so the XLA timeline lines up with flight ticks by step
+    number. Single-flight (the profiler is process-global); auth-gated
+    like every /debug route. Blocking work runs on a worker thread — the
+    event loop keeps serving while the window is open."""
+    import tempfile
+
+    from sentio_tpu.infra.tracing import profile_window
+
+    try:
+        seconds = float(request.query.get("seconds", "3"))
+    except ValueError:
+        raise SchemaError([{"field": "seconds",
+                            "error": "must be a number"}]) from None
+    if not 0.1 <= seconds <= 60.0:
+        raise SchemaError([{"field": "seconds",
+                            "error": "must be within [0.1, 60]"}])
+    container: DependencyContainer = request.app["container"]
+    log_dir = (
+        request.query.get("dir")
+        or container.settings.observability.profiler_dir
+        or tempfile.mkdtemp(prefix="sentio-xla-profile-")
+    )
+    outcome = await asyncio.to_thread(profile_window, seconds, log_dir)
+    status = 200 if outcome.get("started") else 409
+    return web.json_response(outcome, status=status)
 
 
 async def auth_token(request: web.Request) -> web.Response:
@@ -846,6 +912,7 @@ def create_app(
     app.router.add_get("/metrics", metrics_endpoint)
     app.router.add_get("/metrics/performance", metrics_performance)
     app.router.add_get("/debug/flight/{request_id}", debug_flight)
+    app.router.add_get("/debug/profile", debug_profile)
     app.router.add_post("/auth/token", auth_token)
 
     async def on_startup(app: web.Application) -> None:

@@ -39,6 +39,7 @@ _SANITIZED_MODULES = {
     "test_paged",
     "test_paged_sched",
     "test_paged_spec",
+    "test_phases",
     "test_prefix_cache",
     "test_replica",
     "test_service",

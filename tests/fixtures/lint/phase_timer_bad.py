@@ -1,0 +1,40 @@
+"""Seeded violations for the phase-timer-under-lock rule: phase regions
+entered while an annotated lock is held fold lock wait/hold time into the
+open phase. The correctly-ordered method (timer outside, lock inside) and
+the lock-free region must produce nothing."""
+
+import threading
+
+
+class BadPump:
+    def __init__(self):
+        self._mutex = threading.Lock()
+        self._timer = object()
+        self._pending = []  # guarded-by: _mutex
+
+    def bad_nested(self):
+        with self._mutex:
+            with self._timer.phase("inbox_drain"):  # finding: lock held
+                self._pending.clear()
+
+    def bad_combined(self):
+        # items evaluate left to right: the lock is held when the phase
+        # region opens
+        with self._mutex, self._timer.phase("deliver"):  # finding
+            self._pending.clear()
+
+    def _sweep_locked(self):
+        # `_locked` suffix: the caller holds the lock by contract
+        with self._timer.phase("other"):  # finding
+            self._pending.clear()
+
+    def good_order(self):
+        # timer OUTSIDE the lock: the mutex wait is honestly part of the
+        # phase being measured
+        with self._timer.phase("inbox_drain"):
+            with self._mutex:
+                self._pending.clear()
+
+    def good_unlocked(self):
+        with self._timer.phase("decode_dispatch"):
+            return len([])

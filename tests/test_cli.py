@@ -49,6 +49,23 @@ class TestTrace:
         out = json.loads(capsys.readouterr().out)
         assert out["num_retrieved"] == 0
 
+    def test_trace_chrome_export(self, fake_settings, tmp_path, capsys):
+        """--chrome dumps the whole flight timeline as a Chrome/Perfetto
+        trace next to the normal JSON dump."""
+        doc = tmp_path / "doc.txt"
+        doc.write_text("TPUs pair a systolic MXU with HBM for fast matmul.")
+        out_path = tmp_path / "trace.json"
+        rc = main(["trace", "what is an MXU?", "--ingest", str(tmp_path),
+                   "--chrome", str(out_path)])
+        assert rc == 0
+        json.loads(capsys.readouterr().out)  # normal dump still intact
+        trace = json.loads(out_path.read_text())
+        assert "traceEvents" in trace
+        names = {e["name"] for e in trace["traceEvents"]}
+        # the echo provider never touches the paged engine, so there may
+        # be no ticks — but the request span must be on the timeline
+        assert any(n.startswith("request ") for n in names)
+
 
 class TestConvert:
     def test_convert_llama_dir_round_trip(self, fake_settings, tmp_path, capsys):

@@ -340,6 +340,58 @@ class TestFlightEndpoint:
 
         asyncio.run(self._with_client(self._settings(), body))
 
+    def test_chat_flight_chrome_format(self, recorder):
+        """?format=chrome returns the record's window as a Perfetto-openable
+        Chrome trace: tick slices with nested phase slices, the request
+        span, on one timeline."""
+
+        async def body(client, container):
+            await client.post("/embed", json={
+                "content": "tpus multiply matrices in a systolic array"
+            })
+            resp = await client.post("/chat", json={
+                "question": "what multiplies matrices?",
+                "thread_id": "flight-chrome-1",
+            })
+            assert resp.status == 200
+
+            chrome = await client.get(
+                "/debug/flight/flight-chrome-1?format=chrome")
+            assert chrome.status == 200
+            trace = await chrome.json()
+            events = trace["traceEvents"]
+            names = {e["name"] for e in events}
+            assert "request flight-chrome-1" in names
+            assert any(n.startswith("tick ") for n in names)
+            from sentio_tpu.infra.phases import TICK_PHASES
+
+            assert names & set(TICK_PHASES), "no phase slices on the trace"
+
+            missing = await client.get(
+                "/debug/flight/who-dis?format=chrome")
+            assert missing.status == 404
+
+        asyncio.run(self._with_client(self._settings(), body))
+
+    def test_debug_profile_window(self, recorder, tmp_path):
+        """/debug/profile arms jax.profiler for the window and reports the
+        trace directory; malformed/oversized windows 422."""
+
+        async def body(client, container):
+            resp = await client.get(
+                f"/debug/profile?seconds=0.1&dir={tmp_path}")
+            assert resp.status == 200
+            out = await resp.json()
+            assert out["started"] is True
+            assert out["log_dir"] == str(tmp_path)
+
+            bad = await client.get("/debug/profile?seconds=oops")
+            assert bad.status == 422
+            too_long = await client.get("/debug/profile?seconds=9999")
+            assert too_long.status == 422
+
+        asyncio.run(self._with_client(self._settings(), body))
+
     def test_sse_stream_records_ttft(self, recorder):
         """The SSE path must trace too: X-Request-Id names the record, and
         the paged pump stamps TTFT/TPOT for the streamed sequence."""

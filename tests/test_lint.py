@@ -71,6 +71,20 @@ class TestSeededFixtures:
         assert "timeout" in got[0].message
         assert "watchdog" in got[1].message
 
+    def test_phase_timer_fixture_exact_findings(self):
+        """Phase-timer regions (tick-phase attribution, infra/phases.py)
+        entered while an annotated lock is held: the nested form, the
+        combined with-items form, and the `_locked`-contract form all
+        fire; the timer-outside-lock ordering and the lock-free region
+        produce nothing."""
+        got = _findings("phase_timer_bad.py")
+        assert [(f.rule, f.line) for f in got] == [
+            ("phase-timer-under-lock", 17),
+            ("phase-timer-under-lock", 23),
+            ("phase-timer-under-lock", 28),
+        ]
+        assert "dedicated phase" in got[0].message
+
     def test_clock_fixture_exact_finding(self):
         got = _findings("clock_bad.py")
         assert [(f.rule, f.line) for f in got] == [("wall-clock-duration", 6)]
