@@ -736,10 +736,19 @@ def phase_load(llm_cfg, new_tokens):
     session affinity probe whose second request must report
     ``prefix_hit_tokens > 0`` on the routed replica.
 
+    ``BENCH_LOAD_MODES`` sweeps the replica ISOLATION tier: "thread" (all
+    N pumps in this process — the GIL-bound baseline) and/or "process"
+    (each replica a spawned worker process behind the RPC shim,
+    runtime/worker.py). With both, the artifact reports the GIL probe PER
+    MODE side by side: per-replica host fractions and the sustained-QPS
+    scaling ratio — the direct measurement of what escaping the GIL buys
+    (ROADMAP item 1).
+
     Env knobs: BENCH_LOAD_REPLICAS ("1,2"), BENCH_LOAD_QPS ladder
     ("2,4,8,16,32"), BENCH_LOAD_SECONDS per level (8), BENCH_LOAD_SLOTS
     per-replica decode slots (8), BENCH_LOAD_SHED_SLO (0.05),
-    BENCH_LOAD_SEED (1234)."""
+    BENCH_LOAD_SEED (1234), BENCH_LOAD_MODES ("thread" |
+    "thread,process")."""
     import random
     import threading
 
@@ -765,6 +774,10 @@ def phase_load(llm_cfg, new_tokens):
     shed_slo = float(os.environ.get("BENCH_LOAD_SHED_SLO", "0.05"))
     max_slots = int(os.environ.get("BENCH_LOAD_SLOTS", "8"))
     seed = int(os.environ.get("BENCH_LOAD_SEED", "1234"))
+    replica_modes = [m.strip().lower()
+                     for m in os.environ.get("BENCH_LOAD_MODES",
+                                             "thread").split(",")
+                     if m.strip()]
     gen_tokens = min(new_tokens, 16)
     stream_frac = 0.3
 
@@ -785,6 +798,31 @@ def phase_load(llm_cfg, new_tokens):
         for eng in engines[:n]:
             eng.reset()
         return engines[:n]
+
+    def build_replicas(mode: str, n: int) -> list:
+        """N replicas at the requested isolation tier. Thread mode reuses
+        the shared-weights in-process engines (compile once across counts);
+        process mode spawns fresh worker processes — compiles are per
+        worker by construction, which is part of what the mode costs."""
+        if mode == "process":
+            import dataclasses as _dc
+
+            from sentio_tpu.models.tokenizer import ByteTokenizer
+            from sentio_tpu.runtime.worker import ProcessReplica, WorkerSpec
+
+            spec = WorkerSpec(factory_kwargs=dict(
+                model_config=_dc.asdict(llm_cfg),
+                engine_kwargs=dict(
+                    max_slots=max_slots, page_size=16, max_pages_per_seq=8,
+                    steps_per_tick=8, max_tick_steps=8, pipeline_depth=2,
+                    ignore_eos=True,
+                ),
+            ))
+            tok = ByteTokenizer(llm_cfg.vocab_size)
+            return [ProcessReplica(spec, tok, replica_id=i,
+                                   build_timeout_s=600.0)
+                    for i in range(n)]
+        return [PagedGenerationService(eng) for eng in get_engines(n)]
 
     # 8 distinct session heads: follow-ups within one session share a
     # prefix, so affinity routing has something real to route on
@@ -921,115 +959,139 @@ def phase_load(llm_cfg, new_tokens):
                 }
         return out
 
+    def run_mode(mode: str) -> dict:
+        out: dict = {"by_replicas": {}}
+        sustained: dict[int, float] = {}
+        duty_by_count: dict[int, list[dict]] = {}
+        for n in replica_counts:
+            log(f"phase LOAD[{mode}]: building {n}-replica set ...")
+            svcs = build_replicas(mode, n)
+            rs = ReplicaSet(svcs)
+            log(f"phase LOAD[{mode}]: warmup ({n} replicas) ...")
+            t0 = time.perf_counter()
+            warm = rs.warmup(max_new_tokens=gen_tokens)
+            log(f"  warmup: {warm['prompts']} prompts, "
+                f"{warm['xla_compiles']} compiles in "
+                f"{time.perf_counter() - t0:.1f}s")
+            get_flight_recorder().clear()
+            set_metrics(MetricsCollector())  # per-count isolation
+            for svc in svcs:
+                # ladder duty windows must exclude warmup's
+                # compile-dominated ticks, which would swamp the host
+                # fraction (process mode: an RPC re-bases the worker's)
+                svc.reset_duty_cycle()
+            curve = []
+            sustained_n = 0.0
+            for qps in qps_ladder:
+                level = run_level(rs, qps, random.Random(seed))
+                curve.append(level)
+                log(f"phase LOAD[{mode}]: replicas={n} offered={qps} "
+                    f"achieved={level['achieved_qps']} "
+                    f"shed_rate={level['shed_rate']} "
+                    f"e2e_p50={level.get('e2e_ms', {}).get('p50')}ms")
+                if level["shed_rate"] <= shed_slo and level["errors"] == 0:
+                    sustained_n = max(sustained_n, level["achieved_qps"])
+            # two-turn session probe: affinity measured END TO END — the
+            # second turn must land on the replica holding turn one's KV
+            # and actually reuse it
+            probe_head = ("affinity probe session head long enough to span "
+                          "multiple sixteen token cache pages comfortably")
+            rs.generate(probe_head + " turn one", max_new_tokens=4,
+                        temperature=0.0, timeout_s=180)
+            hits_before = [s.get("prefix_hit_tokens", 0)
+                           for s in rs.stats()["replicas"]]
+            second = rs.generate(probe_head + " turn two", max_new_tokens=4,
+                                 temperature=0.0, timeout_s=180)
+            set_stats = rs.stats()
+            # the replica whose hit counter MOVED between the probe's turns
+            # is the one that actually served turn two (cumulative argmax
+            # would attribute the probe to whichever replica served the
+            # most load-phase session follow-ups)
+            probe_deltas = [
+                s.get("prefix_hit_tokens", 0) - hits_before[i]
+                for i, s in enumerate(set_stats["replicas"])
+            ]
+            # whole-ladder duty per replica (warmup excluded via the
+            # reset): in thread mode the host fraction here, times N, is
+            # the single-process GIL load; in process mode each fraction
+            # is measured inside its own worker process
+            ladder_duty = [svc.duty_cycle() for svc in svcs]
+            duty_by_count[n] = ladder_duty
+            out["by_replicas"][str(n)] = {
+                "levels": curve,
+                "sustained_qps_at_slo": sustained_n,
+                "routing": set_stats["routing"],
+                "duty_cycle_per_replica": ladder_duty,
+                "per_replica_prefix_hit_token_ratio": [
+                    s.get("prefix_hit_token_ratio", 0.0)
+                    for s in set_stats["replicas"]
+                ],
+                "affinity_probe": {
+                    "second_turn_prefix_hit_tokens":
+                        second.prefix_hit_tokens,
+                    "routed_replica": max(range(n),
+                                          key=lambda i: probe_deltas[i]),
+                },
+            }
+            sustained[n] = sustained_n
+            rs.close()
+        if len(sustained) > 1:
+            lo, hi = min(sustained), max(sustained)
+            if sustained[lo] > 0:
+                out["throughput_ratio"] = {
+                    "replicas": [lo, hi],
+                    "sustained_qps": [sustained[lo], sustained[hi]],
+                    "ratio": round(sustained[hi] / sustained[lo], 3),
+                }
+        if duty_by_count:
+            # THE GIL probe (ROADMAP item 1): per-replica host fraction at
+            # each replica count, next to the measured scaling ratio. In
+            # thread mode all N pumps share one Python process — summed
+            # host fraction approaching 1 is the quantified ceiling; in
+            # process mode each replica owns a GIL, so the honest signal
+            # is the PER-REPLICA fraction staying flat (and the scaling
+            # ratio climbing) as replicas are added.
+            out["gil_probe"] = {
+                "replica_mode": mode,
+                "host_fraction_by_replicas": {
+                    str(n): [round(d["host"], 4) for d in duties]
+                    for n, duties in duty_by_count.items()
+                },
+                "host_fraction_sum_by_replicas": {
+                    str(n): round(sum(d["host"] for d in duties), 4)
+                    for n, duties in duty_by_count.items()
+                },
+                **({"scaling_ratio": out["throughput_ratio"]["ratio"]}
+                   if "throughput_ratio" in out else {}),
+                "note": ("thread: summed host fraction ~1.0 means the "
+                         "pumps saturate one GIL; process: fractions are "
+                         "per-worker-process, one GIL each"),
+            }
+        log(f"phase LOAD[{mode}]: sustained {sustained}")
+        return out
+
     result: dict = {
         "knobs": {
             "replica_counts": replica_counts, "qps_ladder": qps_ladder,
             "level_s": level_s, "slots_per_replica": max_slots,
             "gen_tokens": gen_tokens, "stream_frac": stream_frac,
             "shed_slo": shed_slo, "seed": seed,
+            "replica_modes": replica_modes,
         },
-        "by_replicas": {},
     }
-    sustained: dict[int, float] = {}
-    duty_by_count: dict[int, list[dict]] = {}
-    for n in replica_counts:
-        log(f"phase LOAD: building {n}-replica set ...")
-        engs = get_engines(n)
-        svcs = [PagedGenerationService(eng) for eng in engs]
-        rs = ReplicaSet(svcs)
-        log(f"phase LOAD: warmup ({n} replicas) ...")
-        t0 = time.perf_counter()
-        warm = rs.warmup(max_new_tokens=gen_tokens)
-        log(f"  warmup: {warm['prompts']} prompts, "
-            f"{warm['xla_compiles']} compiles in "
-            f"{time.perf_counter() - t0:.1f}s")
-        get_flight_recorder().clear()
-        set_metrics(MetricsCollector())  # per-count isolation
-        for svc in svcs:
-            # ladder duty windows must exclude warmup's compile-dominated
-            # ticks, which would swamp the host fraction
-            svc.reset_duty_cycle()
-        curve = []
-        sustained_n = 0.0
-        for qps in qps_ladder:
-            level = run_level(rs, qps, random.Random(seed))
-            curve.append(level)
-            log(f"phase LOAD: replicas={n} offered={qps} "
-                f"achieved={level['achieved_qps']} "
-                f"shed_rate={level['shed_rate']} "
-                f"e2e_p50={level.get('e2e_ms', {}).get('p50')}ms")
-            if level["shed_rate"] <= shed_slo and level["errors"] == 0:
-                sustained_n = max(sustained_n, level["achieved_qps"])
-        # two-turn session probe: affinity measured END TO END — the second
-        # turn must land on the replica holding turn one's KV and actually
-        # reuse it
-        probe_head = ("affinity probe session head long enough to span "
-                      "multiple sixteen token cache pages comfortably")
-        rs.generate(probe_head + " turn one", max_new_tokens=4,
-                    temperature=0.0, timeout_s=180)
-        hits_before = [s.get("prefix_hit_tokens", 0)
-                       for s in rs.stats()["replicas"]]
-        second = rs.generate(probe_head + " turn two", max_new_tokens=4,
-                             temperature=0.0, timeout_s=180)
-        set_stats = rs.stats()
-        # the replica whose hit counter MOVED between the probe's turns is
-        # the one that actually served turn two (cumulative argmax would
-        # attribute the probe to whichever replica served the most
-        # load-phase session follow-ups)
-        probe_deltas = [
-            s.get("prefix_hit_tokens", 0) - hits_before[i]
-            for i, s in enumerate(set_stats["replicas"])
-        ]
-        # whole-ladder duty per replica (warmup excluded via the reset):
-        # the host fraction here, times N, is the single-process GIL load
-        ladder_duty = [svc.duty_cycle() for svc in svcs]
-        duty_by_count[n] = ladder_duty
-        result["by_replicas"][str(n)] = {
-            "levels": curve,
-            "sustained_qps_at_slo": sustained_n,
-            "routing": set_stats["routing"],
-            "duty_cycle_per_replica": ladder_duty,
-            "per_replica_prefix_hit_token_ratio": [
-                s.get("prefix_hit_token_ratio", 0.0)
-                for s in set_stats["replicas"]
-            ],
-            "affinity_probe": {
-                "second_turn_prefix_hit_tokens": second.prefix_hit_tokens,
-                "routed_replica": max(range(n),
-                                      key=lambda i: probe_deltas[i]),
-            },
-        }
-        sustained[n] = sustained_n
-        rs.close()
-    if len(sustained) > 1:
-        lo, hi = min(sustained), max(sustained)
-        if sustained[lo] > 0:
-            result["throughput_ratio"] = {
-                "replicas": [lo, hi],
-                "sustained_qps": [sustained[lo], sustained[hi]],
-                "ratio": round(sustained[hi] / sustained[lo], 3),
-            }
-    if duty_by_count:
-        # THE GIL probe (ROADMAP item 1): per-replica host fraction at each
-        # replica count, next to the measured scaling ratio. All N pumps
-        # share one Python process — summed host fraction approaching 1 is
-        # the quantified ceiling the multi-process replica tier removes.
-        result["gil_probe"] = {
-            "host_fraction_by_replicas": {
-                str(n): [round(d["host"], 4) for d in duties]
-                for n, duties in duty_by_count.items()
-            },
-            "host_fraction_sum_by_replicas": {
-                str(n): round(sum(d["host"] for d in duties), 4)
-                for n, duties in duty_by_count.items()
-            },
-            **({"scaling_ratio": result["throughput_ratio"]["ratio"]}
-               if "throughput_ratio" in result else {}),
-            "note": ("summed host fraction ~1.0 means the pumps saturate "
-                     "one GIL — the single-process scaling ceiling"),
+    by_mode = {mode: run_mode(mode) for mode in replica_modes}
+    # legacy top-level shape: the first (usually thread) mode's results
+    primary = by_mode.get("thread") or next(iter(by_mode.values()))
+    result.update(primary)
+    if len(by_mode) > 1:
+        result["by_mode"] = by_mode
+        # the mode comparison the artifact leads with: same ladder, same
+        # replica counts, thread vs process — scaling ratio and host
+        # fractions side by side
+        result["gil_probe_per_mode"] = {
+            mode: out.get("gil_probe") for mode, out in by_mode.items()
         }
     set_metrics(MetricsCollector())  # leave a clean collector behind
-    log(f"phase LOAD: sustained {sustained}")
     return result
 
 
@@ -1057,10 +1119,20 @@ def phase_chaos(llm_cfg, new_tokens):
     at quarantine instead of riding caller failover). Untyped errors are
     counted separately and should be zero.
 
+    ``BENCH_CHAOS_REPLICA_MODE=process`` runs the drill against
+    PROCESS-mode replicas (runtime/worker.py): ``kill`` becomes a real
+    mid-dispatch ``SIGKILL`` of the victim's worker process (armed inside
+    the worker via the RPC fault surface — no Python frame unwinds, the
+    supervisor must find the corpse from the outside and RESPAWN it), and
+    ``stall`` wedges the worker's pump with an in-worker stall fault
+    (recovery reaps the whole wedged process instead of abandoning a
+    thread).
+
     Env knobs: BENCH_CHAOS_QPS (8), BENCH_CHAOS_SECONDS (30),
     BENCH_CHAOS_KILL_AT_S (5), BENCH_CHAOS_SLOTS (8),
     BENCH_CHAOS_SEED (1234), BENCH_CHAOS_MODE (kill|stall),
-    BENCH_CHAOS_STALL_BUDGET_S (2)."""
+    BENCH_CHAOS_STALL_BUDGET_S (2), BENCH_CHAOS_REPLICA_MODE
+    (thread|process)."""
     import random
     import threading
 
@@ -1082,29 +1154,46 @@ def phase_chaos(llm_cfg, new_tokens):
     seed = int(os.environ.get("BENCH_CHAOS_SEED", "1234"))
     mode = os.environ.get("BENCH_CHAOS_MODE", "kill").strip().lower()
     stall_budget_s = float(os.environ.get("BENCH_CHAOS_STALL_BUDGET_S", "2"))
+    replica_mode = os.environ.get(
+        "BENCH_CHAOS_REPLICA_MODE", "thread").strip().lower()
     gen_tokens = min(new_tokens, 16)
     rng = random.Random(seed)
 
-    log(f"phase CHAOS: building 2-replica set (mode={mode}) ...")
-    e0 = ContinuousBatchingEngine(
-        model_config=llm_cfg, max_slots=max_slots, page_size=16,
-        max_pages_per_seq=8, steps_per_tick=8, max_tick_steps=8,
-        pipeline_depth=2, ignore_eos=True,
-    )
-    e1 = ContinuousBatchingEngine(
-        model_config=llm_cfg, params=e0.params, tokenizer=e0.tokenizer,
-        max_slots=max_slots, page_size=16, max_pages_per_seq=8,
-        steps_per_tick=8, max_tick_steps=8, pipeline_depth=2,
-        ignore_eos=True,
-    )
+    log(f"phase CHAOS: building 2-replica set (mode={mode}, "
+        f"replica_mode={replica_mode}) ...")
     # stall mode rests on the watchdog: the per-service stall budget must
     # exceed the slowest legitimate tick (warmup has pre-compiled, so the
     # default 2s is generous) but stay small next to the run window
     svc_kw = ({"tick_stall_budget_s": stall_budget_s}
               if mode == "stall" else {})
+    engine_kw = dict(max_slots=max_slots, page_size=16, max_pages_per_seq=8,
+                     steps_per_tick=8, max_tick_steps=8, pipeline_depth=2,
+                     ignore_eos=True)
+    if replica_mode == "process":
+        import dataclasses as _dc
+
+        from sentio_tpu.models.tokenizer import ByteTokenizer
+        from sentio_tpu.runtime.worker import ProcessReplica, WorkerSpec
+
+        spec = WorkerSpec(factory_kwargs=dict(
+            model_config=_dc.asdict(llm_cfg),
+            engine_kwargs=engine_kw,
+            service_kwargs=dict(svc_kw),
+        ))
+        tok = ByteTokenizer(llm_cfg.vocab_size)
+        replicas = [ProcessReplica(spec, tok, replica_id=i,
+                                   build_timeout_s=600.0)
+                    for i in range(2)]
+    else:
+        e0 = ContinuousBatchingEngine(model_config=llm_cfg, **engine_kw)
+        e1 = ContinuousBatchingEngine(
+            model_config=llm_cfg, params=e0.params, tokenizer=e0.tokenizer,
+            **engine_kw,
+        )
+        replicas = [PagedGenerationService(e0, **svc_kw),
+                    PagedGenerationService(e1, **svc_kw)]
     rs = ReplicaSet(
-        [PagedGenerationService(e0, **svc_kw),
-         PagedGenerationService(e1, **svc_kw)],
+        replicas,
         # fast supervision: the drill measures recovery, not poll cadence
         probe_interval_s=0.05, quarantine_backoff_s=0.25,
         breaker_tick_failures=2, failover_budget=2,
@@ -1176,7 +1265,20 @@ def phase_chaos(llm_cfg, new_tokens):
     while time.perf_counter() - t_start < run_s:
         t_rel = time.perf_counter() - t_start
         if not killed and t_rel >= kill_at_s:
-            if mode == "stall":
+            if replica_mode == "process":
+                # the fault arms INSIDE the victim's worker process via
+                # the RPC fault surface: its next decode tick either takes
+                # a REAL mid-dispatch SIGKILL (no handler, no unwinding —
+                # the supervisor must detect the corpse from the outside
+                # and respawn the process) or wedges in-worker
+                victim = replicas[1]
+                if mode == "stall":
+                    victim.inject_fault("paged.step",
+                                        stall_s=run_s + 300.0, times=1)
+                else:
+                    victim.inject_fault("paged.step", kill_process=True,
+                                        times=1)
+            elif mode == "stall":
                 # one-shot wedge: the next decode tick anywhere BLOCKS
                 # (raising nothing) until released after the run — the
                 # watchdog must find it by heartbeat age alone
@@ -1194,7 +1296,8 @@ def phase_chaos(llm_cfg, new_tokens):
                     times=1))
             t_state["kill"] = t_rel
             killed = True
-            log(f"phase CHAOS: replica {mode} armed at t={t_rel:.1f}s")
+            log(f"phase CHAOS: replica {mode} armed at t={t_rel:.1f}s "
+                f"({replica_mode})")
         prompt = f"chaos session {seq % 8:02d} steady traffic turn {seq}"
         t = threading.Thread(target=worker, args=(prompt, t_rel), daemon=True)
         t.start()
@@ -1233,6 +1336,7 @@ def phase_chaos(llm_cfg, new_tokens):
         "knobs": {"qps": qps, "run_s": run_s, "kill_at_s": kill_at_s,
                   "slots_per_replica": max_slots, "gen_tokens": gen_tokens,
                   "seed": seed, "mode": mode,
+                  "replica_mode": replica_mode,
                   **({"stall_budget_s": stall_budget_s}
                      if mode == "stall" else {})},
         **stats,
@@ -1274,6 +1378,17 @@ def phase_chaos(llm_cfg, new_tokens):
             t.name == "paged-decode-pump" and t.is_alive()
             for t in threading.enumerate()):
         time.sleep(0.05)
+    if replica_mode == "process":
+        # acceptance telemetry: close() must have REAPED every worker
+        # (SIGKILLed, wedged, and respawned alike) — orphan_workers != 0
+        # in the artifact is a failed drill
+        import multiprocessing
+
+        reap_end = time.perf_counter() + 30
+        while time.perf_counter() < reap_end and \
+                multiprocessing.active_children():
+            time.sleep(0.05)
+        out["orphan_workers"] = len(multiprocessing.active_children())
     set_metrics(MetricsCollector())
     log(f"phase CHAOS[{mode}]: availability={out['availability']} "
         f"detect={out['detection_latency_s']}s "

@@ -1,0 +1,101 @@
+"""Fork hygiene: JAX is not fork-safe — spawn or nothing.
+
+A ``fork()`` duplicates the parent's threads' locks in whatever state they
+were in at the instant of the fork — but only the forking thread survives
+into the child. JAX's runtime (PJRT client, compilation cache, collective
+launchers) is heavily threaded, so a forked child deadlocks on the first
+dispatch that touches a lock some dead thread was holding. The process-mode
+replica tier (runtime/worker.py) therefore spawns its workers, and this
+rule keeps anyone from quietly reintroducing fork semantics anywhere in the
+tree:
+
+``no-fork``
+    Fires on:
+
+    * ``os.fork()`` / ``os.forkpty()`` (also the from-imported bare names);
+    * ``get_context("fork")`` / ``get_context("forkserver")`` and
+      ``set_start_method`` with either — a forkserver parent imports jax
+      too, so it inherits the same hazard;
+    * any ``Process(...)`` / ``Pool(...)`` construction (bare or attribute
+      form): on Linux the DEFAULT multiprocessing start method is fork, so
+      every worker construction must go through an explicit spawn context
+      — and the vetted spawn-context call sites carry the inline
+      ``# lint: allow(no-fork)`` marker (runtime/worker.py is the one
+      legitimate site today).
+
+Suppression: the standard inline ``# lint: allow(no-fork)`` marker.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from sentio_tpu.analysis.findings import Finding, SourceFile
+
+__all__ = ["check_fork"]
+
+RULE = "no-fork"
+
+# direct fork syscall wrappers (attribute or from-imported name form)
+_FORK_CALLS = ("fork", "forkpty")
+
+# multiprocessing context selectors whose string argument picks the method
+_CONTEXT_CALLS = ("get_context", "set_start_method")
+
+# worker constructions that inherit the platform-DEFAULT start method
+# (fork on Linux) unless made from an explicit spawn context
+_WORKER_CALLS = ("Process", "Pool")
+
+
+def _call_name(node: ast.Call) -> str:
+    """The trailing name of the called thing: ``obj.attr(...)`` → attr,
+    ``name(...)`` → name, anything else → ''."""
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return ""
+
+
+def _first_str_arg(node: ast.Call) -> str:
+    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+    return ""
+
+
+def check_fork(tree: ast.Module, src: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        f = None
+        if name in _FORK_CALLS:
+            f = src.finding(
+                RULE, node.lineno,
+                f"{name}() forks a process whose JAX runtime threads' "
+                "locks copy in a held state — the child deadlocks on its "
+                "first dispatch; spawn a fresh interpreter instead "
+                "(runtime/worker.py)",
+            )
+        elif name in _CONTEXT_CALLS:
+            method = _first_str_arg(node)
+            if method.startswith("fork"):
+                f = src.finding(
+                    RULE, node.lineno,
+                    f"{name}({method!r}) selects a fork-based start method "
+                    "— JAX is not fork-safe; use get_context(\"spawn\")",
+                )
+        elif name in _WORKER_CALLS:
+            f = src.finding(
+                RULE, node.lineno,
+                f"{name}(...) without a vetted spawn context: the Linux "
+                "default start method is fork, which deadlocks a "
+                "JAX-initialized child — construct via "
+                "get_context(\"spawn\") and annotate the call site with "
+                "`# lint: allow(no-fork)`",
+            )
+        if f is not None:
+            findings.append(f)
+    return findings

@@ -24,6 +24,7 @@ from sentio_tpu.analysis.findings import (
     save_baseline,
 )
 from sentio_tpu.analysis.blocking import check_blocking
+from sentio_tpu.analysis.forkcheck import check_fork
 from sentio_tpu.analysis.hygiene import check_hygiene
 from sentio_tpu.analysis.locks import check_locks
 from sentio_tpu.analysis.phasing import check_phase_timer
@@ -36,7 +37,7 @@ REPO_ROOT = PACKAGE_ROOT.parent
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
 
 RULES = (check_retrace, check_locks, check_hygiene, check_blocking,
-         check_phase_timer)
+         check_phase_timer, check_fork)
 
 
 def _iter_py_files(path: Path):

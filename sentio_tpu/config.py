@@ -389,6 +389,13 @@ class ServeConfig:
     # 1 = today's single-engine behavior. On a mesh, replicas map onto
     # slices of the dp axis (REPLICAS must divide MESH_DP).
     replicas: int = 1
+    # replica isolation tier: "thread" (default — N engine+service+pump
+    # replicas inside this process, byte-compatible with every pre-process
+    # behavior) or "process" (each replica is a spawned WORKER PROCESS
+    # running its own engine+service+pump behind a thin RPC shim,
+    # runtime/worker.py — a replica death is a real OS process death, and
+    # N pumps stop contending for one GIL)
+    replica_mode: str = "thread"
     # radix-affinity stickiness: a prefix-hit replica keeps the request
     # while its backlog <= stickiness x its slot count; 0 = pure
     # least-loaded routing
@@ -441,6 +448,11 @@ class ServeConfig:
     # work) and quarantines it — must comfortably exceed the slowest
     # legitimate tick INCLUDING a cold XLA compile; 0 disables
     tick_stall_budget_s: float = 120.0
+    # watchdog stand-down bound for a replica's WARMING phase: a wedge
+    # DURING warmup quarantines (typed, supervisor-visible) once warmup
+    # has run this long, instead of hanging the spawn/rebuild path until
+    # caller timeouts fire; 0 = warmup exempt forever (pre-budget behavior)
+    warmup_budget_s: float = 600.0
     # bounded rebuild worker pool: detection cadence stays at the
     # supervisor's probe interval while rebuilds (seconds-to-minutes of
     # drain + compile, or wedged entirely) run on workers; 0 = rebuild on
@@ -475,6 +487,7 @@ class ServeConfig:
             crash_retry_budget=_env_int(["CRASH_RETRY_BUDGET"], 1),
             drain_deadline_s=_env_float(["DRAIN_DEADLINE_S"], 10.0),
             replicas=_env_int(["REPLICAS", "SENTIO_REPLICAS"], 1),
+            replica_mode=_env_str(["REPLICA_MODE"], "thread").strip().lower(),
             affinity_stickiness=_env_float(["AFFINITY_STICKINESS"], 4.0),
             route_prefix_tokens=_env_int(["ROUTE_PREFIX_TOKENS"], 512),
             tenant_weights=_env_str(["TENANT_WEIGHTS"], ""),
@@ -512,6 +525,7 @@ class ServeConfig:
                 ["REPLICA_FAILOVER_BUDGET"], 1
             ),
             tick_stall_budget_s=_env_float(["TICK_STALL_BUDGET_S"], 120.0),
+            warmup_budget_s=_env_float(["WARMUP_BUDGET_S"], 600.0),
             replica_rebuild_workers=_env_int(
                 ["REPLICA_REBUILD_WORKERS"], 1
             ),

@@ -60,6 +60,15 @@ class FaultRule:
       CALLING thread — arming it at ``paged.step`` wedges that replica's
       pump exactly like a hung device dispatch. Composes with ``error``:
       stall first, then raise (a dispatch that hangs and THEN dies).
+    * ``kill_process`` — the **crash** fault: ``SIGKILL`` the CALLING
+      process at the injection point. No handlers run, no frames unwind —
+      the strongest possible replica death, meaningful only against
+      process-mode replica workers (runtime/worker.py), whose supervisor
+      must detect the corpse from the outside. Arming it in the test
+      process itself kills the test runner; the worker RPC surface
+      (``ProcessReplica.inject_fault``) arms it in the right process.
+      Composes with ``stall_s`` (wedge, then die) but not ``error`` —
+      the process is gone before any raise.
     """
 
     error: Optional[BaseException] = None
@@ -68,6 +77,7 @@ class FaultRule:
     delay_s: float = 0.0
     stall_s: Optional[float] = None
     stall_event: Optional[threading.Event] = None
+    kill_process: bool = False
     rng: random.Random = field(default_factory=lambda: random.Random(0))
     hits: int = 0
     fired: int = 0
@@ -127,6 +137,13 @@ def hit(point: str) -> None:
         stall_event.wait(stall_s)
     elif stall_s is not None and stall_s > 0:
         time.sleep(stall_s)
+    if fire and rule.kill_process:
+        # the crash fault: this process is gone NOW — no cleanup, no
+        # flushing, exactly what a kernel OOM-kill or node loss looks like
+        import os
+        import signal
+
+        os.kill(os.getpid(), signal.SIGKILL)
     if delay > 0:
         time.sleep(delay)
     if error is not None:

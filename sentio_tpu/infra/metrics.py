@@ -263,6 +263,16 @@ class MetricsCollector:
                          0.025, 0.05, 0.1, 0.25, 0.5, 1, 5),
                 registry=r,
             ),
+            # process-mode replica tier (runtime/worker.py): worker
+            # process deaths observed by the router-side shim (SIGKILL,
+            # OOM-kill, crash, broken RPC pipe). A steadily increasing
+            # rate means the supervisor is respawn-looping a replica —
+            # monitoring.yaml's SentioTpuReplicaWorkerDead alerts on it
+            "worker_deaths": Counter(
+                "sentio_tpu_replica_worker_deaths",
+                "replica worker process deaths observed by the router",
+                ["replica"], registry=r,
+            ),
         }
 
     # ------------------------------------------------------------- recording
@@ -437,6 +447,18 @@ class MetricsCollector:
         gauge = self._prom.get("pump_heartbeat_age")
         if gauge is not None:
             gauge.labels(replica=str(replica)).set(age_s)
+
+    def record_worker_death(self, replica: int) -> None:
+        """One replica worker PROCESS death (process-mode replica tier,
+        runtime/worker.py) — observed via broken RPC pipe, a false
+        ``proc.is_alive()``, or an explicit chaos SIGKILL. Counted once
+        per corpse by the router-side shim's death latch."""
+        if not self.enabled:
+            return
+        self.memory.inc("worker_deaths", (str(replica),))
+        counter = self._prom.get("worker_deaths")
+        if counter is not None:
+            counter.labels(str(replica)).inc()
 
     def record_replica_health(self, replica: int, state: str) -> None:
         """Publish one replica's health-state transition: the new state's

@@ -175,7 +175,7 @@ def sweep_stale_tmp(base: Path) -> None:
 
 
 def load_pytree(
-    path: str | Path, shardings: Any = None
+    path: str | Path, shardings: Any = None, mmap: bool = False
 ) -> tuple[Any, dict]:
     """Read a checkpoint directory → (tree, meta).
 
@@ -183,6 +183,15 @@ def load_pytree(
     are ``jax.sharding.Sharding``s (or None); matching leaves are device_put
     through their sharding so restore lands directly in the distributed
     layout.
+
+    ``mmap=True`` memory-maps each leaf **in place inside arrays.npz**
+    instead of copying it onto the heap: ``np.savez`` stores members
+    uncompressed (ZIP_STORED), so every ``.npy`` payload is a contiguous
+    byte range of the zip that ``np.memmap`` can map read-only. N replica
+    worker processes loading the same checkpoint then share ONE page-cache
+    copy of the weights per host (runtime/worker.py's weight-sharing
+    model) rather than N private heap copies. Falls back to the copying
+    path for any member that is not plainly mappable.
     """
     path = Path(path)
     mf_path = path / "manifest.json"
@@ -193,10 +202,14 @@ def load_pytree(
         raise CheckpointError(
             f"unsupported checkpoint format {manifest.get('format_version')}"
         )
-    with np.load(path / "arrays.npz", allow_pickle=False) as z:
-        flat: dict[str, np.ndarray] = {}
+    npz = path / "arrays.npz"
+    flat: dict[str, np.ndarray] = {}
+    mapped: dict[str, np.ndarray] = _mmap_npz_members(npz) if mmap else {}
+    with np.load(npz, allow_pickle=False) as z:
         for slot, key in manifest["keys"].items():
-            arr = z[slot]
+            arr = mapped.get(slot)
+            if arr is None:
+                arr = z[slot]
             true_dtype = manifest["dtypes"][key]
             if true_dtype == "bfloat16" and arr.dtype == np.uint16:
                 import ml_dtypes
@@ -208,6 +221,48 @@ def load_pytree(
     if shardings is not None:
         tree = _apply_shardings(tree, shardings)
     return tree, manifest.get("meta", {})
+
+
+def _mmap_npz_members(npz_path: Path) -> dict[str, np.ndarray]:
+    """Read-only ``np.memmap`` views over the uncompressed ``.npy`` members
+    of an npz: {slot: array}. Each member's payload offset comes from its
+    LOCAL zip header (the central directory's extra field can differ), and
+    its shape/dtype from the standard npy header. Members that are
+    compressed, fortran-ordered, or otherwise surprising are simply
+    omitted — the caller copy-loads those."""
+    out: dict[str, np.ndarray] = {}
+    try:
+        with zipfile.ZipFile(npz_path) as zf, open(npz_path, "rb") as f:
+            for info in zf.infolist():
+                if info.compress_type != zipfile.ZIP_STORED:
+                    continue
+                f.seek(info.header_offset)
+                hdr = f.read(30)
+                if len(hdr) < 30 or hdr[:4] != b"PK\x03\x04":
+                    continue
+                name_len = int.from_bytes(hdr[26:28], "little")
+                extra_len = int.from_bytes(hdr[28:30], "little")
+                payload_off = info.header_offset + 30 + name_len + extra_len
+                f.seek(payload_off)
+                try:
+                    # _read_array_header is numpy-private: a release that
+                    # renames it must degrade to the copy-load path
+                    # (AttributeError), not fail every worker's spawn
+                    version = np.lib.format.read_magic(f)
+                    shape, fortran, dtype = \
+                        np.lib.format._read_array_header(f, version)
+                except (ValueError, OSError, AttributeError):
+                    continue
+                if fortran or dtype.hasobject:
+                    continue
+                data_off = f.tell()
+                slot = info.filename[:-4] if info.filename.endswith(".npy") \
+                    else info.filename
+                out[slot] = np.memmap(npz_path, dtype=dtype, mode="r",
+                                      offset=data_off, shape=shape)
+    except (OSError, zipfile.BadZipFile):
+        return {}
+    return out
 
 
 def _apply_shardings(tree: Any, shardings: Any) -> Any:

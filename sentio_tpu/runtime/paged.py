@@ -1213,11 +1213,15 @@ class ContinuousBatchingEngine:
         completed this tick."""
         if self._san is not None:
             self._san.enter("step")
+        # the timer resets BEFORE the injection point: whatever a failed
+        # step leaves in the accumulator belongs to THIS step alone, so the
+        # pump's crash-path flush (partial_step_phases) can never re-count
+        # the previous tick's already-recorded phases
+        acc = self._phase.acc
+        self._phase.reset()
         # chaos-drill injection point: a raised fault propagates exactly like
         # a real failed device dispatch (the serving pump resets + requeues)
         faults.hit("paged.step")
-        acc = self._phase.acc
-        self._phase.reset()
         t0 = time.perf_counter()
         self.last_tick_active = 0
         self._admit()
@@ -1255,6 +1259,15 @@ class ContinuousBatchingEngine:
         acc["other"] += time.perf_counter() - t_harvest
         self.last_step_phases = dict(acc)
         return out
+
+    def partial_step_phases(self) -> dict:
+        """Live (possibly mid-step) phase accumulations. When ``step()``
+        raises, ``last_step_phases`` still holds the PREVIOUS tick's
+        decomposition — the pump's crash-containment path reads these
+        partials instead, so a failed iteration's wall time is attributed
+        rather than holed (the timer reset at step entry guarantees they
+        cover only the failed step)."""
+        return dict(self._phase.acc)
 
     # -------------------------------------------------------------- private
 

@@ -93,6 +93,19 @@ Threading: routing probes (``peek_prefix``, ``backlog``, ``projected_wait``)
 are advisory reads against live replicas; all ReplicaSet/TenantFairQueue
 mutable state sits behind one mutex held only for quick bookkeeping — never
 across a generate call, a device tick, or a rebuild.
+
+**Process-mode replicas** — everything above is duck-typed against the
+service surface, so a :class:`~sentio_tpu.runtime.worker.ProcessReplica`
+(one spawned worker process per replica, ``REPLICA_MODE=process``) slots
+into the set unchanged: load/liveness probes (``backlog``,
+``projected_wait``, ``broken``) read its pushed status frames, the
+prefix-affinity probe is a short-timeout RPC that skips wedged workers
+(a stale status frame reads as a cold cache), the watchdog reads the
+worker's own pump heartbeat, quarantine abandons
+via RPC, and the rebuild path respawns the process (``respawn()``)
+instead of swapping an in-process service. See runtime/worker.py for the
+deliberate semantic deltas (no cross-process inbox handoff; worker
+compiles outside the router's fence).
 """
 
 from __future__ import annotations
@@ -1215,12 +1228,18 @@ class ReplicaSet:
         """In-place rebuild of a quarantined replica: fresh engine + pool +
         radix + pump from the shared weights, re-warmed, then swapped back
         into rotation. Runs on the supervisor thread (or a test driver) —
-        never under ``_mutex``, since it compiles and decodes."""
+        never under ``_mutex``, since it compiles and decodes.
+
+        Process-mode replicas (runtime/worker.py) duck-type the rebuild: a
+        replica exposing ``respawn()`` is rebuilt by SPAWNING A FRESH WORKER
+        PROCESS from the same spec instead of constructing an in-process
+        engine+service — the backoff, warm-before-swap, and health
+        bookkeeping are identical either way."""
         with self._mutex:
             attempt = self._health[idx].rebuild_attempts + 1
             self._health[idx].rebuild_inflight = True
         self._transition(idx, HEALTH_REBUILDING, f"rebuild attempt {attempt}")
-        fresh: Optional[PagedGenerationService] = None
+        fresh = None
         try:
             faults.hit("replica.rebuild")
             old = self._services[idx]
@@ -1236,19 +1255,28 @@ class ReplicaSet:
                 except Exception:  # noqa: BLE001 — drain is best-effort
                     logger.warning("replica %d pre-rebuild drain failed",
                                    idx, exc_info=True)
-            engine = old.engine.spawn_fresh()
-            guard = getattr(engine, "_san", None)
-            if guard is not None:
-                guard.name = f"ContinuousBatchingEngine[r{idx}]"
-            fresh = PagedGenerationService(
-                engine,
-                default_timeout_s=old.default_timeout_s,
-                max_queue=old.max_queue,
-                default_deadline_s=old.default_deadline_s,
-                retry_budget=old.retry_budget,
-                replica_id=idx,
-                tick_stall_budget_s=old.tick_stall_budget_s,
-            )
+            respawn = getattr(old, "respawn", None)
+            if respawn is not None:
+                # process mode: the dead worker is reaped (drain → close
+                # above SIGKILLs stragglers) and a fresh process takes the
+                # slot; its cold compiles happen in the WORKER, outside the
+                # router's compile fence
+                fresh = respawn()
+            else:
+                engine = old.engine.spawn_fresh()
+                guard = getattr(engine, "_san", None)
+                if guard is not None:
+                    guard.name = f"ContinuousBatchingEngine[r{idx}]"
+                fresh = PagedGenerationService(
+                    engine,
+                    default_timeout_s=old.default_timeout_s,
+                    max_queue=old.max_queue,
+                    default_deadline_s=old.default_deadline_s,
+                    retry_budget=old.retry_budget,
+                    replica_id=idx,
+                    tick_stall_budget_s=old.tick_stall_budget_s,
+                    warmup_budget_s=getattr(old, "warmup_budget_s", 600.0),
+                )
             self._warm_rebuilt(fresh)
             if self._stop.is_set():
                 # the set is shutting down: never swap a live pump into a

@@ -164,6 +164,7 @@ class PagedGenerationService:
         retry_budget: int = 1,
         replica_id: int = 0,
         tick_stall_budget_s: float = 120.0,
+        warmup_budget_s: float = 600.0,
     ) -> None:
         self.engine = engine
         self.default_timeout_s = default_timeout_s
@@ -191,6 +192,15 @@ class PagedGenerationService:
         # exceed the slowest legitimate tick INCLUDING a cold XLA compile;
         # 0 disables stall detection for this service.
         self.tick_stall_budget_s = max(float(tick_stall_budget_s), 0.0)
+        # watchdog stand-down bound for WARMING: warmup ticks legitimately
+        # run cold XLA compiles far past any sane stall budget, so the
+        # heartbeat watchdog is exempted while ``_warming`` — but the
+        # exemption EXPIRES after this many seconds, or a wedge DURING
+        # warmup would only ever be caught by caller timeouts and hang the
+        # spawn/rebuild path for minutes. Must comfortably exceed the
+        # slowest legitimate full warmup sweep; 0 = exempt forever (the
+        # pre-budget behavior).
+        self.warmup_budget_s = max(float(warmup_budget_s), 0.0)
         # inbox + bookkeeping ONLY, never device work
         self._mutex = make_lock("PagedGenerationService._mutex")
         self._inbox: list[_Ticket] = []  # guarded-by: _mutex
@@ -216,9 +226,10 @@ class PagedGenerationService:
         # latched by abandon(): the replica layer gave up on a wedged pump
         self._abandoned = False  # guarded-by: _mutex
         # warmup in progress: ticks legitimately run cold XLA compiles far
-        # past any sane stall budget, so the watchdog stands down (a
-        # genuinely wedged warmup is still bounded by its generate timeouts)
+        # past any sane stall budget, so the watchdog stands down — until
+        # warmup_budget_s expires (see above)
         self._warming = False  # guarded-by: _mutex
+        self._warming_since = 0.0  # guarded-by: _mutex
         # EMA of recent TTFT seconds, updated by the pump — the projected-
         # wait estimate admission control weighs against a deadline
         self._ttft_ema = 0.0  # guarded-by: _mutex
@@ -521,8 +532,22 @@ class PagedGenerationService:
         that raises nothing — the watchdog's only observable for the hang
         fault class."""
         with self._mutex:
-            if not self._pump_running or self._abandoned or self._warming:
+            if not self._pump_running or self._abandoned:
                 return None
+            if self._warming:
+                # warmup stand-down — bounded by warmup_budget_s: past the
+                # budget a stale heartbeat with pending work reads as a
+                # stalled WARMUP, the blind spot the budget exists to close
+                # (without it, a wedge during warmup hangs the spawn or
+                # rebuild path until caller timeouts fire)
+                over_budget = (
+                    self.warmup_budget_s > 0
+                    and self._warming_since > 0.0
+                    and time.perf_counter() - self._warming_since
+                    > self.warmup_budget_s
+                )
+                if not over_budget:
+                    return None
             if not self._inbox and not self._tickets:
                 return None
             if self._heartbeat_ts <= 0.0:
@@ -806,6 +831,7 @@ class PagedGenerationService:
                 "pump_leaked": self._pump_leaked,
                 "abandoned": int(self._abandoned),
                 "tick_stall_budget_s": self.tick_stall_budget_s,
+                "warmup_budget_s": self.warmup_budget_s,
                 # tick-phase attribution: cumulative seconds per phase and
                 # the host/device/idle duty cycle over the current window
                 # (bench diffs phase_seconds snapshots for per-level duty)
@@ -839,13 +865,18 @@ class PagedGenerationService:
         with self._mutex:
             # stall watchdog stands down for the duration: warmup ticks
             # include multi-second cold compiles that would otherwise read
-            # as a wedged pump (heartbeat stale + pending work)
+            # as a wedged pump (heartbeat stale + pending work). The
+            # stand-down expires at warmup_budget_s (heartbeat_age) so a
+            # wedge DURING warmup still quarantines instead of hanging the
+            # spawn/rebuild path.
             self._warming = True
+            self._warming_since = time.perf_counter()
         try:
             return self._warmup_impl(max_new_tokens)
         finally:
             with self._mutex:
                 self._warming = False
+                self._warming_since = 0.0
 
     def _warmup_impl(self, max_new_tokens: int) -> dict:
         import threading
@@ -1079,8 +1110,44 @@ class PagedGenerationService:
                     finished = self.engine.step()
                 tick_dur_s = time.perf_counter() - t_drain
             except Exception:
+                t_fail = time.perf_counter()
                 logger.exception(
                     "paged decode tick failed; attempting crash containment")
+                # flush the FAILED iteration's partial phase snapshot
+                # (residual folded into "other"): the success path's
+                # record/amend never runs on this branch, and without the
+                # flush a chaos round's Perfetto trace holes every failed
+                # tick and the duty-cycle gauge under-counts host time.
+                # sum(phase_ms) == pump_ms holds here too, by construction.
+                try:
+                    # full bounded key shape (zeros included): the tier-1
+                    # conservation gate pins phase_ms records to exactly
+                    # TICK_PHASES, failed ticks included
+                    phase_s = dict.fromkeys(TICK_PHASES, 0.0)
+                    partial = getattr(
+                        self.engine, "partial_step_phases", dict)() or {}
+                    for key, val in partial.items():
+                        if key in phase_s:
+                            phase_s[key] = val
+                    phase_s["inbox_drain"] = t_drain - t_iter
+                    pump_s = t_fail - t_iter
+                    phase_s["other"] = phase_s.get("other", 0.0) + max(
+                        pump_s - sum(phase_s.values()), 0.0
+                    )
+                    recorder.record_tick(
+                        event="tick_failure", replica=self.replica_id,
+                        dur_ms=round((t_fail - t_drain) * 1e3, 3),
+                        pump_ms=round(pump_s * 1e3, 3),
+                        phase_ms=phases_to_ms(phase_s),
+                    )
+                    metrics.record_tick_phases(phase_s)
+                    for key, val in phase_s.items():
+                        self._phase_totals[key] = (
+                            self._phase_totals.get(key, 0.0) + val
+                        )
+                except Exception:  # noqa: BLE001 — telemetry best-effort
+                    logger.debug("failed-tick phase telemetry failed",
+                                 exc_info=True)
                 # the failed dispatch may have consumed the donated pool
                 # buffers and left slots half-admitted — rebuild the decode
                 # state so the NEXT request gets a working engine instead of

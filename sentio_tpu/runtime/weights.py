@@ -43,13 +43,16 @@ def load_model(
     checkpoint_path: str,
     expect_family: Optional[str] = None,
     tokenizer_path: str = "",
+    mmap: bool = False,
 ) -> tuple[Any, Any, Optional[Any]]:
     """→ (params, model_config, tokenizer|None) from a ``cli convert`` /
     ``save_pytree`` checkpoint. The meta's recorded config reconstructs the
     exact dataclass the weights were converted for — a preset mismatch
-    cannot silently produce shape errors deep in the first forward pass."""
+    cannot silently produce shape errors deep in the first forward pass.
+    ``mmap=True`` memory-maps the param leaves in place (process-mode
+    replica workers share one page-cache copy per host)."""
     try:
-        params, meta = load_pytree(checkpoint_path)
+        params, meta = load_pytree(checkpoint_path, mmap=mmap)
     except CheckpointError as exc:
         raise WeightsError(f"cannot load checkpoint {checkpoint_path!r}: {exc}") from exc
 

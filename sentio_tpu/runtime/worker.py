@@ -1,0 +1,1004 @@
+"""Process-level replica workers: one engine+service+pump per OS process.
+
+The thread-mode ReplicaSet (runtime/replica.py) made the replica a complete
+*logical* failure domain — health state machine, breakers, watchdog, inbox
+handoff — but all N pumps share one Python process, so a "replica kill" is
+an injected exception and N dispatches contend for one GIL (BENCH_r08's GIL
+probe measured a 0.978 scaling ratio at 1→2 in-process replicas). This
+module promotes the replica to a real **OS-level** failure domain, the way
+production inference stacks isolate engine crashes from the frontend
+(vLLM's engine-per-process serving, Orca-style continuous-batching
+workers):
+
+* :func:`worker_main` runs in a child process (**spawn** start method —
+  JAX is not fork-safe: a fork duplicates its runtime threads' locks in a
+  held state and the child deadlocks on the first dispatch) and owns a
+  private ``ContinuousBatchingEngine`` + ``PagedGenerationService`` +
+  pump thread. It serves a small RPC protocol over the spawn pipe
+  (``multiprocessing.Pipe`` — length-prefixed pickle frames) and pushes
+  unsolicited **status frames** (heartbeat age, backlog, breaker signals)
+  at a fixed cadence so the router's supervisor probes never pay an RPC
+  round trip.
+* :class:`ProcessReplica` is the router-side shim: it presents the same
+  ``generate / generate_stream / check_admission / peek_prefix / warmup /
+  drain / stats / close`` surface as a ``PagedGenerationService``, so
+  ``ReplicaSet`` routing, WFQ, affinity, health supervision, and failover
+  drive it **unchanged**. Streaming arrives as incremental token frames;
+  worker death (``SIGKILL``, OOM-kill, crash) surfaces as broken-pipe /
+  ``proc.is_alive()`` and every in-flight RPC fails with a typed
+  :class:`ReplicaUnavailable` — callers spend their normal failover
+  budget, exactly as if an in-process replica had latched broken.
+* the supervisor rebuilds a dead replica by **respawning the process**
+  (:meth:`ProcessReplica.respawn` — the ``ReplicaSet._rebuild`` path
+  duck-types it), with the existing exponential backoff and rebuild
+  worker pool carrying over.
+* weights are mapped **once per host**: a checkpoint loaded with
+  ``load_pytree(..., mmap=True)`` memory-maps the uncompressed ``.npy``
+  members of ``arrays.npz`` in place, so N workers reading the same
+  checkpoint share the page cache instead of holding N private host
+  copies (runtime/checkpoint.py stores ``np.savez`` zips uncompressed
+  precisely so this works).
+
+Deliberate semantic deltas from thread mode, all documented here:
+
+* **no cross-process inbox handoff** — a dead worker's never-dispatched
+  tickets live in its process; their callers' blocked RPCs fail typed and
+  ride the normal failover budget instead of the zero-cost handoff
+  (:meth:`ProcessReplica.extract_inbox` returns ``[]``).
+* **stream cancellation propagates at chunk granularity** — closing the
+  router-side iterator sends a cancel frame; the worker notices between
+  token frames, so an abandoned stream decodes at most one more chunk.
+* **compile fences are per-process** — worker compiles never trip the
+  router's fence; ``set_fence_exempt`` on the engine facade is a no-op.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import queue as _queue
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Optional
+
+from sentio_tpu.infra.exceptions import (
+    DeadlineExceededError,
+    ReplicaUnavailable,
+    SentioError,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "WorkerSpec",
+    "ProcessReplica",
+    "worker_main",
+    "default_service_factory",
+    "REPLICA_MODE_THREAD",
+    "REPLICA_MODE_PROCESS",
+]
+
+REPLICA_MODE_THREAD = "thread"
+REPLICA_MODE_PROCESS = "process"
+
+# worker → router frame kinds (req_id 0 is reserved for unsolicited frames)
+_F_READY = "ready"
+_F_STATUS = "status"
+_F_OK = "ok"
+_F_ERR = "err"
+_F_TOK = "tok"
+_F_END = "end"
+
+
+@dataclass(frozen=True)
+class WorkerSpec:
+    """Everything a spawned worker needs to build its replica. Must be
+    picklable: the spawn start method ships it through the process pipe.
+
+    ``factory`` is a ``"module:function"`` path resolved **inside the
+    worker** — it returns a ready ``PagedGenerationService``. The default
+    (:func:`default_service_factory`) builds a llama/moe engine from a
+    checkpoint path (mmap-shared across workers) or a seeded random init;
+    tests point it at tiny configs through ``factory_kwargs``."""
+
+    factory: str = "sentio_tpu.runtime.worker:default_service_factory"
+    factory_kwargs: dict = field(default_factory=dict)
+    # cadence of unsolicited status frames (the router-side supervisor's
+    # probe source); also bounds how stale a liveness read can be
+    status_interval_s: float = 0.1
+
+
+def _resolve_factory(path: str):
+    import importlib
+
+    mod_name, _, fn_name = path.partition(":")
+    if not fn_name:
+        raise ValueError(f"factory {path!r} is not 'module:function'")
+    return getattr(importlib.import_module(mod_name), fn_name)
+
+
+def default_service_factory(
+    model_family: str = "llama",
+    model_config: Optional[dict] = None,
+    checkpoint_path: str = "",
+    tokenizer_path: str = "",
+    draft_checkpoint_path: str = "",
+    rng_seed: int = 0,
+    engine_kwargs: Optional[dict] = None,
+    service_kwargs: Optional[dict] = None,
+    warm_prefix_text: str = "",
+) -> Any:
+    """Build the worker's engine+service. With a ``checkpoint_path`` the
+    params are loaded **memory-mapped** so sibling workers on the same host
+    share one page-cache copy; without one, a seeded random init keeps all
+    replicas' weights identical (the test / offline-dev mode). A
+    ``draft_checkpoint_path`` arms paged speculation inside the worker —
+    the draft loads here, in the worker process, mmap-shared like the
+    target weights."""
+    from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+    from sentio_tpu.runtime.service import PagedGenerationService
+
+    params = tokenizer = None
+    cfg = None
+    if checkpoint_path:
+        from sentio_tpu.runtime.weights import load_model
+
+        params, cfg, tokenizer = load_model(
+            checkpoint_path,
+            expect_family=model_family,
+            tokenizer_path=tokenizer_path,
+            mmap=True,
+        )
+    elif model_config is not None:
+        if model_family == "moe":
+            from sentio_tpu.models.moe import MoeConfig
+
+            cfg = MoeConfig(**model_config)
+        else:
+            from sentio_tpu.models.llama import LlamaConfig
+
+            cfg = LlamaConfig(**model_config)
+    engine_kwargs = dict(engine_kwargs or {})
+    if draft_checkpoint_path:
+        from sentio_tpu.runtime.weights import load_model
+
+        draft_params, draft_cfg, _ = load_model(
+            draft_checkpoint_path, expect_family="llama", mmap=True,
+        )
+        engine_kwargs.setdefault("draft_params", draft_params)
+        engine_kwargs.setdefault("draft_config", draft_cfg)
+    engine = ContinuousBatchingEngine(
+        model_config=cfg,
+        params=params,
+        tokenizer=tokenizer,
+        rng_seed=rng_seed,
+        **engine_kwargs,
+    )
+    if warm_prefix_text:
+        engine.warm_prefix(warm_prefix_text)
+    return PagedGenerationService(engine, **(service_kwargs or {}))
+
+
+# --------------------------------------------------------------------------
+# exception codec: typed errors must survive the process boundary
+
+def _encode_exc(exc: BaseException) -> dict:
+    data = {
+        "cls": type(exc).__name__,
+        "module": type(exc).__module__,
+        "message": str(exc),
+    }
+    if isinstance(exc, SentioError):
+        data.update(
+            status=exc.status,
+            details=exc.details,
+            retryable=exc.retryable,
+            code=exc.code.value,
+        )
+    return data
+
+
+def _decode_exc(data: dict) -> BaseException:
+    """Rebuild the worker's exception router-side. SentioError subclasses
+    reconstruct with their full wire surface (status / details /
+    retry_after_s) so HTTP mapping and failover logic behave identically;
+    the service's own GenerationTimeout and common builtins round-trip by
+    name; anything else degrades to RuntimeError carrying the original
+    type — a worker *bug* must not masquerade as a retryable 503."""
+    from sentio_tpu.infra import exceptions as exc_mod
+    from sentio_tpu.runtime.service import GenerationTimeout
+
+    name, message = data.get("cls", ""), data.get("message", "")
+    cls = getattr(exc_mod, name, None)
+    if isinstance(cls, type) and issubclass(cls, exc_mod.SentioError):
+        err = cls.__new__(cls)
+        Exception.__init__(err, message)
+        err.message = message
+        err.status = data.get("status", 500)
+        err.details = data.get("details") or {}
+        err.retryable = bool(data.get("retryable", False))
+        err.error_id = ""
+        err.timestamp = 0.0
+        try:
+            err.code = exc_mod.ErrorCode(data.get("code", cls.code.value))
+        except ValueError:
+            pass
+        return err
+    if name == "GenerationTimeout":
+        return GenerationTimeout(message)
+    import builtins
+
+    builtin = getattr(builtins, name, None)
+    if isinstance(builtin, type) and issubclass(builtin, Exception):
+        try:
+            return builtin(message)
+        except Exception:  # noqa: BLE001 — odd constructor signature
+            pass
+    return RuntimeError(f"worker raised {name}: {message}")
+
+
+# --------------------------------------------------------------------------
+# worker side
+
+class _WorkerServer:
+    """Runs inside the child process: one recv loop dispatching RPC frames
+    to handler threads, a status thread pushing liveness, a send lock
+    (Connection.send is not thread-safe)."""
+
+    def __init__(self, conn, spec: WorkerSpec) -> None:
+        self.conn = conn
+        self.spec = spec
+        self.svc = None
+        self._send_lock = threading.Lock()
+        self._stop = threading.Event()
+        # stream cancellation flags by req_id (checked between token frames)
+        self._cancelled: set[int] = set()
+        self._cancel_lock = threading.Lock()
+
+    def _send(self, req_id: int, kind: str, payload: Any) -> None:
+        with self._send_lock:
+            try:
+                self.conn.send((req_id, kind, payload))
+            except (BrokenPipeError, OSError):
+                # router gone: nothing to report to; shut down
+                self._stop.set()
+
+    # ------------------------------------------------------------- handlers
+
+    def _status_loop(self) -> None:
+        interval = max(self.spec.status_interval_s, 0.02)
+        while not self._stop.wait(interval):
+            svc = self.svc
+            if svc is None:
+                continue
+            try:
+                status = {
+                    "heartbeat_age": svc.heartbeat_age(),
+                    "backlog": svc.backlog(),
+                    "projected_wait": svc.projected_wait(),
+                    "broken": svc.broken,
+                    "closed": svc.closed,
+                    "tick_failure_count": svc.tick_failure_count,
+                    "pump_leaked": svc.pump_leaked_count,
+                    "duty_cycle": svc.duty_cycle(),
+                    "pid": os.getpid(),
+                }
+            except Exception:  # noqa: BLE001 — status is best-effort
+                continue
+            self._send(0, _F_STATUS, status)
+
+    def _handle(self, req_id: int, method: str, kwargs: dict) -> None:
+        svc = self.svc
+        try:
+            if method == "generate":
+                self._send(req_id, _F_OK, svc.generate(**kwargs))
+            elif method == "stream_open":
+                self._handle_stream(req_id, kwargs)
+            elif method == "check_admission":
+                rel = kwargs.get("deadline_rel_s")
+                svc.check_admission(
+                    time.perf_counter() + rel if rel is not None else None
+                )
+                self._send(req_id, _F_OK, None)
+            elif method == "peek_prefix":
+                self._send(req_id, _F_OK,
+                           svc.engine.peek_prefix(kwargs["toks"]))
+            elif method == "stats":
+                self._send(req_id, _F_OK, svc.stats())
+            elif method == "warmup":
+                self._send(req_id, _F_OK, svc.warmup(**kwargs))
+            elif method == "drain":
+                self._send(req_id, _F_OK, svc.drain(**kwargs))
+            elif method == "abandon":
+                svc.abandon(kwargs.get("reason", "abandoned by router"))
+                self._send(req_id, _F_OK, None)
+            elif method == "duty_cycle":
+                self._send(req_id, _F_OK, svc.duty_cycle())
+            elif method == "reset_duty_cycle":
+                svc.reset_duty_cycle()
+                self._send(req_id, _F_OK, None)
+            elif method == "inject_fault":
+                from sentio_tpu.infra import faults
+
+                point = kwargs.pop("point")
+                faults.arm(point, faults.FaultRule(**kwargs))
+                self._send(req_id, _F_OK, None)
+            elif method == "reset_faults":
+                from sentio_tpu.infra import faults
+
+                faults.reset()
+                self._send(req_id, _F_OK, None)
+            elif method == "ping":
+                self._send(req_id, _F_OK, os.getpid())
+            else:
+                raise ValueError(f"unknown worker method {method!r}")
+        except BaseException as exc:  # noqa: BLE001 — everything goes typed  # lint: allow(baseexception-swallow) — converted to a typed wire frame
+            self._send(req_id, _F_ERR, _encode_exc(exc))
+
+    def _handle_stream(self, req_id: int, kwargs: dict) -> None:
+        """Token frames for one stream. The iterator is created (call-time
+        validation) BEFORE the ok frame, so the router-side caller sees
+        validation errors synchronously — the SSE pre-200 contract."""
+        stats_out: dict = {}
+        it = self.svc.generate_stream(stats_out=stats_out, **kwargs)
+        self._send(req_id, _F_OK, None)
+        try:
+            for piece in it:
+                with self._cancel_lock:
+                    if req_id in self._cancelled:
+                        self._cancelled.discard(req_id)
+                        it.close()  # marks the ticket cancelled in finally
+                        return
+                self._send(req_id, _F_TOK, piece)
+            self._send(req_id, _F_END, stats_out)
+        except BaseException as exc:  # noqa: BLE001  # lint: allow(baseexception-swallow) — converted to a typed wire frame
+            self._send(req_id, _F_ERR, _encode_exc(exc))
+        finally:
+            with self._cancel_lock:
+                self._cancelled.discard(req_id)
+
+    # ----------------------------------------------------------------- main
+
+    def run(self) -> None:
+        try:
+            factory = _resolve_factory(self.spec.factory)
+            self.svc = factory(**self.spec.factory_kwargs)
+        except BaseException as exc:  # noqa: BLE001 — report, then die  # lint: allow(baseexception-swallow) — reported as a typed wire frame
+            self._send(0, _F_ERR, _encode_exc(exc))
+            return
+        eng = self.svc.engine
+        self._send(0, _F_READY, {
+            "pid": os.getpid(),
+            "page_size": eng.page_size,
+            "max_slots": eng.max_slots,
+            "max_queue": self.svc.max_queue,
+            "default_timeout_s": self.svc.default_timeout_s,
+            "default_deadline_s": self.svc.default_deadline_s,
+            "retry_budget": self.svc.retry_budget,
+            "tick_stall_budget_s": self.svc.tick_stall_budget_s,
+        })
+        status = threading.Thread(target=self._status_loop,
+                                  name="worker-status", daemon=True)
+        status.start()
+        while not self._stop.is_set():
+            try:
+                frame = self.conn.recv()
+            except (EOFError, OSError):
+                break  # router died or closed: shut down with it
+            except pickle.UnpicklingError:
+                logger.exception("worker dropped an undecodable frame")
+                continue
+            req_id, method, kwargs = frame
+            if method == "__shutdown__":
+                break
+            if method == "stream_cancel":
+                with self._cancel_lock:
+                    self._cancelled.add(int(kwargs["stream_id"]))
+                continue
+            threading.Thread(
+                target=self._handle, args=(req_id, method, kwargs),
+                name=f"worker-rpc-{req_id}", daemon=True,
+            ).start()
+        self._stop.set()
+        try:
+            self.svc.close()
+        except Exception:  # noqa: BLE001 — exiting anyway
+            logger.exception("worker service close failed")
+
+
+def worker_main(conn, spec: WorkerSpec) -> None:
+    """Child-process entry point (spawned by :class:`ProcessReplica`)."""
+    # the worker must die with its router even when wedged in XLA: the
+    # router holds the other pipe end, so a clean router close() still
+    # reaches the recv loop; SIGTERM from terminate() gets a fast exit
+    signal.signal(signal.SIGTERM, lambda *_: os._exit(0))
+    logging.basicConfig(level=logging.WARNING)
+    _WorkerServer(conn, spec).run()
+    # skip interpreter/static teardown: daemon threads (pump, RPC
+    # handlers) may still sit inside XLA, and C++ static destructors
+    # running under them abort with "terminate called without an active
+    # exception" — the service already closed, nothing left to flush
+    os._exit(0)
+
+
+# --------------------------------------------------------------------------
+# router side
+
+class _PendingCall:
+    __slots__ = ("q", "streaming")
+
+    def __init__(self, streaming: bool = False) -> None:
+        self.q: _queue.Queue = _queue.Queue()
+        # a streaming call stays registered past its open ack (_F_OK): the
+        # token frames that follow reuse the same req_id, and popping on
+        # the ack would silently drop every one of them
+        self.streaming = streaming
+
+
+class _EngineFacade:
+    """The slice of the engine surface ReplicaSet touches on a replica:
+    routing probes and rebuild-warmup hooks. Compiles happen in the worker
+    process, outside the router's compile fence, so the fence exemption is
+    a no-op here."""
+
+    def __init__(self, owner: "ProcessReplica", tokenizer,
+                 page_size: int, max_slots: int) -> None:
+        self._owner = owner
+        self.tokenizer = tokenizer
+        self.page_size = page_size
+        self.max_slots = max_slots
+
+    def peek_prefix(self, toks) -> int:
+        return self._owner._peek_prefix(toks)
+
+    def set_fence_exempt(self, exempt: bool) -> None:  # noqa: ARG002
+        return None
+
+
+class ProcessReplica:
+    """Router-process shim over one worker process; presents the
+    ``PagedGenerationService`` surface so ReplicaSet drives it unchanged.
+
+    Liveness model: the worker pushes status frames at
+    ``spec.status_interval_s``; every read-side probe (``backlog``,
+    ``heartbeat_age``, ``broken``…) is served from the cached frame, so
+    supervisor passes cost zero RPCs. Worker death is observed three ways,
+    any of which flips :attr:`broken`: the dispatcher hits EOF/broken pipe,
+    ``proc.is_alive()`` goes false, or the worker itself reports a latched
+    ``broken``. All pending RPCs then fail with typed
+    :class:`ReplicaUnavailable` — the same caller surface as an in-process
+    replica whose engine latched broken."""
+
+    def __init__(
+        self,
+        spec: WorkerSpec,
+        tokenizer,
+        replica_id: int = 0,
+        build_timeout_s: float = 600.0,
+    ) -> None:
+        import multiprocessing
+
+        self.spec = spec
+        self.replica_id = replica_id
+        self.build_timeout_s = build_timeout_s
+        self._tokenizer = tokenizer
+        # JAX is not fork-safe (see module docstring): the worker MUST come
+        # up via spawn so its runtime initializes in a clean interpreter
+        self._ctx = multiprocessing.get_context("spawn")
+        self._conn, child_conn = self._ctx.Pipe()
+        self._proc = self._ctx.Process(  # lint: allow(no-fork) — spawn context
+            target=worker_main, args=(child_conn, spec),
+            name=f"sentio-replica-worker-{replica_id}", daemon=True,
+        )
+        self._mutex = threading.Lock()
+        # Connection.send is not thread-safe (a >16KB frame goes out as
+        # separate header+body writes, and partial writes loop): concurrent
+        # router threads would interleave bytes and desync the pipe, making
+        # a healthy worker look dead. Mirrors the worker-side _send_lock.
+        self._send_lock = threading.Lock()
+        self._calls: dict[int, _PendingCall] = {}  # guarded-by: _mutex
+        self._next_id = 1  # guarded-by: _mutex
+        self._dead = False  # guarded-by: _mutex
+        self._death_reason = ""  # guarded-by: _mutex
+        self._closed = False  # guarded-by: _mutex
+        self._status: dict = {}
+        self._status_ts = 0.0
+        self._last_stats: dict = {}
+        self._proc.start()
+        child_conn.close()  # the parent's copy; the worker holds its own
+        # the handshake call is registered BEFORE the dispatcher starts: a
+        # factory that fails instantly would otherwise race its err frame
+        # past an unregistered req_id 0 and the build would time out instead
+        # of surfacing the real error
+        ready_call = _PendingCall()
+        self._calls[0] = ready_call
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop,
+            name=f"replica-worker-rx-{replica_id}", daemon=True,
+        )
+        self._dispatcher.start()
+        ready = self._wait_ready(ready_call, build_timeout_s)
+        self.engine = _EngineFacade(self, tokenizer,
+                                    ready["page_size"], ready["max_slots"])
+        self.max_queue = ready["max_queue"]
+        self.default_timeout_s = ready["default_timeout_s"]
+        self.default_deadline_s = ready["default_deadline_s"]
+        self.retry_budget = ready["retry_budget"]
+        self.tick_stall_budget_s = ready["tick_stall_budget_s"]
+
+    # ------------------------------------------------------------- plumbing
+
+    def _wait_ready(self, call: "_PendingCall", timeout_s: float) -> dict:
+        try:
+            kind, payload = call.q.get(timeout=timeout_s)
+        except _queue.Empty:
+            self.close()
+            raise ReplicaUnavailable(
+                f"worker did not come up within {timeout_s:.0f}s",
+                retryable=False,
+            ) from None
+        if kind == _F_ERR:
+            self.close()
+            raise _decode_exc(payload)
+        if kind != _F_READY:
+            self.close()
+            raise ReplicaUnavailable(
+                f"worker handshake sent {kind!r} before ready",
+                retryable=False,
+            )
+        return payload
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            try:
+                frame = self._conn.recv()
+            except (EOFError, OSError, pickle.UnpicklingError):
+                self._on_death("worker connection lost")
+                return
+            req_id, kind, payload = frame
+            if kind == _F_STATUS:
+                # plain attribute writes: GIL-atomic snapshot for probes
+                self._status = payload
+                self._status_ts = time.perf_counter()
+                continue
+            with self._mutex:
+                call = self._calls.get(req_id)
+                if call is not None and (
+                    kind in (_F_ERR, _F_END, _F_READY)
+                    or (kind == _F_OK and not call.streaming)
+                ):
+                    self._calls.pop(req_id, None)
+            if call is not None:
+                call.q.put((kind, payload))
+
+    def _on_death(self, reason: str, *, process_death: bool = True) -> None:
+        with self._mutex:
+            if self._dead:
+                return
+            self._dead = True
+            self._death_reason = reason
+            pending = list(self._calls.values())
+            self._calls.clear()
+            closed = self._closed
+        exc = self._death_error()
+        for call in pending:
+            call.q.put((_F_ERR, _encode_exc(exc)))
+        if not closed:
+            logger.warning("replica %d worker died: %s", self.replica_id,
+                           reason)
+            if process_death:
+                # the worker_deaths counter feeds the respawn-loop alert
+                # (SentioTpuReplicaWorkerDead) — only actual process deaths
+                # count; a stall-quarantine abandon of a live worker is the
+                # stall watchdog's story, not a death
+                try:
+                    from sentio_tpu.infra.metrics import get_metrics
+
+                    get_metrics().record_worker_death(self.replica_id)
+                except Exception:  # noqa: BLE001 — telemetry is best-effort
+                    pass
+
+    def _death_error(self) -> ReplicaUnavailable:
+        # _death_reason is written exactly once (under _mutex, before _dead
+        # latches true) and only read after; the lock-free read is a
+        # GIL-atomic str fetch
+        reason = self._death_reason or "killed"  # lint: allow(lock-discipline) — GIL-atomic read after latch
+        return ReplicaUnavailable(
+            f"replica worker process died: {reason}",
+            retry_after_s=2.0,
+            details={"replica": self.replica_id, "reason": "worker_dead"},
+        )
+
+    def _send_frame(self, frame: tuple) -> None:
+        with self._send_lock:
+            self._conn.send(frame)
+
+    def _call(self, method: str, kwargs: dict,
+              timeout_s: Optional[float]) -> Any:
+        """One blocking RPC. A dead worker — before or during the call —
+        raises the typed death error; an unresponsive worker past
+        ``timeout_s`` does too (a wedged RPC loop is indistinguishable
+        from a dead one, and both are replica failures the caller should
+        fail over from)."""
+        call = _PendingCall()
+        with self._mutex:
+            if self._dead:
+                raise self._death_error()
+            req_id = self._next_id
+            self._next_id += 1
+            self._calls[req_id] = call
+        try:
+            self._send_frame((req_id, method, kwargs))
+        except (BrokenPipeError, OSError):
+            self._on_death("worker pipe broken on send")
+            raise self._death_error() from None
+        try:
+            kind, payload = call.q.get(
+                timeout=timeout_s if timeout_s and timeout_s > 0 else None)
+        except _queue.Empty:
+            with self._mutex:
+                self._calls.pop(req_id, None)
+            raise ReplicaUnavailable(
+                f"worker RPC {method!r} unanswered after {timeout_s:.0f}s",
+                retry_after_s=2.0,
+                details={"replica": self.replica_id, "reason": "rpc_timeout"},
+            ) from None
+        if kind == _F_ERR:
+            raise _decode_exc(payload)
+        return payload
+
+    @staticmethod
+    def _rel_deadline(deadline_s: Optional[float],
+                      deadline_ts: Optional[float]) -> Optional[float]:
+        """perf_counter clocks do not compare across processes: absolute
+        router deadlines cross the boundary as remaining seconds. An
+        ALREADY-expired deadline raises here, router-side — shipping a
+        non-positive remainder would read as ``deadline_s=0``, the
+        explicit no-deadline opt-out, and silently un-expire the
+        request (thread mode sheds it typed at admission)."""
+        if deadline_ts is not None:
+            rel = deadline_ts - time.perf_counter()
+            if rel <= 0:
+                raise DeadlineExceededError("deadline expired before submit")
+            return rel
+        return deadline_s
+
+    # ------------------------------------------------------------------ api
+
+    def generate(
+        self,
+        prompt: str,
+        max_new_tokens: int = 64,
+        temperature: float = 0.0,
+        timeout_s: Optional[float] = None,
+        request_id: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+        deadline_ts: Optional[float] = None,
+        top_k: int = 0,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
+        cost_tokens: int = 0,
+    ):
+        wait = (timeout_s or self.default_timeout_s) + 30.0
+        result = self._call("generate", dict(
+            prompt=prompt, max_new_tokens=max_new_tokens,
+            temperature=temperature, timeout_s=timeout_s,
+            request_id=request_id,
+            deadline_s=self._rel_deadline(deadline_s, deadline_ts),
+            top_k=top_k, tenant=tenant, priority=priority,
+            cost_tokens=cost_tokens,
+        ), timeout_s=wait)
+        result.replica_id = self.replica_id
+        return result
+
+    def generate_stream(
+        self,
+        prompt: str,
+        max_new_tokens: int = 64,
+        temperature: float = 0.0,
+        timeout_s: Optional[float] = None,
+        request_id: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+        deadline_ts: Optional[float] = None,
+        top_k: int = 0,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
+        cost_tokens: int = 0,
+        stats_out: Optional[dict] = None,
+    ) -> Iterator[str]:
+        """Lazy, matching thread mode: the ``stream_open`` RPC — which
+        admits AND starts decoding in the worker — defers to the first
+        ``next()``. ``ReplicaSet._stream_impl`` discards and re-creates
+        not-yet-started iterators (WFQ overflow re-bucketing, failover) on
+        the promise that doing so costs nothing; an eager open here would
+        leak a phantom decode per discarded iterator. The process-mode
+        delta: thread mode's CALL-time validation (top_k vs speculation)
+        also moves to the first ``next()`` — the SSE handler's admission
+        pre-check still runs before its 200, and a validation error past
+        that surfaces as the typed mid-stream error."""
+        wait = (timeout_s or self.default_timeout_s) + 30.0
+        return self._stream_open_and_pump(dict(
+            prompt=prompt, max_new_tokens=max_new_tokens,
+            temperature=temperature, timeout_s=timeout_s,
+            request_id=request_id,
+            deadline_s=deadline_s, deadline_ts=deadline_ts,
+            top_k=top_k, tenant=tenant, priority=priority,
+            cost_tokens=cost_tokens,
+        ), wait, stats_out)
+
+    def _stream_open_and_pump(self, req: dict, wait: float,
+                              stats_out: Optional[dict]) -> Iterator[str]:
+        # generator body: nothing below runs until the first next()
+        req["deadline_s"] = self._rel_deadline(
+            req.pop("deadline_s"), req.pop("deadline_ts"))
+        call = _PendingCall(streaming=True)
+        with self._mutex:
+            if self._dead:
+                raise self._death_error()
+            req_id = self._next_id
+            self._next_id += 1
+            self._calls[req_id] = call
+        try:
+            self._send_frame((req_id, "stream_open", req))
+        except (BrokenPipeError, OSError):
+            self._on_death("worker pipe broken on send")
+            raise self._death_error() from None
+        try:
+            kind, payload = call.q.get(timeout=wait)
+        except _queue.Empty:
+            with self._mutex:
+                self._calls.pop(req_id, None)
+            raise ReplicaUnavailable(
+                f"worker stream open unanswered after {wait:.0f}s",
+                retry_after_s=2.0,
+                details={"replica": self.replica_id, "reason": "rpc_timeout"},
+            ) from None
+        if kind == _F_ERR:
+            raise _decode_exc(payload)
+        yield from self._stream_frames(req_id, call, wait, stats_out)
+
+    def _stream_frames(self, req_id: int, call: _PendingCall, wait: float,
+                       stats_out: Optional[dict]) -> Iterator[str]:
+        done = False
+        try:
+            while True:
+                try:
+                    kind, payload = call.q.get(timeout=wait)
+                except _queue.Empty:
+                    raise ReplicaUnavailable(
+                        f"worker stream stalled for {wait:.0f}s",
+                        retry_after_s=2.0,
+                        details={"replica": self.replica_id,
+                                 "reason": "rpc_timeout"},
+                    ) from None
+                if kind == _F_TOK:
+                    yield payload
+                elif kind == _F_END:
+                    done = True
+                    if stats_out is not None and isinstance(payload, dict):
+                        payload["replica_id"] = self.replica_id
+                        stats_out.update(payload)
+                    return
+                else:  # _F_ERR
+                    done = True
+                    raise _decode_exc(payload)
+        finally:
+            with self._mutex:
+                self._calls.pop(req_id, None)
+                dead = self._dead
+            if not done and not dead:
+                # consumer abandoned mid-stream: tell the worker (it cancels
+                # the ticket between token frames — chunk-granular)
+                try:
+                    self._send_frame((0, "stream_cancel",
+                                      {"stream_id": req_id}))
+                except (BrokenPipeError, OSError):
+                    pass
+
+    def check_admission(self, deadline_ts: Optional[float] = None) -> None:
+        self._call("check_admission", {
+            "deadline_rel_s": self._rel_deadline(None, deadline_ts),
+        }, timeout_s=10.0)
+
+    def _peek_prefix(self, toks) -> int:
+        """Routing probe; MUST never fail OR stall a request — unlike
+        thread mode's in-memory radix read this is a pipe RPC, and it sits
+        on every incoming request's routing path. A worker whose status
+        frames have gone stale is slow or wedged, so skip the RPC entirely
+        (reads as a cold cache and the router routes elsewhere); a healthy
+        worker answers from a handler thread in milliseconds, so the short
+        timeout bounds the set-wide routing cost of a not-yet-detected
+        wedge instead of stacking multi-second waits per replica."""
+        stale_after = max(10 * self.spec.status_interval_s, 0.5)
+        if (self._status_ts <= 0.0
+                or time.perf_counter() - self._status_ts > stale_after):
+            return 0
+        try:
+            return int(self._call("peek_prefix", {"toks": list(toks)},
+                                  timeout_s=0.5))
+        except Exception:  # noqa: BLE001
+            return 0
+
+    def warmup(self, max_new_tokens: int = 4) -> dict:
+        return self._call("warmup", {"max_new_tokens": max_new_tokens},
+                          timeout_s=self.build_timeout_s)
+
+    def backlog(self) -> int:
+        return int(self._status.get("backlog") or 0)
+
+    def projected_wait(self) -> Optional[float]:
+        return self._status.get("projected_wait")
+
+    def heartbeat_age(self) -> Optional[float]:
+        """Worker-reported pump heartbeat age plus the status frame's own
+        staleness. A worker whose status frames STOPPED while RPCs are in
+        flight is itself wedged — that staleness is the age (the router's
+        watchdog must detect a dead worker-side loop exactly like a dead
+        pump)."""
+        with self._mutex:
+            if self._dead:
+                return None
+            pending = len(self._calls)
+        if self._status_ts <= 0.0:
+            return None
+        stale = time.perf_counter() - self._status_ts
+        age = self._status.get("heartbeat_age")
+        if age is not None:
+            return float(age) + stale
+        interval = max(self.spec.status_interval_s, 0.02)
+        if pending > 0 and stale > max(10 * interval, 2.0):
+            return stale
+        return None
+
+    def duty_cycle(self) -> dict:
+        return self._status.get("duty_cycle") or {
+            "host": 0.0, "device": 0.0, "idle": 1.0,
+        }
+
+    def reset_duty_cycle(self) -> None:
+        try:
+            self._call("reset_duty_cycle", {}, timeout_s=10.0)
+        except Exception:  # noqa: BLE001 — telemetry re-basing, best-effort
+            pass
+
+    @property
+    def broken(self) -> bool:
+        with self._mutex:
+            if self._dead:
+                return True
+        if self._proc is not None and not self._proc.is_alive():
+            self._on_death(f"worker exited (code {self._proc.exitcode})")
+            return True
+        return bool(self._status.get("broken"))
+
+    @property
+    def closed(self) -> bool:
+        with self._mutex:
+            if self._closed:
+                return True
+        return bool(self._status.get("closed"))
+
+    @property
+    def tick_failure_count(self) -> int:
+        return int(self._status.get("tick_failure_count") or 0)
+
+    @property
+    def pump_leaked_count(self) -> int:
+        return int(self._status.get("pump_leaked") or 0)
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self._proc.pid if self._proc is not None else None
+
+    def stats(self) -> dict:
+        try:
+            self._last_stats = self._call("stats", {}, timeout_s=10.0)
+        except Exception:  # noqa: BLE001 — dead replica: last known stats
+            return {**self._last_stats, "replica": self.replica_id,
+                    "worker_dead": 1}
+        return self._last_stats
+
+    # ------------------------------------------------ quarantine / handoff
+
+    def abandon(self, reason: str) -> list:
+        """Stall-quarantine surface: ask the worker (its RPC loop survives a
+        wedged pump) to abandon — admitted tickets fail typed in-worker,
+        which unblocks their router-side RPCs with the typed error — then
+        latch dead locally so every later call fails fast. No cross-process
+        inbox handoff: the returned list is empty and those callers spend
+        normal failover budget (module docstring)."""
+        try:
+            self._call("abandon", {"reason": reason}, timeout_s=10.0)
+        except Exception:  # noqa: BLE001 — wedged/dead worker: kill below
+            pass
+        alive = self._proc is not None and self._proc.is_alive()
+        self._on_death(f"abandoned: {reason}", process_death=not alive)
+        return []
+
+    def extract_inbox(self) -> list:
+        """Never-dispatched tickets live in the worker process; they cannot
+        move across the boundary (their callers block on THIS replica's
+        RPC frames). Quarantine fails them typed via the worker instead."""
+        return []
+
+    def adopt(self, ticket) -> None:  # noqa: ARG002
+        raise ReplicaUnavailable(
+            "process-mode replicas cannot adopt cross-process tickets",
+            retryable=False,
+            details={"replica": self.replica_id, "reason": "process_mode"},
+        )
+
+    # ------------------------------------------------------------ lifecycle
+
+    def respawn(self) -> "ProcessReplica":
+        """A fresh worker process from the same spec — the supervisor's
+        rebuild path (``ReplicaSet._rebuild`` duck-types this instead of
+        ``engine.spawn_fresh()``)."""
+        return ProcessReplica(
+            self.spec, self._tokenizer, replica_id=self.replica_id,
+            build_timeout_s=self.build_timeout_s,
+        )
+
+    def kill(self) -> None:
+        """SIGKILL the worker — the chaos drill's real replica death. The
+        dispatcher observes the broken pipe and fails all in-flight RPCs
+        typed; the supervisor sees ``broken`` and respawns."""
+        if self._proc is not None and self._proc.pid:
+            try:
+                os.kill(self._proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, OSError):
+                pass
+
+    def inject_fault(self, point: str, **rule_kwargs) -> None:
+        """Arm a fault rule INSIDE the worker process (its faults registry
+        is process-private). ``kill_process=True`` at e.g. ``paged.step``
+        makes the next decode tick a real SIGKILL mid-dispatch."""
+        self._call("inject_fault", {"point": point, **rule_kwargs},
+                   timeout_s=10.0)
+
+    def reset_faults(self) -> None:
+        try:
+            self._call("reset_faults", {}, timeout_s=10.0)
+        except Exception:  # noqa: BLE001 — the worker may already be dead
+            pass
+
+    def drain(self, deadline_s: float = 30.0) -> dict:
+        """Worker-side graceful drain, then local close. A dead worker
+        drains vacuously (its backlog died with it)."""
+        result = {"drained": False, "abandoned": 0}
+        try:
+            result = self._call("drain", {"deadline_s": deadline_s},
+                                timeout_s=deadline_s + 30.0)
+        except Exception:  # noqa: BLE001 — dead worker: nothing to drain
+            pass
+        self.close(join_timeout_s=max(deadline_s, 1.0))
+        return result
+
+    def close(self, join_timeout_s: float = 10.0) -> None:
+        """Shut the worker down and REAP it: graceful shutdown frame, then
+        SIGTERM, then SIGKILL — close() never returns with the child still
+        runnable, so a closed set cannot leak orphan processes."""
+        with self._mutex:
+            self._closed = True
+        proc = self._proc
+        if proc is None:
+            return
+        try:
+            self._send_frame((0, "__shutdown__", {}))
+        except (BrokenPipeError, OSError):
+            pass
+        proc.join(timeout=max(join_timeout_s, 0.5))
+        if proc.is_alive():
+            proc.terminate()
+            proc.join(timeout=5.0)
+        if proc.is_alive():
+            proc.kill()
+            proc.join(timeout=5.0)
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+        self._on_death("closed")

@@ -255,6 +255,28 @@ class DependencyContainer:
             from sentio_tpu.runtime.replica import ReplicaSet
             from sentio_tpu.runtime.service import PagedGenerationService
 
+            n_replicas = max(serve.replicas, 1)
+            replica_mode = serve.replica_mode
+            if replica_mode not in ("thread", "process"):
+                # a typo must not SILENTLY degrade to the GIL-bound thread
+                # tier while the operator believes they have OS-level
+                # failure domains
+                logger.warning(
+                    "REPLICA_MODE=%r unknown (expected thread|process); "
+                    "using thread mode", replica_mode,
+                )
+                replica_mode = "thread"
+            if replica_mode == "process" and self.mesh is not None:
+                # per-process replicas over dp-axis mesh slices need
+                # coordinated multi-process device init — the remaining
+                # ROADMAP item 1 leg. Fall back rather than half-work.
+                logger.warning(
+                    "REPLICA_MODE=process ignored: a device mesh is "
+                    "configured (MESH_* > 1) and multi-host process "
+                    "replicas are not wired yet; using thread mode"
+                )
+                replica_mode = "thread"
+
             # paged speculative decoding: a configured draft checkpoint now
             # accelerates the DEFAULT serving path (runtime/paged_spec.py)
             # instead of being dead under USE_PAGED_KV=1 (round-4 advisor)
@@ -272,6 +294,14 @@ class DependencyContainer:
                         "and paged speculation requires whole-prompt "
                         "admission (the draft prefills full prompts)"
                     )
+                elif replica_mode == "process":
+                    # workers load the draft themselves (mmap-shared, via
+                    # WorkerSpec below) — loading a private router-process
+                    # copy here would defeat the one-copy-per-host goal
+                    logger.info(
+                        "paged speculation: draft %s loads in-worker (k=%d)",
+                        cfg.draft_checkpoint_path, cfg.speculative_k,
+                    )
                 else:
                     from sentio_tpu.runtime.weights import load_model
 
@@ -283,8 +313,6 @@ class DependencyContainer:
                         cfg.draft_checkpoint_path, draft_cfg.dim,
                         draft_cfg.n_layers, cfg.speculative_k,
                     )
-
-            n_replicas = max(serve.replicas, 1)
             # replicas map onto dp-axis slices of the mesh when it divides
             # evenly; otherwise every replica shares the whole mesh (their
             # dispatches serialize on device — still correct, no scale-out)
@@ -317,6 +345,123 @@ class DependencyContainer:
                 warm_head = prompts.static_head(
                     "retrieve", instruction=prompts.load("profile")
                 ) or ""
+
+            if replica_mode == "process":
+                # process-mode replica tier (runtime/worker.py): each
+                # replica is a spawned worker process owning its private
+                # engine+service+pump; the router keeps only a thin RPC
+                # shim per replica. Weights are NOT shipped through the
+                # spawn pipe — each worker loads the checkpoint itself,
+                # memory-mapped, so N workers share one page-cache copy
+                # per host (or re-derives the identical seeded random
+                # init in the no-checkpoint dev mode).
+                import dataclasses as _dc
+
+                from sentio_tpu.runtime.worker import (
+                    ProcessReplica,
+                    WorkerSpec,
+                )
+
+                engine_kwargs = dict(
+                    max_slots=cfg.max_batch_size,
+                    page_size=cfg.kv_page_size,
+                    max_pages_per_seq=cfg.kv_max_pages_per_seq,
+                    steps_per_tick=cfg.decode_steps_per_tick,
+                    max_tick_steps=cfg.decode_max_tick_steps,
+                    pipeline_depth=cfg.decode_pipeline_depth,
+                    kv_quant=cfg.kv_quant,
+                    prefill_chunk=cfg.prefill_chunk or None,
+                    spec_k=cfg.speculative_k,
+                    prefix_cache=cfg.prefix_cache,
+                )
+                service_kwargs = dict(
+                    max_queue=serve.admission_max_queue or None,
+                    default_deadline_s=(
+                        serve.default_deadline_ms / 1e3
+                        if serve.default_deadline_ms > 0 else None
+                    ),
+                    retry_budget=serve.crash_retry_budget,
+                    tick_stall_budget_s=serve.tick_stall_budget_s,
+                    warmup_budget_s=serve.warmup_budget_s,
+                )
+                draft_path = ""
+                if cfg.draft_checkpoint_path and not cfg.prefill_chunk:
+                    # the draft loads INSIDE each worker (mmap-shared);
+                    # the prefill_chunk incompatibility warning above
+                    # applies identically
+                    draft_path = cfg.draft_checkpoint_path
+                services = []
+                try:
+                    for i in range(n_replicas):
+                        spec = WorkerSpec(factory_kwargs=dict(
+                            model_family=(
+                                "moe" if type(engine.model_config).__name__
+                                == "MoeConfig" else "llama"
+                            ),
+                            model_config=(
+                                None if cfg.checkpoint_path
+                                else _dc.asdict(engine.model_config)
+                            ),
+                            checkpoint_path=cfg.checkpoint_path,
+                            tokenizer_path=cfg.tokenizer_path,
+                            draft_checkpoint_path=draft_path,
+                            engine_kwargs=engine_kwargs,
+                            service_kwargs={**service_kwargs,
+                                            "replica_id": i},
+                            warm_prefix_text=warm_head,
+                        ))
+                        services.append(ProcessReplica(
+                            spec, engine.tokenizer, replica_id=i,
+                        ))
+                    logger.info(
+                        "process-mode replica tier: %d worker processes "
+                        "(pids %s)", n_replicas,
+                        [s.pid for s in services],
+                    )
+                    return ReplicaSet(
+                        services,
+                        tenant_weights=serve.parsed_tenant_weights(),
+                        tenant_default_weight=serve.tenant_default_weight,
+                        tenant_refill_tokens_per_s=(
+                            serve.tenant_refill_tokens_per_s
+                        ),
+                        tenant_burst_tokens=serve.tenant_burst_tokens,
+                        tenant_headroom=(serve.tenant_headroom
+                                         if serve.tenant_headroom >= 0
+                                         else None),
+                        batch_shed_fraction=serve.batch_shed_fraction,
+                        affinity_stickiness=serve.affinity_stickiness,
+                        route_prefix_tokens=serve.route_prefix_tokens,
+                        supervise=serve.replica_supervise,
+                        probe_interval_s=serve.replica_probe_interval_s,
+                        breaker_window_s=serve.replica_breaker_window_s,
+                        breaker_error_rate=serve.replica_breaker_error_rate,
+                        breaker_min_samples=(
+                            serve.replica_breaker_min_samples
+                        ),
+                        breaker_tick_failures=(
+                            serve.replica_breaker_tick_failures
+                        ),
+                        quarantine_backoff_s=(
+                            serve.replica_quarantine_backoff_s
+                        ),
+                        rebuild_budget=serve.replica_rebuild_budget,
+                        rebuild_drain_s=serve.replica_rebuild_drain_s,
+                        failover_budget=serve.replica_failover_budget,
+                        rebuild_workers=serve.replica_rebuild_workers,
+                    )
+                except BaseException:
+                    # a failed spawn — or a ReplicaSet constructor reject —
+                    # must not leak the workers already running: each is a
+                    # live OS process holding an engine + KV pool, and
+                    # _get retries this build on the next request,
+                    # multiplying the leak
+                    for s in services:
+                        try:
+                            s.close(join_timeout_s=5.0)
+                        except Exception:  # noqa: BLE001 — reap best-effort
+                            pass
+                    raise
 
             services = []
             for i in range(n_replicas):
@@ -355,6 +500,7 @@ class DependencyContainer:
                     retry_budget=serve.crash_retry_budget,
                     replica_id=i,
                     tick_stall_budget_s=serve.tick_stall_budget_s,
+                    warmup_budget_s=serve.warmup_budget_s,
                 ))
             return ReplicaSet(
                 services,

@@ -1,0 +1,35 @@
+"""Seeded no-fork violations (analysis/forkcheck.py): every fork-flavored
+process creation the rule must catch — JAX-after-fork deadlocks. Each
+numbered line below is pinned by tests/test_lint.py."""
+
+import multiprocessing
+import os
+from multiprocessing import Pool
+from os import fork
+
+
+def direct_syscalls():
+    pid = os.fork()  # line 12: os.fork attribute call
+    if pid == 0:
+        fork()  # line 14: from-imported bare fork
+
+
+def fork_contexts():
+    ctx = multiprocessing.get_context("fork")  # line 18: fork context
+    multiprocessing.set_start_method("forkserver")  # line 19: forkserver
+    return ctx
+
+
+def default_method_workers(ctx):
+    p = multiprocessing.Process(target=print)  # line 24: default = fork
+    q = ctx.Pool(2)  # line 25: unvetted context worker pool
+    return p, q
+
+
+def clean_forms():
+    # none of these fire: spawn context, annotated vetted site, unrelated
+    # string args and attribute names
+    ctx = multiprocessing.get_context("spawn")
+    p = ctx.Process(target=print)  # lint: allow(no-fork) — spawn context
+    {"fork": 1}.get("fork")
+    return ctx, p

@@ -54,6 +54,15 @@ def _assert_pages_conserved(svc):
         == s["total_pages"] - 1, s
 
 
+def _catch(fn, **kwargs):
+    """Run ``fn`` and return its result OR the exception it raised — for
+    threads whose outcome (either way) the test asserts on afterwards."""
+    try:
+        return fn(**kwargs)
+    except Exception as exc:  # noqa: BLE001 — the test inspects the type
+        return exc
+
+
 def _assert_no_pump_threads(timeout_s: float = 15.0):
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
@@ -518,6 +527,220 @@ class TestChaosDrill:
             assert any(e.get("event") == "pump_stall" for e in events)
             assert any(e.get("event") == "inbox_handoff"
                        and e.get("handed_off") == 2 for e in events)
+        finally:
+            release.set()  # unwedge the abandoned pump so it can exit
+            faults.reset()
+            rs.close()
+        _assert_no_pump_threads()
+
+    def test_process_replica_sigkill_drill(self):
+        """ISSUE 13 acceptance drill: one of 2 PROCESS-mode replicas takes
+        a real ``SIGKILL`` mid-traffic — no exception raised in any Python
+        frame, the worker process is simply gone. The contract:
+
+        * every caller terminates with a TYPED outcome (in-flight RPCs
+          against the corpse fail ReplicaUnavailable and fail over);
+        * the survivor keeps serving during the outage;
+        * the supervisor detects the corpse from the OUTSIDE (broken pipe /
+          ``proc.is_alive()``), quarantines, and rebuilds by RESPAWNING the
+          process; the respawned worker serves before the test ends;
+        * detection and recovery land within budget;
+        * zero orphan worker processes at teardown."""
+        import dataclasses
+        import multiprocessing
+
+        from sentio_tpu.models.llama import LlamaConfig
+        from sentio_tpu.models.tokenizer import ByteTokenizer
+        from sentio_tpu.runtime.replica import ReplicaSet
+        from sentio_tpu.runtime.worker import ProcessReplica, WorkerSpec
+
+        cfg = LlamaConfig.tiny()
+        spec = WorkerSpec(factory_kwargs=dict(
+            model_config=dataclasses.asdict(cfg),
+            engine_kwargs=dict(max_slots=2, page_size=8, max_pages_per_seq=4,
+                               steps_per_tick=2),
+            service_kwargs=dict(retry_budget=1),
+        ))
+        tok = ByteTokenizer(cfg.vocab_size)
+        p0 = ProcessReplica(spec, tok, replica_id=0, build_timeout_s=300.0)
+        p1 = ProcessReplica(spec, tok, replica_id=1, build_timeout_s=300.0)
+        # pre-compile both workers so the drill's traffic exercises the
+        # failure machinery instead of waiting out XLA compiles
+        p0.generate("drill warm zero", max_new_tokens=2, timeout_s=180)
+        p1.generate("drill warm one", max_new_tokens=2, timeout_s=180)
+        rs = ReplicaSet(
+            [p0, p1],
+            probe_interval_s=0.05, quarantine_backoff_s=0.1,
+            failover_budget=2, rebuild_drain_s=0.5,
+        )
+        outcomes: dict[str, object] = {}
+        stop_traffic = threading.Event()
+
+        def call_generate(i):
+            try:
+                outcomes[f"g{i}"] = rs.generate(
+                    f"sigkill drill generate {i}", max_new_tokens=8,
+                    temperature=0.0, timeout_s=120,
+                )
+            except Exception as exc:  # noqa: BLE001 — typed errors terminal
+                outcomes[f"g{i}"] = exc
+
+        def call_stream(i):
+            try:
+                outcomes[f"s{i}"] = "".join(rs.generate_stream(
+                    f"sigkill drill stream {i}", max_new_tokens=8,
+                    temperature=0.0, timeout_s=120,
+                ))
+            except Exception as exc:  # noqa: BLE001
+                outcomes[f"s{i}"] = exc
+
+        try:
+            threads = (
+                [threading.Thread(target=call_generate, args=(i,))
+                 for i in range(5)]
+                + [threading.Thread(target=call_stream, args=(i,))
+                   for i in range(3)]
+            )
+            for t in threads:
+                t.start()
+            # the kill lands while traffic is in flight (workers decode for
+            # several ticks at 8 tokens / 2 steps-per-tick)
+            time.sleep(0.1)
+            t_kill = time.monotonic()
+            p1.kill()  # real SIGKILL: no handlers run, no frames unwind
+            # detection: the supervisor (or a failing caller) must move the
+            # corpse out of HEALTHY from the OUTSIDE
+            t_detect = None
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if rs.health_summary()["replicas"][1]["state"] != "HEALTHY":
+                    t_detect = time.monotonic()
+                    break
+                time.sleep(0.01)
+            assert t_detect is not None, "corpse never left HEALTHY"
+            assert t_detect - t_kill <= 15.0, (
+                f"detection took {t_detect - t_kill:.1f}s"
+            )
+            for t in threads:
+                t.join(timeout=180)
+            assert not any(t.is_alive() for t in threads), (
+                "caller thread hung across the worker SIGKILL"
+            )
+            # EVERY caller terminated with a typed outcome; the survivor
+            # absorbed failed-over load
+            assert len(outcomes) == 8
+            successes = 0
+            for name, out in outcomes.items():
+                if isinstance(out, Exception):
+                    assert isinstance(out, SentioError), (
+                        f"{name}: untyped {type(out).__name__}: {out}"
+                    )
+                else:
+                    assert isinstance(out, (PagedResult, str)), (name, out)
+                    if isinstance(out, PagedResult):
+                        assert out.finish_reason in ("stop", "length"), (
+                            name, out,
+                        )
+                    successes += 1
+            assert successes >= 1, (
+                f"survivor never served during the outage: {outcomes}"
+            )
+            # the supervisor RESPAWNS the dead worker process and the set
+            # returns to full health within budget
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if rs.health_summary()["status"] == "healthy":
+                    break
+                time.sleep(0.05)
+            summary = rs.health_summary()
+            assert summary["status"] == "healthy", summary
+            assert summary["replicas"][1]["rebuilds"] == 1, summary
+            rebuilt = rs._services[1]
+            assert rebuilt is not p1, "slot was not respawned"
+            assert rebuilt.pid != p1.pid, "respawn reused the corpse's pid?"
+            ok = rebuilt.generate("respawned replica serves again",
+                                  max_new_tokens=3, timeout_s=180)
+            assert ok.finish_reason in ("stop", "length")
+            ok2 = rs.generate("post sigkill routed sanity", max_new_tokens=3,
+                              timeout_s=120)
+            assert ok2.finish_reason in ("stop", "length")
+        finally:
+            stop_traffic.set()
+            rs.close()
+        # zero orphan worker processes at teardown: close() reaps
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and multiprocessing.active_children():
+            time.sleep(0.05)
+        assert multiprocessing.active_children() == [], (
+            "orphan replica worker processes leaked"
+        )
+        _assert_no_pump_threads()
+
+    def test_warmup_stall_quarantined_by_budget(self):
+        """ISSUE 13 satellite: a wedge DURING warmup. WARMING is
+        watchdog-exempt (cold compiles legitimately dwarf any stall
+        budget), so pre-budget this hang was only caught by caller
+        timeouts — the spawn/rebuild path just sat there. With
+        ``WARMUP_BUDGET_S`` the exemption EXPIRES: the watchdog
+        quarantines the replica (typed, supervisor-visible) and the
+        blocked warmup caller gets the typed abandonment error."""
+        from sentio_tpu.runtime.replica import (
+            HEALTH_HEALTHY,
+            HEALTH_QUARANTINED,
+            ReplicaSet,
+        )
+
+        eng = ContinuousBatchingEngine(
+            max_slots=2, page_size=8, max_pages_per_seq=4, steps_per_tick=2,
+        )
+        budget_s = 2.0
+        svc = PagedGenerationService(eng, tick_stall_budget_s=budget_s,
+                                     warmup_budget_s=budget_s)
+        rs = ReplicaSet([svc], supervise=False)
+        release = threading.Event()
+        warm_outcome: list = []
+        rule = faults.FaultRule(stall_event=release, stall_s=120.0, times=1)
+        faults.arm("paged.step", rule)
+        try:
+            warmer = threading.Thread(
+                target=lambda: warm_outcome.append(
+                    _catch(svc.warmup, max_new_tokens=2)),
+            )
+            warmer.start()
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and rule.stalled == 0:
+                time.sleep(0.005)
+            assert rule.stalled == 1, "warmup never wedged"
+            t_wedge = time.monotonic()
+            # inside the budget the stand-down holds: warming is exempt
+            rs._supervise_once()
+            assert rs.health_summary()["replicas"][0]["state"] \
+                == HEALTH_HEALTHY
+            # past the budget the exemption expires and the watchdog fires
+            deadline = time.monotonic() + 6 * budget_s
+            state = HEALTH_HEALTHY
+            while time.monotonic() < deadline:
+                rs._supervise_once()
+                state = rs.health_summary()["replicas"][0]["state"]
+                if state != HEALTH_HEALTHY:
+                    break
+                time.sleep(0.05)
+            assert state in (HEALTH_QUARANTINED, "REBUILDING"), (
+                "watchdog never fired on the stalled warmup"
+            )
+            assert time.monotonic() - t_wedge <= 4 * budget_s, (
+                "stalled-warmup detection exceeded 2x budget + slack"
+            )
+            assert rs.health_summary()["replicas"][0].get("reason", "") \
+                .startswith("pump stalled"), rs.health_summary()
+            # the blocked warmup caller wakes with the TYPED abandonment
+            # error instead of hanging out its generate timeouts
+            warmer.join(timeout=60)
+            assert not warmer.is_alive(), "warmup still hung post-quarantine"
+            assert isinstance(warm_outcome[0], ReplicaUnavailable), (
+                warm_outcome
+            )
+            assert rs.stats()["stall_quarantines"] == 1
         finally:
             release.set()  # unwedge the abandoned pump so it can exit
             faults.reset()

@@ -85,6 +85,23 @@ class TestSeededFixtures:
         ]
         assert "dedicated phase" in got[0].message
 
+    def test_fork_fixture_exact_findings(self):
+        """Fork-flavored process creation (JAX-after-fork deadlocks): the
+        direct syscalls, the fork/forkserver context selections, and the
+        default-start-method worker constructions all fire; the spawn
+        context, the annotated vetted site, and the unrelated dict.get
+        produce nothing."""
+        got = _findings("fork_bad.py")
+        assert [(f.rule, f.line) for f in got] == [
+            ("no-fork", 12),
+            ("no-fork", 14),
+            ("no-fork", 18),
+            ("no-fork", 19),
+            ("no-fork", 24),
+            ("no-fork", 25),
+        ]
+        assert "fork" in got[0].message and "spawn" in got[0].message
+
     def test_clock_fixture_exact_finding(self):
         got = _findings("clock_bad.py")
         assert [(f.rule, f.line) for f in got] == [("wall-clock-duration", 6)]

@@ -240,6 +240,45 @@ class TestConservation:
 
 
 class TestReplicaAggregation:
+    def test_failed_tick_flushes_partial_phases(self, recorder, metrics):
+        """ISSUE 13 satellite: a pump iteration that ends in a tick failure
+        still records a ``phase_ms`` decomposition (partial engine snapshot,
+        residual folded into ``other``; the same bounded key set and
+        conservation contract as a successful tick) — chaos-round Perfetto
+        traces must not hole every failed tick."""
+        from sentio_tpu.infra import faults
+
+        svc = PagedGenerationService(_engine(), retry_budget=1)
+        try:
+            with faults.inject("paged.step",
+                               error=RuntimeError("phase flush probe"),
+                               times=1) as rule:
+                result = svc.generate("phase flush probe request",
+                                      max_new_tokens=4, timeout_s=120)
+            assert rule.fired == 1
+            # the ticket was requeued past the failed tick (crash
+            # containment) and finished normally
+            assert result.finish_reason in ("stop", "length")
+            stats = svc.stats()
+            assert stats["tick_failures"] == 1
+        finally:
+            faults.reset()
+            svc.close()
+        failed = [e for e in recorder.timeline()
+                  if e.get("event") == "tick_failure"]
+        assert len(failed) == 1, "failed tick recorded no flight event"
+        tick = failed[0]
+        phase_ms = tick["phase_ms"]
+        assert set(phase_ms) == set(TICK_PHASES)
+        assert all(v >= 0.0 for v in phase_ms.values())
+        assert sum(phase_ms.values()) == pytest.approx(
+            tick["pump_ms"], rel=0.05, abs=0.5)
+        # the failed iteration's wall time landed in the duty totals too
+        # (phase_seconds grew by at least the failed tick's pump span)
+        assert sum(stats["phase_seconds"].values()) * 1e3 >= (
+            tick["pump_ms"] * 0.5
+        )
+
     def test_replica_set_duty_cycle(self, recorder, metrics):
         from sentio_tpu.runtime.replica import ReplicaSet
 

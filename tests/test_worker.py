@@ -1,0 +1,202 @@
+"""Process-mode replica parity (ISSUE 13): the ``ProcessReplica`` shim over
+a spawned worker process must present the same typed surface as the
+in-process ``PagedGenerationService`` it wraps — same tokens (seeded random
+init is re-derived identically in the worker), same typed sheds and
+deadline errors, same mid-stream failure semantics, and a teardown that
+REAPS the worker (no orphan processes, asserted via ``active_children``).
+
+Workers here run tiny seeded-random llama engines (no checkpoint), so the
+suite exercises the RPC/liveness machinery, not model quality."""
+
+import dataclasses
+import multiprocessing
+import threading
+import time
+
+import pytest
+
+from sentio_tpu.infra.exceptions import (
+    DeadlineExceededError,
+    ReplicaUnavailable,
+    ServiceOverloaded,
+)
+from sentio_tpu.models.llama import LlamaConfig
+from sentio_tpu.models.tokenizer import ByteTokenizer
+from sentio_tpu.runtime.worker import ProcessReplica, WorkerSpec
+
+CFG = LlamaConfig.tiny()
+ENGINE_KW = dict(max_slots=2, page_size=8, max_pages_per_seq=4,
+                 steps_per_tick=2, num_pages=65)
+
+
+def _spec(**service_kwargs) -> WorkerSpec:
+    return WorkerSpec(factory_kwargs=dict(
+        model_config=dataclasses.asdict(CFG),
+        engine_kwargs=dict(ENGINE_KW),
+        service_kwargs=service_kwargs,
+    ))
+
+
+def _tokenizer() -> ByteTokenizer:
+    return ByteTokenizer(CFG.vocab_size)
+
+
+@pytest.fixture(scope="module")
+def worker():
+    # ONE worker for the module: each spawn pays a fresh interpreter + jax
+    # init + first-tick compiles
+    pr = ProcessReplica(_spec(retry_budget=1), _tokenizer(), replica_id=0,
+                        build_timeout_s=300.0)
+    yield pr
+    pr.close()
+
+
+class TestProcessParity:
+    def test_generate_token_parity_with_in_process_engine(self, worker):
+        """Same tiny config, same seed, temperature 0: the worker's tokens
+        must be IDENTICAL to an in-process engine's — the worker re-derives
+        the seeded random init, so any drift means the RPC shim changed the
+        request or the worker built a different engine."""
+        from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+
+        r = worker.generate("parity probe prompt", max_new_tokens=6,
+                            temperature=0.0, timeout_s=120)
+        assert r.finish_reason in ("stop", "length")
+        assert r.replica_id == 0
+        eng = ContinuousBatchingEngine(model_config=CFG, **ENGINE_KW)
+        local = eng.run_all(["parity probe prompt"], max_new_tokens=6)[0]
+        assert list(r.tokens) == list(local.tokens)
+        assert r.text == local.text
+
+    def test_stream_parity_and_stats_out(self, worker):
+        """Streaming crosses the boundary as incremental token frames; the
+        reassembled text matches the blocking path's, and the stats_out
+        contract (logprob accumulators filled before exhaustion) holds."""
+        prompt = "stream parity probe prompt"
+        blocking = worker.generate(prompt, max_new_tokens=6,
+                                   temperature=0.0, timeout_s=120)
+        stats_out: dict = {}
+        text = "".join(worker.generate_stream(
+            prompt, max_new_tokens=6, temperature=0.0, timeout_s=120,
+            stats_out=stats_out,
+        ))
+        assert text == blocking.text
+        assert stats_out.get("replica_id") == 0
+        assert stats_out.get("tokens") == len(blocking.tokens)
+
+    def test_routing_probes_and_admission_check(self, worker):
+        """The read-side probe surface ReplicaSet routes on: peek_prefix
+        sees the radix pages the parity prompts left behind, the status
+        frames feed backlog/heartbeat, and check_admission round-trips."""
+        worker.generate("routing probe session head prompt",
+                        max_new_tokens=2, temperature=0.0, timeout_s=120)
+        toks = _tokenizer().encode("routing probe session head prompt",
+                                   add_bos=True)
+        assert worker.engine.peek_prefix(list(toks)) > 0
+        assert worker.engine.peek_prefix([499, 498, 497]) == 0
+        worker.check_admission()  # no raise = admittable
+        # backlog/heartbeat are served from the worker's pushed status
+        # frames (0.1s cadence): give the post-generate frame a beat to
+        # land rather than asserting against a stale snapshot
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and worker.backlog() != 0:
+            time.sleep(0.02)
+        assert worker.backlog() == 0
+        assert worker.heartbeat_age() is None  # idle replica: nothing stale
+        duty = worker.duty_cycle()
+        assert set(duty) == {"host", "device", "idle"}
+        stats = worker.stats()
+        assert stats["replica"] == 0
+        assert stats["completed"] >= 1
+        assert set(stats["duty_cycle"]) == {"host", "device", "idle"}
+
+    def test_expired_deadline_is_typed_across_the_boundary(self, worker):
+        """Absolute router-clock deadlines cross as remaining seconds and
+        shed with the same typed error thread mode raises."""
+        with pytest.raises(DeadlineExceededError):
+            worker.generate("expired before submit", max_new_tokens=2,
+                            deadline_ts=time.perf_counter() - 0.5,
+                            timeout_s=30)
+
+    def test_midstream_failure_is_typed(self, worker):
+        """A decode tick failure while a stream has delivered tokens is the
+        non-resumable case: the worker's typed ReplicaUnavailable must
+        cross the process boundary as the same exception type, surfaced
+        from the router-side iterator."""
+        worker.inject_fault("paged.step", delay_s=0.1)
+        it = worker.generate_stream("midstream failure probe prompt",
+                                    max_new_tokens=200, temperature=0.0,
+                                    timeout_s=120)
+        first = next(it)
+        assert first  # tokens flowed before the fault arms
+        worker.inject_fault("paged.step", error=RuntimeError("boom"),
+                            times=1)
+        with pytest.raises(ReplicaUnavailable):
+            for _ in it:
+                pass
+        worker.reset_faults()
+        # the worker CONTAINED the crash (engine reset): it still serves
+        ok = worker.generate("post failure sanity", max_new_tokens=3,
+                             temperature=0.0, timeout_s=120)
+        assert ok.finish_reason in ("stop", "length")
+        assert worker.stats()["tick_failures"] >= 1
+
+    def test_admission_shed_drain_close_no_orphans(self):
+        """A max_queue=0 worker sheds typed 429 without touching decode;
+        drain closes it and close() REAPS the process — active_children
+        must not know it afterwards."""
+        pr = ProcessReplica(_spec(max_queue=0), _tokenizer(), replica_id=7,
+                            build_timeout_s=300.0)
+        pid = pr.pid
+        try:
+            with pytest.raises(ServiceOverloaded) as exc_info:
+                pr.generate("cannot even queue", max_new_tokens=2,
+                            timeout_s=30)
+            assert exc_info.value.status == 429
+            with pytest.raises(ServiceOverloaded):
+                pr.check_admission()
+            out = pr.drain(deadline_s=5.0)
+            assert out["drained"] is True
+        finally:
+            pr.close()
+        assert pr.closed
+        with pytest.raises(ReplicaUnavailable):
+            pr.generate("after drain-close", max_new_tokens=2, timeout_s=10)
+        assert pid not in [p.pid for p in multiprocessing.active_children()]
+
+    def test_sigkill_fails_inflight_typed_then_respawns(self, worker):
+        """LAST (kills the module worker): a real SIGKILL mid-request fails
+        the blocked caller with the typed death error, latches ``broken``
+        for the supervisor, and ``respawn()`` brings a fresh worker from
+        the same spec that serves again."""
+        worker.inject_fault("paged.step", delay_s=0.2)  # keep it in flight
+        outcome: dict = {}
+
+        def call():
+            try:
+                outcome["r"] = worker.generate(
+                    "inflight kill probe", max_new_tokens=100,
+                    temperature=0.0, timeout_s=60,
+                )
+            except Exception as exc:  # noqa: BLE001 — typed or bust
+                outcome["r"] = exc
+
+        t = threading.Thread(target=call)
+        t.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and worker.backlog() < 1:
+            time.sleep(0.01)
+        assert worker.backlog() >= 1, "request never reached the worker"
+        worker.kill()  # real SIGKILL — no handlers, no unwinding
+        t.join(timeout=30)
+        assert not t.is_alive(), "caller hung across the worker SIGKILL"
+        assert isinstance(outcome["r"], ReplicaUnavailable), outcome
+        assert worker.broken
+        fresh = worker.respawn()
+        try:
+            ok = fresh.generate("respawned worker serves", max_new_tokens=3,
+                                temperature=0.0, timeout_s=180)
+            assert ok.finish_reason in ("stop", "length")
+        finally:
+            fresh.close()
+        assert multiprocessing.active_children() == []
