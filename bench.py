@@ -1095,7 +1095,7 @@ def phase_load(llm_cfg, new_tokens):
     return result
 
 
-def phase_chaos(llm_cfg, new_tokens):
+def phase_chaos(llm_cfg, new_tokens, replica_mode=None):
     """Replica chaos drill over the open-loop harness (BENCH_CHAOS=1):
     a 2-replica set serves a steady Poisson arrival stream; mid-run one
     replica suffers the scenario picked by ``BENCH_CHAOS_MODE``:
@@ -1107,7 +1107,16 @@ def phase_chaos(llm_cfg, new_tokens):
       raising nothing) exactly like a hung device dispatch; nothing
       latches, so recovery rests entirely on the pump-heartbeat watchdog:
       quarantine on heartbeat age, inbox handoff to the survivor, engine
-      abandonment, in-place rebuild.
+      abandonment, in-place rebuild;
+    * ``midstream`` — half the traffic is SSE-shaped streams and the
+      replica dies while streams are MID-DELIVERY (thread mode: tick
+      fault + reset denied; process mode: a real ``SIGKILL`` armed at the
+      ``worker.stream_chunk`` point, between delivered chunks). Delivered
+      -token streams must RESUME by replay-prefill on the survivor; the
+      artifact records ``resumed_streams``, ``replayed_tokens_total``,
+      ``splice_exact`` (every resumed stream byte-identical to its
+      no-fault greedy reference) and ``non_resumable_errors`` (target 0
+      within budget).
 
     The artifact answers the operator questions: **availability**
     (completed / arrivals — the error-budget fraction is its complement),
@@ -1130,9 +1139,10 @@ def phase_chaos(llm_cfg, new_tokens):
 
     Env knobs: BENCH_CHAOS_QPS (8), BENCH_CHAOS_SECONDS (30),
     BENCH_CHAOS_KILL_AT_S (5), BENCH_CHAOS_SLOTS (8),
-    BENCH_CHAOS_SEED (1234), BENCH_CHAOS_MODE (kill|stall),
+    BENCH_CHAOS_SEED (1234), BENCH_CHAOS_MODE (kill|stall|midstream),
     BENCH_CHAOS_STALL_BUDGET_S (2), BENCH_CHAOS_REPLICA_MODE
-    (thread|process)."""
+    (thread|process, or a comma list — the caller runs this phase once
+    per listed mode from one invocation)."""
     import random
     import threading
 
@@ -1154,8 +1164,9 @@ def phase_chaos(llm_cfg, new_tokens):
     seed = int(os.environ.get("BENCH_CHAOS_SEED", "1234"))
     mode = os.environ.get("BENCH_CHAOS_MODE", "kill").strip().lower()
     stall_budget_s = float(os.environ.get("BENCH_CHAOS_STALL_BUDGET_S", "2"))
-    replica_mode = os.environ.get(
-        "BENCH_CHAOS_REPLICA_MODE", "thread").strip().lower()
+    if replica_mode is None:
+        replica_mode = os.environ.get(
+            "BENCH_CHAOS_REPLICA_MODE", "thread").strip().lower()
     gen_tokens = min(new_tokens, 16)
     rng = random.Random(seed)
 
@@ -1166,9 +1177,13 @@ def phase_chaos(llm_cfg, new_tokens):
     # default 2s is generous) but stay small next to the run window
     svc_kw = ({"tick_stall_budget_s": stall_budget_s}
               if mode == "stall" else {})
+    # midstream runs smaller ticks so every stream spans SEVERAL delivered
+    # chunks — at 8-step ticks an 8-token answer ships in one harvest and
+    # the kill can never land "between chunks" of a thread-mode stream
+    tick_steps = 4 if mode == "midstream" else 8
     engine_kw = dict(max_slots=max_slots, page_size=16, max_pages_per_seq=8,
-                     steps_per_tick=8, max_tick_steps=8, pipeline_depth=2,
-                     ignore_eos=True)
+                     steps_per_tick=tick_steps, max_tick_steps=tick_steps,
+                     pipeline_depth=2, ignore_eos=True)
     if replica_mode == "process":
         import dataclasses as _dc
 
@@ -1201,11 +1216,44 @@ def phase_chaos(llm_cfg, new_tokens):
     )
     log("phase CHAOS: warmup ...")
     rs.warmup(max_new_tokens=gen_tokens)
+    # midstream: per-prompt no-fault GREEDY references, computed before
+    # the incident — a resumed stream's spliced output must be
+    # byte-identical to the run that never saw a fault (splice_exact).
+    # Stream answers run LONGER than the generate traffic (several
+    # delivered chunks at the shrunken midstream tick) so streams spend
+    # most of their life mid-delivery — the window the kill must land in
+    stream_tokens = max(gen_tokens, 16) if mode == "midstream" \
+        else gen_tokens
+    stream_prompts = [f"midstream chaos session {i:02d} steady turn"
+                      for i in range(8)]
+    expected_text: dict = {}
+    if mode == "midstream":
+        # references run directly on the designated VICTIM (replica 1 —
+        # the one the process-mode SIGKILL arms in): its radix then holds
+        # every stream prompt's full prefix, so prefix affinity routes
+        # every drill stream onto the replica that will die, and the kill
+        # provably lands on a pump with live delivered streams instead of
+        # the idle sibling's (seeded replica inits are identical, so the
+        # reference text is valid for whichever replica resumes it)
+        for p in stream_prompts:
+            expected_text[p] = replicas[1].generate(
+                p, max_new_tokens=stream_tokens, temperature=0.0,
+                timeout_s=180).text
     set_metrics(MetricsCollector())
 
     lock = threading.Lock()
     stats = {"arrivals": 0, "ok": 0, "shed": 0, "expired": 0,
              "typed_errors": 0, "untyped_errors": 0}
+    # midstream bookkeeping: resumed-stream splice checks + streams that
+    # delivered tokens and STILL surfaced the typed mid-stream error
+    mid = {"streams": 0, "splice_checked": 0, "splice_mismatch": 0,
+           "non_resumable_errors": 0}
+    # count of streams that have delivered ≥1 chunk and are still
+    # mid-delivery RIGHT NOW: the thread-mode kill arms only while this
+    # is non-zero, so the tick fault provably lands on a replica set with
+    # live delivered streams (process mode needs no gate — its
+    # worker.stream_chunk injection point IS between delivered chunks)
+    live_delivered = [0]  # guarded-by: lock
     # (arrival time relative to t_start, e2e latency ms) for completions
     completions: list[tuple[float, float]] = []
     t_state = {"kill": None, "detect": None, "recover": None, "done": False}
@@ -1233,6 +1281,51 @@ def phase_chaos(llm_cfg, new_tokens):
             with lock:
                 stats["typed_errors"] += 1
         except Exception:  # noqa: BLE001 — the number that must stay zero
+            with lock:
+                stats["untyped_errors"] += 1
+
+    def stream_worker(prompt: str, t_rel: float) -> None:
+        t0 = time.perf_counter()
+        so: dict = {}
+        pieces: list = []
+        try:
+            try:
+                for piece in rs.generate_stream(
+                        prompt, max_new_tokens=stream_tokens,
+                        temperature=0.0, timeout_s=180, stats_out=so):
+                    pieces.append(piece)
+                    if len(pieces) == 1:
+                        with lock:
+                            live_delivered[0] += 1
+                dt_ms = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    stats["ok"] += 1
+                    completions.append((t_rel, dt_ms))
+                    if so.get("resumed"):
+                        mid["splice_checked"] += 1
+                        if "".join(pieces) != expected_text.get(prompt):
+                            mid["splice_mismatch"] += 1
+            finally:
+                # the kill-arming gate reads this: EVERY exit path of a
+                # stream that delivered (incl. a resume re-admission shed
+                # AFTER chunks were out) must unwind its live increment
+                if pieces:
+                    with lock:
+                        live_delivered[0] -= 1
+        except ServiceOverloaded:
+            with lock:
+                stats["shed"] += 1
+        except DeadlineExceededError:
+            with lock:
+                stats["expired"] += 1
+        except SentioError:
+            with lock:
+                stats["typed_errors"] += 1
+                # delivered tokens AND a typed mid-stream error: the
+                # resume machinery did not save this stream
+                if pieces:
+                    mid["non_resumable_errors"] += 1
+        except Exception:  # noqa: BLE001 — must stay zero
             with lock:
                 stats["untyped_errors"] += 1
 
@@ -1264,7 +1357,17 @@ def phase_chaos(llm_cfg, new_tokens):
     seq = 0
     while time.perf_counter() - t_start < run_s:
         t_rel = time.perf_counter() - t_start
-        if not killed and t_rel >= kill_at_s:
+        # thread-mode midstream holds its fire until a stream is provably
+        # mid-delivery (≥1 chunk out, not finished): a tick fault armed
+        # into an idle-stream window would drill plain failover, not
+        # resume-by-replay. Process mode needs no gate — the SIGKILL arms
+        # at worker.stream_chunk, BETWEEN delivered chunks by definition.
+        if mode == "midstream" and replica_mode != "process":
+            with lock:
+                midstream_ready = live_delivered[0] > 0
+        else:
+            midstream_ready = True
+        if not killed and t_rel >= kill_at_s and midstream_ready:
             if replica_mode == "process":
                 # the fault arms INSIDE the victim's worker process via
                 # the RPC fault surface: its next decode tick either takes
@@ -1275,6 +1378,12 @@ def phase_chaos(llm_cfg, new_tokens):
                 if mode == "stall":
                     victim.inject_fault("paged.step",
                                         stall_s=run_s + 300.0, times=1)
+                elif mode == "midstream":
+                    # a real SIGKILL BETWEEN delivered stream chunks: the
+                    # victim dies exactly while a stream is mid-delivery,
+                    # the case only resume-by-replay can save
+                    victim.inject_fault("worker.stream_chunk",
+                                        kill_process=True, times=1)
                 else:
                     victim.inject_fault("paged.step", kill_process=True,
                                         times=1)
@@ -1298,8 +1407,21 @@ def phase_chaos(llm_cfg, new_tokens):
             killed = True
             log(f"phase CHAOS: replica {mode} armed at t={t_rel:.1f}s "
                 f"({replica_mode})")
-        prompt = f"chaos session {seq % 8:02d} steady traffic turn {seq}"
-        t = threading.Thread(target=worker, args=(prompt, t_rel), daemon=True)
+        if mode == "midstream":
+            # the midstream drill's offered traffic is ALL SSE-shaped
+            # streams (the generate path is what the kill/stall modes
+            # drill): combined with victim-side reference warming above,
+            # the one-shot fault lands on a pump with live delivered
+            # streams to splice
+            sp = stream_prompts[seq % len(stream_prompts)]
+            with lock:
+                mid["streams"] += 1
+            t = threading.Thread(target=stream_worker, args=(sp, t_rel),
+                                 daemon=True)
+        else:
+            prompt = f"chaos session {seq % 8:02d} steady traffic turn {seq}"
+            t = threading.Thread(target=worker, args=(prompt, t_rel),
+                                 daemon=True)
         t.start()
         threads.append(t)
         with lock:
@@ -1338,7 +1460,9 @@ def phase_chaos(llm_cfg, new_tokens):
                   "seed": seed, "mode": mode,
                   "replica_mode": replica_mode,
                   **({"stall_budget_s": stall_budget_s}
-                     if mode == "stall" else {})},
+                     if mode == "stall" else {}),
+                  **({"stream_tokens": stream_tokens}
+                     if mode == "midstream" else {})},
         **stats,
         "hung": hung,
         # the headline: fraction of offered requests that completed — its
@@ -1364,6 +1488,20 @@ def phase_chaos(llm_cfg, new_tokens):
         "handed_off_tickets": set_stats.get("handed_off", 0),
         "stall_quarantines": set_stats.get("stall_quarantines", 0),
     }
+    if mode == "midstream":
+        # resumable-stream telemetry: every delivered-token stream the
+        # incident touched should RESUME (non_resumable_errors == 0 within
+        # budget) and every resumed completion should be byte-identical to
+        # its no-fault greedy reference (splice_exact)
+        out["streams_offered"] = mid["streams"]
+        out["resumed_streams"] = set_stats.get("stream_resumes", 0)
+        out["replayed_tokens_total"] = set_stats.get(
+            "resume_replayed_tokens", 0)
+        out["resume_exhausted"] = set_stats.get("resume_exhausted", 0)
+        out["non_resumable_errors"] = mid["non_resumable_errors"]
+        out["resumed_completions_checked"] = mid["splice_checked"]
+        out["splice_exact"] = (mid["splice_mismatch"] == 0
+                               if mid["splice_checked"] else None)
     if steady:
         out["steady_p95_ms"] = round(_percentile(steady, 0.95), 2)
     if incident:
@@ -1390,12 +1528,19 @@ def phase_chaos(llm_cfg, new_tokens):
             time.sleep(0.05)
         out["orphan_workers"] = len(multiprocessing.active_children())
     set_metrics(MetricsCollector())
-    log(f"phase CHAOS[{mode}]: availability={out['availability']} "
+    extra = ""
+    if mode == "midstream":
+        extra = (f" resumed={out['resumed_streams']} "
+                 f"replayed={out['replayed_tokens_total']} "
+                 f"splice_exact={out['splice_exact']} "
+                 f"non_resumable={out['non_resumable_errors']}")
+    log(f"phase CHAOS[{mode}/{replica_mode}]: "
+        f"availability={out['availability']} "
         f"detect={out['detection_latency_s']}s "
         f"ttr={out['time_to_recover_s']}s "
         f"incident_p95={out.get('incident_p95_ms')}ms "
         f"handed_off={out['handed_off_tickets']} "
-        f"untyped={stats['untyped_errors']}")
+        f"untyped={stats['untyped_errors']}{extra}")
     return out
 
 
@@ -1641,9 +1786,26 @@ def main() -> None:
     load = phase_load(llm_cfg, new_tokens) \
         if os.environ.get("BENCH_LOAD") == "1" else None
     # replica-kill chaos drill: availability, incident-window p95, and
-    # time-to-recover for a mid-run replica loss with reset forced to fail
-    chaos = phase_chaos(llm_cfg, new_tokens) \
-        if os.environ.get("BENCH_CHAOS") == "1" else None
+    # time-to-recover for a mid-run replica loss. BENCH_CHAOS_REPLICA_MODE
+    # accepts a comma list (e.g. "thread,process") — the drill then runs
+    # once per replica mode from this one invocation, and the chaos
+    # section becomes a per-mode matrix
+    chaos = None
+    if os.environ.get("BENCH_CHAOS") == "1":
+        chaos_modes = [m.strip().lower() for m in os.environ.get(
+            "BENCH_CHAOS_REPLICA_MODE", "thread").split(",") if m.strip()]
+        if len(chaos_modes) <= 1:
+            chaos = phase_chaos(
+                llm_cfg, new_tokens,
+                replica_mode=(chaos_modes[0] if chaos_modes else "thread"))
+        else:
+            chaos = {
+                "replica_mode_matrix": chaos_modes,
+                "per_replica_mode": {
+                    m: phase_chaos(llm_cfg, new_tokens, replica_mode=m)
+                    for m in chaos_modes
+                },
+            }
 
     total_s = time.perf_counter() - t_start
     log(f"bench wall {total_s:.0f}s")
@@ -1690,6 +1852,17 @@ def main() -> None:
                     longctx, speculative, load, chaos):
         if isinstance(section, dict):
             section["device_platform"] = plat
+    # nested per-mode summaries stamped too (PR 12 known gap for
+    # verify_sweep; the chaos replica-mode matrix gets the same treatment):
+    # any sub-dict copied out of the artifact still names its platform
+    if isinstance(verify_sweep, dict):
+        for sub in verify_sweep.values():
+            if isinstance(sub, dict):
+                sub["device_platform"] = plat
+    if isinstance(chaos, dict):
+        for sub in (chaos.get("per_replica_mode") or {}).values():
+            if isinstance(sub, dict):
+                sub["device_platform"] = plat
     print(json.dumps(payload))
     if fallback_reason:
         # repeated LAST so the banner cannot scroll away under phase logs

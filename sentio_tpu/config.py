@@ -442,6 +442,11 @@ class ServeConfig:
     # ReplicaSet-layer failover retries per request after a replica dies
     # under it (PR 5's crash retry budget, lifted across replicas)
     replica_failover_budget: int = 1
+    # resume-by-replay budget for DELIVERED-token streams (mid-flight
+    # failover: the delivered prefix replays onto a survivor and decode
+    # continues from the splice point): -1 follows the failover budget,
+    # 0 disables resumption and keeps the typed mid-stream error
+    stream_resume_budget: int = -1
     # ---- stall detection & watchdog ----
     # wall-clock budget one pump loop iteration may take before the
     # watchdog declares the replica STALLED (heartbeat stale with pending
@@ -524,6 +529,7 @@ class ServeConfig:
             replica_failover_budget=_env_int(
                 ["REPLICA_FAILOVER_BUDGET"], 1
             ),
+            stream_resume_budget=_env_int(["STREAM_RESUME_BUDGET"], -1),
             tick_stall_budget_s=_env_float(["TICK_STALL_BUDGET_S"], 120.0),
             warmup_budget_s=_env_float(["WARMUP_BUDGET_S"], 600.0),
             replica_rebuild_workers=_env_int(

@@ -5,7 +5,10 @@ are simulated ad hoc with mocks in its tests) and prescribes adding "a
 fault-injection hook (drop/deadline a batch) for tests" to the build. This
 module is that hook: named injection points are planted at the framework's
 failure-relevant seams (device dispatch in the generator engine, retriever
-legs, reranker batches), default to no-ops with near-zero overhead, and
+legs, reranker batches, ``worker.stream_chunk`` between a process-mode
+worker's delivered stream chunks — the mid-stream death the resumable-
+stream drills arm ``kill_process``/``stall_s`` at), default to no-ops with
+near-zero overhead, and
 tests (or chaos drills) arm them with rules — fail N times, fail with a
 given exception, add latency, fail with probability p under a seeded RNG,
 or **stall**: block inside the injection point for a duration (or until the
@@ -50,6 +53,10 @@ class FaultRule:
     * ``error`` — exception instance to raise (a fresh copy each hit via
       type(error)(*error.args), so tracebacks don't chain weirdly).
     * ``times`` — fire for the first N hits, then disarm (None = forever).
+    * ``skip`` — ignore the first N hits entirely (fire from hit N+1 on):
+      "the K+1th dispatch dies" armed deterministically BEFORE the work
+      starts — e.g. a mid-stream kill that must land only after at least
+      one decode tick's tokens were delivered.
     * ``probability`` — fire with this probability (seeded ``rng`` makes it
       deterministic in tests).
     * ``delay_s`` — sleep before (optionally) failing: deadline simulation.
@@ -78,12 +85,16 @@ class FaultRule:
     stall_s: Optional[float] = None
     stall_event: Optional[threading.Event] = None
     kill_process: bool = False
+    skip: int = 0
     rng: random.Random = field(default_factory=lambda: random.Random(0))
     hits: int = 0
     fired: int = 0
     stalled: int = 0
 
     def should_fire(self) -> bool:
+        # hits is incremented BEFORE this check: skip=N passes hits 1..N
+        if self.hits <= self.skip:
+            return False
         if self.times is not None and self.fired >= self.times:
             return False
         return self.probability >= 1.0 or self.rng.random() < self.probability
@@ -159,6 +170,7 @@ def inject(
     delay_s: float = 0.0,
     stall_s: Optional[float] = None,
     stall_event: Optional[threading.Event] = None,
+    skip: int = 0,
     seed: int = 0,
 ) -> Iterator[FaultRule]:
     """Arm ``point`` for the duration of the block; yields the rule so the
@@ -168,7 +180,7 @@ def inject(
     rule = FaultRule(
         error=error, times=times, probability=probability,
         delay_s=delay_s, stall_s=stall_s, stall_event=stall_event,
-        rng=random.Random(seed),
+        skip=skip, rng=random.Random(seed),
     )
     arm(point, rule)
     try:

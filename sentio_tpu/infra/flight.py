@@ -12,7 +12,11 @@ Two bounded, thread-safe stores:
 * a **tick ring buffer** — one event per engine pump tick (wall time, batch
   occupancy, queue depth, prefill/decode token counts, speculative accepts,
   prefix-cache hits, page-pool free/used), appended by the decode pump and
-  read by ``/debug/flight``, ``sentio trace``, and ``bench.py``;
+  read by ``/debug/flight``, ``sentio trace``, and ``bench.py``. The same
+  ring carries the replica-supervision vocabulary: ``replica_health``,
+  ``pump_stall``, ``inbox_handoff``, ``tick_failure``, and
+  ``stream_resumed`` (a delivered-token stream spliced onto a survivor —
+  ``replica_from``/``replica_to``, ``replayed_tokens``, ``splice_index``);
 * a **request table** — per-request records keyed by the serving layer's
   ``query_id`` (graph node timings, TTFT, TPOT, token counts, and the tick
   window the request's decode rode), LRU-evicted at ``max_requests``.

@@ -273,6 +273,18 @@ class MetricsCollector:
                 "replica worker process deaths observed by the router",
                 ["replica"], registry=r,
             ),
+            # resumable streams (runtime/replica.py): mid-flight failovers
+            # of delivered-token streams. outcome=resumed is the healthy
+            # path; a sustained resume RATE means a replica is flapping —
+            # monitoring.yaml's SentioTpuStreamResumeStorm alerts on it
+            "stream_resumes": Counter(
+                "sentio_tpu_stream_resumes_total",
+                "mid-flight stream resume outcomes (resumed = delivered "
+                "prefix spliced onto a survivor; exhausted = resume budget "
+                "spent, typed error surfaced; failed = no survivor could "
+                "take the splice; opt_out = caller disabled resumption)",
+                ["outcome"], registry=r,
+            ),
         }
 
     # ------------------------------------------------------------- recording
@@ -459,6 +471,17 @@ class MetricsCollector:
         counter = self._prom.get("worker_deaths")
         if counter is not None:
             counter.labels(str(replica)).inc()
+
+    def record_stream_resume(self, outcome: str) -> None:
+        """One mid-flight stream resume outcome (``outcome``: resumed |
+        exhausted | failed | opt_out) — the counter behind
+        ``sentio_tpu_stream_resumes_total``."""
+        if not self.enabled:
+            return
+        self.memory.inc("stream_resumes", (outcome,))
+        counter = self._prom.get("stream_resumes")
+        if counter is not None:
+            counter.labels(outcome).inc()
 
     def record_replica_health(self, replica: int, state: str) -> None:
         """Publish one replica's health-state transition: the new state's

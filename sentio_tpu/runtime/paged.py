@@ -409,6 +409,19 @@ class _Request:
     # lazily cached tokenization — _admit may inspect a queued request many
     # times (skip-ahead scans the queue every tick) without re-encoding
     tok_ids: Optional[list] = None
+    # prior-prefix admission (resume-by-replay, runtime/replica.py): token
+    # ids appended after the truncated prompt as already-generated context.
+    # The prompt truncation reserve is computed as if max_new were
+    # max_new + len(prior_tokens), which reproduces the ORIGINAL
+    # admission's truncation exactly — the resumed context is byte-for-byte
+    # the dead replica's context at the splice point.
+    prior_tokens: Optional[list] = None
+    # per-request sampling seed (None = leave the engine RNG stream alone):
+    # folded ONCE into the engine's shared RNG at admission. Best-effort —
+    # the engine RNG advances per tick for the whole batch, so this only
+    # yields reproducible draws when the request is the engine's sole
+    # sampled traffic; it is NOT a per-request pinned stream
+    seed: Optional[int] = None
 
 
 @dataclass
@@ -982,12 +995,22 @@ class ContinuousBatchingEngine:
     # --------------------------------------------------------------- public
 
     def submit(self, prompt: str, max_new_tokens: int = 64, temperature: float = 0.0,
-               deadline_ts: Optional[float] = None, top_k: int = 0) -> int:
+               deadline_ts: Optional[float] = None, top_k: int = 0,
+               prior_tokens: Optional[Sequence[int]] = None,
+               seed: Optional[int] = None) -> int:
         """``deadline_ts`` is an absolute ``time.perf_counter()`` deadline:
         the queue drops the request (finish_reason="expired") if it is still
         waiting for a slot when the deadline passes. ``top_k`` (0 = off)
         rides the fused decode dispatch as traced per-row data — any value
-        shares the one compiled tick program."""
+        shares the one compiled tick program.
+
+        ``prior_tokens`` is the prior-prefix admission surface (resume-by-
+        replay, runtime/replica.py): already-generated token ids appended
+        after the (truncation-exact) prompt as context, so decode continues
+        from the splice point. The radix cache turns the replay into a
+        prefix hit when the pages survive here, and a bounded replay
+        prefill otherwise; emitted tokens are post-splice only.
+        ``seed`` (None = off) folds into the engine RNG at admission."""
         if self._san is not None:
             self._san.enter("submit")
         top_k = int(top_k)
@@ -1000,6 +1023,8 @@ class ContinuousBatchingEngine:
         self._queue.append(_Request(
             rid, prompt, max_new_tokens, temperature, top_k=max(top_k, 0),
             submit_t=time.perf_counter(), deadline_ts=deadline_ts,
+            prior_tokens=(list(prior_tokens) if prior_tokens else None),
+            seed=seed,
         ))
         return rid
 
@@ -1413,16 +1438,34 @@ class ContinuousBatchingEngine:
                 ))
                 continue
             if req.tok_ids is None:
-                req.tok_ids = self.tokenizer.encode(req.prompt, add_bos=True)
+                prompt_ids = self.tokenizer.encode(req.prompt, add_bos=True)
+                # budget split inside the per-sequence page window:
+                # generation gets its requested tokens up to HALF the window
+                # (else decode retires on out_of_pages after window - prompt
+                # tokens); the prompt always keeps at least the other half,
+                # so a huge max_new can never silently truncate most of the
+                # context. A prior-prefix admission (resume-by-replay)
+                # counts the prior toward the reserve — max_new + len(prior)
+                # equals the ORIGINAL request's max_new, so the prompt
+                # truncates exactly as it did at first admission and the
+                # resumed context is byte-identical up to the splice.
+                window = self.max_pages_per_seq * self.page_size
+                prior = req.prior_tokens or []
+                reserve = min(req.max_new + len(prior) + 2, window // 2)
+                req.tok_ids = prompt_ids[: window - reserve] + list(prior)
+                if req.seed is not None:
+                    # fold the caller's seed into the ENGINE-SHARED RNG
+                    # once, at first admission scan. Best-effort seeding:
+                    # with concurrent sampled traffic the shared stream's
+                    # position depends on tick interleaving, so this pins
+                    # draws only for a lone sampled request (the resumed
+                    # continuation's correctness does not depend on it —
+                    # it conditions on the replayed prefix either way)
+                    import jax
+
+                    self._rng = jax.random.fold_in(
+                        self._rng, int(req.seed) & 0x7FFFFFFF)
             tok_ids = req.tok_ids
-            # budget split inside the per-sequence page window: generation
-            # gets its requested tokens up to HALF the window (else decode
-            # retires on out_of_pages after window - prompt tokens); the
-            # prompt always keeps at least the other half, so a huge
-            # max_new can never silently truncate most of the context
-            window = self.max_pages_per_seq * self.page_size
-            reserve = min(req.max_new + 2, window // 2)
-            tok_ids = tok_ids[: window - reserve]
             # radix-cache hit: longest page-aligned prefix of this prompt
             # already in the pool → the table reuses those pages read-only
             # and only the unmatched suffix prefills

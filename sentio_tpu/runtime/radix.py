@@ -26,6 +26,18 @@ Design constraints that shape the structure:
 Single-threaded by contract, like the engine that owns it: only the pump
 thread calls in. The tree never talks to the device — it tracks integer
 page ids; the engine orders actual KV writes via its dispatch sequence.
+
+**Prior-prefix admissions** (resume-by-replay, runtime/replica.py): a
+resumed stream re-admits with its delivered tokens appended after the
+prompt, so the token sequences this tree matches and inserts are NOT
+always pure prompts — they may embed generated continuations. Nothing in
+the tree distinguishes the two (tokens are tokens), which is exactly what
+makes the replay cheap: when the dead stream's prompt pages were already
+cached here, the resume admission matches them and prefills only the
+delivered suffix; the insert afterwards caches prompt+delivered, so a
+SECOND resume of the same stream (a flapping replica) is a full-prefix
+hit. Eviction, pinning, and page accounting are oblivious to the origin
+of the tokens — the conservation invariants hold unchanged.
 """
 
 from __future__ import annotations

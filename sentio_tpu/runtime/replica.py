@@ -62,8 +62,25 @@ domain with a supervised health state machine::
   delivered tokens) that dies with a replica-infrastructure failure is
   re-admitted (WFQ released, then re-charged — failover never
   double-counts quota) and re-routed to a surviving replica, bounded by a
-  per-request failover budget. Streams with delivered tokens stay
-  non-resumable and surface a typed error.
+  per-request failover budget.
+
+**Resumable streams** — a stream that dies WITH delivered tokens cannot
+restart (replay would duplicate output), so it is **resumed by
+replay-prefill**: the router tracks the exact delivered token ids per
+piece (:class:`~sentio_tpu.runtime.service.StreamProgress`) plus the
+call-time sampling knobs, and on a mid-stream replica failure re-admits
+on a survivor with ``prior_tokens`` = the delivered prefix — the radix
+cache turns the replay into a prefix hit when the prompt pages survive
+there, and a bounded replay prefill otherwise. Decode continues from the
+splice point and the router yields only post-splice text (re-decoded
+over the full token sequence, so UTF-8 withholding at the splice cannot
+duplicate or drop characters). Greedy resumes are token-exact vs a
+no-fault run; sampled resumes carry the seed and knobs so the
+continuation is distribution-correct. ``stream_resume_budget``
+(default = failover budget; 0 disables) caps attempts per stream;
+opted-out or budget-exhausted streams keep the typed mid-stream error.
+Each resume emits a ``stream_resumed`` flight event and counts into
+``sentio_tpu_stream_resumes_total{outcome}`` and ``stats()``.
 
 **Stall tolerance** — the breaker only sees faults that *raise*; a tick
 that hangs inside a wedged device dispatch raises nothing. The supervisor
@@ -103,9 +120,14 @@ prefix-affinity probe is a short-timeout RPC that skips wedged workers
 (a stale status frame reads as a cold cache), the watchdog reads the
 worker's own pump heartbeat, quarantine abandons
 via RPC, and the rebuild path respawns the process (``respawn()``)
-instead of swapping an in-process service. See runtime/worker.py for the
-deliberate semantic deltas (no cross-process inbox handoff; worker
-compiles outside the router's fence).
+instead of swapping an in-process service. Under a supervising set the
+process replicas arm **router-side ticket shadowing**
+(``enable_shadow_handoff``): a dead worker's never-answered tickets are
+extracted from the router-side shadow queue and re-admitted on survivors
+through the same ``_handoff_inbox`` path as thread mode — handoff parity.
+See runtime/worker.py for the remaining deliberate semantic deltas
+(mid-decode generates may re-execute on handoff; worker compiles outside
+the router's fence).
 """
 
 from __future__ import annotations
@@ -129,6 +151,7 @@ from sentio_tpu.infra.metrics import get_metrics
 from sentio_tpu.infra.phases import duty_fractions
 from sentio_tpu.runtime.service import (
     PagedGenerationService,
+    StreamProgress,
     finish_ticket_error,
 )
 
@@ -473,6 +496,7 @@ class ReplicaSet:
         rebuild_budget: int = 3,
         rebuild_drain_s: float = 5.0,
         failover_budget: int = 1,
+        stream_resume_budget: Optional[int] = None,
         rebuild_workers: int = 1,
     ) -> None:
         services = list(services)
@@ -530,6 +554,15 @@ class ReplicaSet:
         # ReplicaSet-layer retry budget for failed-over requests (PR 5's
         # per-ticket crash retry budget, lifted across replicas)
         self.failover_budget = max(int(failover_budget), 0)
+        # resume-by-replay budget for DELIVERED-token streams (the case
+        # plain failover cannot restart without duplicating output): None
+        # follows the failover budget; 0 disables resumption and keeps the
+        # pre-resume typed mid-stream error (STREAM_RESUME_BUDGET env via
+        # serve/dependencies.py)
+        self.stream_resume_budget = (
+            max(int(stream_resume_budget), 0)
+            if stream_resume_budget is not None else self.failover_budget
+        )
         self._health = [
             _ReplicaHealth(since=time.perf_counter(),
                            # baseline, not zero: pre-existing tick failures
@@ -548,6 +581,12 @@ class ReplicaSet:
         self._handed_off = 0  # guarded-by: _mutex
         self._stall_quarantines = 0  # guarded-by: _mutex
         self._pump_leaked_carryover = 0  # guarded-by: _mutex
+        # resumable-stream telemetry: successful mid-flight splices, the
+        # delivered tokens replayed for them, and streams whose resume
+        # budget (or opt-out) still surfaced the typed mid-stream error
+        self._stream_resumes = 0  # guarded-by: _mutex
+        self._resume_replayed_tokens = 0  # guarded-by: _mutex
+        self._resume_exhausted = 0  # guarded-by: _mutex
         metrics = get_metrics()
         for i in range(len(services)):
             metrics.record_replica_health(i, HEALTH_HEALTHY)
@@ -563,6 +602,17 @@ class ReplicaSet:
         self._rebuild_q: Optional[_queue.Queue] = None
         self._rebuild_pool: list[threading.Thread] = []
         if supervise:
+            # process-mode replicas (runtime/worker.py) mirror their
+            # never-dispatched tickets router-side; with a supervisor
+            # running, a dead worker's shadowed tickets are handed off to
+            # survivors instead of failing typed — parity with thread
+            # mode's quarantine inbox handoff. Without a supervisor nobody
+            # would ever extract the shadow queue, so the flag stays off
+            # and death keeps its fail-fast typed surface.
+            for svc in services:
+                enable = getattr(svc, "enable_shadow_handoff", None)
+                if enable is not None:
+                    enable()
             if self.rebuild_workers > 0:
                 self._rebuild_q = _queue.Queue()
                 self._rebuild_pool = [
@@ -815,9 +865,26 @@ class ReplicaSet:
         tenant: Optional[str] = None,
         priority: str = PRIORITY_INTERACTIVE,
         stats_out: Optional[dict] = None,
+        seed: Optional[int] = None,
+        resumable: bool = True,
     ) -> Iterator[str]:
+        """Streaming with MID-FLIGHT failover. A stream that dies before
+        delivering anything fails over like a generate (fresh restart on a
+        survivor, within ``failover_budget``). A stream that dies WITH
+        delivered tokens — today's only non-resumable case before this —
+        is RESUMED by replay-prefill: the router re-admits on a survivor
+        with the exact delivered token prefix as a prior context suffix
+        (``prior_tokens``), decode continues from the splice point, and
+        only post-splice text is yielded — the client sees one
+        uninterrupted stream with zero duplicated and zero missing tokens.
+        Greedy resumes are token-exact vs a no-fault run; sampled resumes
+        carry the call-time knobs (temperature/top_k/``seed``) so the
+        continuation is distribution-correct. ``resumable=False`` (or
+        ``stream_resume_budget=0``) opts out and keeps the typed
+        mid-stream error."""
         toks = self._route_tokens(prompt)
         idx, _hit = self._route(toks)
+        progress = StreamProgress()
         kwargs = dict(
             max_new_tokens=max_new_tokens, temperature=temperature,
             timeout_s=timeout_s, request_id=request_id,
@@ -832,6 +899,11 @@ class ReplicaSet:
             tenant=tenant or DEFAULT_TENANT, priority=priority,
             cost_tokens=len(toks) + max_new_tokens,
             stats_out=stats_out,
+            # delivered-state tracking: per-piece token ids mirrored by the
+            # replica's stream impl — the splice a resume re-admits; the
+            # sampling knobs above (temperature/top_k) plus this seed are
+            # stamped at CALL time and ride kwargs into every attempt
+            seed=seed, progress=progress,
         )
         # the replica's own generate_stream runs its CALL-time validation
         # (top_k vs paged speculation) here, before any SSE 200 commits;
@@ -841,16 +913,33 @@ class ReplicaSet:
         inner = svc.generate_stream(prompt, **kwargs)
         return self._stream_impl(inner, idx, svc, toks, prompt, kwargs,
                                  tenant or DEFAULT_TENANT,
-                                 len(toks) + max_new_tokens, priority)
+                                 len(toks) + max_new_tokens, priority,
+                                 progress, max_new_tokens, resumable)
 
     def _stream_impl(self, inner: Iterator[str], idx: int, svc,
                      toks: Sequence[int], prompt: str, kwargs: dict,
-                     tenant: str, cost: int,
-                     priority: str) -> Iterator[str]:
-        attempts = 0
+                     tenant: str, cost: int, priority: str,
+                     progress: StreamProgress, max_new_tokens: int,
+                     resumable: bool) -> Iterator[str]:
+        attempts = 0   # fresh-restart failovers (nothing delivered yet)
+        resumes = 0    # replay-prefill resumes (delivered tokens spliced)
         tried = {idx}
+        base: list[int] = []  # token ids delivered by PRIOR attempts
+        flushed = ""          # text already yielded to the caller
+        # a resume is BOOKED (counters, flight event, metric) only after
+        # its attempt clears the loop-top WFQ admission below — booking in
+        # the except branch would count a resume the quota then shed
+        pending_resume_note: Optional[tuple] = None
         while True:
-            charged = self.tenants.admit(tenant, cost, priority=priority)
+            try:
+                charged = self.tenants.admit(tenant, cost, priority=priority)
+            except BaseException:
+                if pending_resume_note is not None:
+                    self._record_resume_outcome("failed")
+                raise
+            if pending_resume_note is not None:
+                self._note_resume(*pending_resume_note)
+                pending_resume_note = None
             if kwargs.get("tenant") != charged:
                 # the reservation landed under a DIFFERENT key than the one
                 # stamped at call time (overflow bucketing): re-create the
@@ -861,11 +950,46 @@ class ReplicaSet:
                 # first next()), so no ticket or admission leaks.
                 kwargs["tenant"] = charged
                 inner = svc.generate_stream(prompt, **kwargs)
-            delivered = False
             try:
-                for piece in inner:
-                    delivered = True
-                    yield piece
+                if not base:
+                    # first attempt (or fresh restart): forward verbatim —
+                    # the zero-overhead happy path; the service's own UTF-8
+                    # withholding already shaped the pieces
+                    for piece in inner:
+                        flushed += piece
+                        yield piece
+                else:
+                    # resumed attempt: the inner stream's pieces decode the
+                    # CONTINUATION tokens in isolation, which may not
+                    # splice cleanly onto text the dead attempt already
+                    # flushed (withheld trailing chars, multi-token UTF-8).
+                    # Re-decode the FULL delivered sequence at each piece
+                    # and yield only what extends the flushed prefix: zero
+                    # duplicated, zero missing tokens by construction.
+                    for _piece in inner:
+                        text = self.tokenizer.decode(
+                            base + list(progress.tokens))
+                        safe = text[:-1] if text.endswith("�") else text
+                        if len(safe) > len(flushed):
+                            delta = safe[len(flushed):]
+                            flushed = safe
+                            yield delta
+                    # final flush is unconditional, like the service's own
+                    # done-path: a finished answer may end in a replacement
+                    # char for real
+                    text = self.tokenizer.decode(base + list(progress.tokens))
+                    if len(text) > len(flushed):
+                        delta = text[len(flushed):]
+                        flushed = text
+                        yield delta
+                stats_out = kwargs.get("stats_out")
+                if stats_out is not None and resumes:
+                    # the service's done-path stats cover the CONTINUATION
+                    # request only; restore the whole-stream token count and
+                    # stamp the resume provenance for bench/confidence sinks
+                    stats_out["tokens"] = len(base) + len(progress.tokens)
+                    stats_out["resumed"] = resumes
+                    stats_out["replayed_tokens"] = len(base)
                 self.tenants.release(charged, cost)
                 self._note_success(idx, svc)
                 return
@@ -873,23 +997,119 @@ class ReplicaSet:
                 # streams release at close/exhaust/error with the estimate —
                 # the exact split is not worth holding the reservation open
                 self.tenants.release(charged, cost)
-                if self._is_replica_failure(exc):
-                    self._note_failure(idx, exc, svc)
-                    # delivered tokens make a stream non-resumable (replay
-                    # would duplicate output): the typed error propagates
-                    if not delivered and attempts < self.failover_budget:
-                        tried.add(idx)
-                        attempts += 1
-                        with self._mutex:
-                            self._failovers += 1
-                        # may itself raise typed ReplicaUnavailable when no
-                        # survivor exists — still a typed terminal outcome
-                        idx, _hit = self._route(toks,
-                                                exclude=frozenset(tried))
-                        svc = self._services[idx]
-                        inner = svc.generate_stream(prompt, **kwargs)
-                        continue
+                if not self._is_replica_failure(exc):
+                    raise
+                self._note_failure(idx, exc, svc)
+                delivered = bool(flushed) or bool(base)
+                if not delivered and attempts < self.failover_budget:
+                    tried.add(idx)
+                    attempts += 1
+                    with self._mutex:
+                        self._failovers += 1
+                    progress.reset()
+                    # may itself raise typed ReplicaUnavailable when no
+                    # survivor exists — still a typed terminal outcome
+                    idx, _hit = self._route(toks, exclude=frozenset(tried))
+                    svc = self._services[idx]
+                    inner = svc.generate_stream(prompt, **kwargs)
+                    continue
+                if delivered and resumable \
+                        and resumes < self.stream_resume_budget:
+                    from_idx = idx
+                    tried.add(idx)
+                    resumes += 1
+                    base = base + list(progress.tokens)
+                    progress.reset()
+                    remaining = max_new_tokens - len(base)
+                    if remaining <= 0:
+                        # every requested token was already delivered; only
+                        # a final flush can be owed — emit it and finish
+                        # without re-admitting anything. replica_to=-1:
+                        # the death was absorbed with NO survivor
+                        # re-admission, so the event must not claim a
+                        # splice landed on some replica
+                        text = self.tokenizer.decode(base)
+                        self._note_resume(from_idx, -1, 0, len(base))
+                        stats_out = kwargs.get("stats_out")
+                        if stats_out is not None:
+                            # the dead attempt never reached its done-path
+                            # stats fill; stamp what the router knows so
+                            # bench/confidence sinks see a completed,
+                            # resumed stream instead of an empty dict
+                            stats_out["tokens"] = len(base)
+                            stats_out["resumed"] = resumes
+                            stats_out["replayed_tokens"] = 0
+                        if len(text) > len(flushed):
+                            yield text[len(flushed):]
+                        return
+                    try:
+                        # survivor choice favors the deepest cached prefix
+                        # of prompt+delivered (peek_prefix walks the full
+                        # resume context head): surviving pages turn the
+                        # replay into a prefix hit. Valid only while the
+                        # routing head covers the WHOLE prompt — toks is
+                        # clamped to route_prefix_tokens, and appending
+                        # base after a truncated head would probe a token
+                        # sequence no radix holds
+                        resume_toks = (
+                            list(toks) + base
+                            if len(toks) < self.route_prefix_tokens
+                            else list(toks)
+                        )
+                        # exclude only the replica that just died — not the
+                        # whole `tried` history: a replica a FRESH failover
+                        # left behind may have been rebuilt and healthy by
+                        # now, and `_route` already skips quarantined/
+                        # rebuilding replicas on its own
+                        idx, _hit = self._route(
+                            resume_toks, exclude=frozenset({from_idx}))
+                    except BaseException:
+                        self._record_resume_outcome("failed")
+                        raise
+                    svc = self._services[idx]
+                    kwargs["prior_tokens"] = list(base)
+                    kwargs["max_new_tokens"] = remaining
+                    inner = svc.generate_stream(prompt, **kwargs)
+                    # booked at the top of the loop AFTER the WFQ admission
+                    # for this attempt clears
+                    pending_resume_note = (from_idx, idx, len(base),
+                                           len(base))
+                    continue
+                if delivered:
+                    self._record_resume_outcome(
+                        "exhausted" if resumable
+                        and self.stream_resume_budget > 0 else "opt_out")
                 raise
+
+    def _note_resume(self, replica_from: int, replica_to: int,
+                     replayed: int, splice_index: int) -> None:
+        """Book one successful mid-flight resume: counters, the
+        ``stream_resumed`` flight event, and the outcome metric.
+        ``replica_to=-1`` marks a death absorbed with NO survivor
+        re-admission (every requested token was already delivered)."""
+        with self._mutex:
+            self._stream_resumes += 1
+            self._resume_replayed_tokens += replayed
+        self._record_resume_outcome("resumed")
+        try:
+            from sentio_tpu.infra.flight import get_flight_recorder
+
+            get_flight_recorder().record_tick(
+                event="stream_resumed", replica_from=replica_from,
+                replica_to=replica_to, replayed_tokens=replayed,
+                splice_index=splice_index,
+            )
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            logger.debug("stream resume telemetry failed", exc_info=True)
+
+    def _record_resume_outcome(self, outcome: str) -> None:
+        if outcome == "exhausted":
+            with self._mutex:
+                self._resume_exhausted += 1
+        try:
+            get_metrics().record_stream_resume(outcome)
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            logger.debug("stream resume metric failed", exc_info=True)
 
     def check_admission(
         self,
@@ -1598,6 +1818,11 @@ class ReplicaSet:
             agg["pump_leaked"] = (
                 agg.get("pump_leaked", 0) + self._pump_leaked_carryover
             )
+            # resumable streams: successful mid-flight splices, delivered
+            # tokens replayed for them, and resumes that ran out of budget
+            agg["stream_resumes"] = self._stream_resumes
+            agg["resume_replayed_tokens"] = self._resume_replayed_tokens
+            agg["resume_exhausted"] = self._resume_exhausted
         agg["tenants"] = self.tenants.stats()
         agg["health"] = self.health_summary()
         return agg

@@ -69,6 +69,7 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "PagedGenerationService",
+    "StreamProgress",
     "GenerationTimeout",
     "ServiceOverloaded",
     "DeadlineExceededError",
@@ -78,6 +79,30 @@ __all__ = [
 
 class GenerationTimeout(Exception):
     pass
+
+
+class StreamProgress:
+    """Delivered-state mirror for ONE streaming request: the exact token
+    ids behind every text piece the iterator has yielded so far.
+
+    The stream iterator REBINDS ``tokens`` right before each yield (and to
+    the authoritative ``result.tokens`` at completion), so a consumer that
+    observes a yield — or catches the iterator's mid-stream exception —
+    reads the precise delivered prefix. That prefix is what the resume-by-
+    replay path (ReplicaSet._stream_impl, runtime/replica.py) re-admits on
+    a surviving replica as a prior context suffix after the prompt: the
+    splice point for a mid-flight failover with zero duplicated and zero
+    missing tokens. Single-threaded by contract: the producer (the stream
+    iterator) and the consumer run on the SAME caller thread, interleaved
+    by the yields themselves — no lock needed or taken."""
+
+    __slots__ = ("tokens",)
+
+    def __init__(self) -> None:
+        self.tokens: list[int] = []
+
+    def reset(self) -> None:
+        self.tokens = []
 
 
 def finish_ticket_error(ticket: "_Ticket", exc: Exception,
@@ -145,6 +170,22 @@ class _Ticket:
     tenant: Optional[str] = None
     priority: Optional[str] = None
     cost_tokens: int = 0
+    # resume-by-replay (runtime/replica.py): token ids spliced in as a
+    # prior context suffix AFTER the tokenized prompt — the delivered
+    # prefix of a stream that died mid-flight on a sibling replica. The
+    # engine prefills (or radix-matches) prompt + prior and decode
+    # continues from the splice point; emitted tokens are post-splice only
+    prior_tokens: Optional[list] = None
+    # sampling seed stamped at call time (None = engine RNG stream as-is):
+    # folded once into the engine's SHARED RNG at admission — best-effort
+    # reproducibility for a lone sampled request, not a per-request pinned
+    # stream (a resumed sampled continuation is distribution-correct by
+    # conditioning on the replayed prefix, with or without the seed)
+    seed: Optional[int] = None
+    # process-mode shadow key (runtime/worker.py): the router-side RPC id
+    # this ticket is mirrored under, so a worker-side extract_inbox can
+    # name its never-dispatched tickets back to the router's shadow queue
+    shadow_id: Optional[int] = None
 
     @property
     def path(self) -> str:
@@ -265,6 +306,8 @@ class PagedGenerationService:
         tenant: Optional[str] = None,
         priority: Optional[str] = None,
         cost_tokens: int = 0,
+        seed: Optional[int] = None,
+        shadow_id: Optional[int] = None,
     ) -> PagedResult:
         """Submit one request and block until its tokens are done. Safe to
         call from any number of threads concurrently — that concurrency IS
@@ -288,7 +331,8 @@ class PagedGenerationService:
                          deadline_ts=deadline_ts,
                          retries_left=self.retry_budget,
                          tenant=tenant, priority=priority,
-                         cost_tokens=int(cost_tokens))
+                         cost_tokens=int(cost_tokens),
+                         seed=seed, shadow_id=shadow_id)
         if request_id:
             get_flight_recorder().note_engine_submit(
                 request_id, replica_id=self.replica_id)
@@ -344,6 +388,10 @@ class PagedGenerationService:
         priority: Optional[str] = None,
         cost_tokens: int = 0,
         stats_out: Optional[dict] = None,
+        prior_tokens: Optional[list] = None,
+        seed: Optional[int] = None,
+        shadow_id: Optional[int] = None,
+        progress: Optional[StreamProgress] = None,
     ) -> Iterator[str]:
         """Streaming variant: yields decoded text increments as the shared
         decode batch produces them (chunks of up to steps_per_tick tokens —
@@ -357,7 +405,14 @@ class PagedGenerationService:
         request's logprob accumulators (logprob_mean/min/count, tokens)
         right before the final yield — a text iterator cannot return the
         PagedResult, and the confidence gate needs the numbers after the
-        stream drains."""
+        stream drains.
+
+        ``prior_tokens``: resume-by-replay splice (ReplicaSet failover of a
+        delivered-token stream): these token ids are admitted as a prior
+        context suffix after the prompt, and the stream yields ONLY the
+        post-splice continuation. ``progress``: caller-owned
+        :class:`StreamProgress` mirroring the token ids behind every yield
+        — the delivered state a router needs to build the NEXT splice."""
         # validated HERE, not in the generator body: a generator function
         # defers its body to the first next(), which would surface this
         # after an SSE handler already committed its 200
@@ -365,7 +420,7 @@ class PagedGenerationService:
         return self._generate_stream_impl(
             prompt, max_new_tokens, temperature, timeout_s, request_id,
             deadline_s, deadline_ts, top_k, tenant, priority, cost_tokens,
-            stats_out,
+            stats_out, prior_tokens, seed, shadow_id, progress,
         )
 
     def _generate_stream_impl(
@@ -382,6 +437,10 @@ class PagedGenerationService:
         priority: Optional[str] = None,
         cost_tokens: int = 0,
         stats_out: Optional[dict] = None,
+        prior_tokens: Optional[list] = None,
+        seed: Optional[int] = None,
+        shadow_id: Optional[int] = None,
+        progress: Optional[StreamProgress] = None,
     ) -> Iterator[str]:
         # NB: admission below is still deferred to the first next() (the
         # long-standing stream contract — SSE handlers pre-check via
@@ -393,7 +452,10 @@ class PagedGenerationService:
                          deadline_ts=deadline_ts,
                          retries_left=self.retry_budget,
                          tenant=tenant, priority=priority,
-                         cost_tokens=int(cost_tokens))
+                         cost_tokens=int(cost_tokens),
+                         prior_tokens=(list(prior_tokens)
+                                       if prior_tokens else None),
+                         seed=seed, shadow_id=shadow_id)
         if request_id:
             get_flight_recorder().note_engine_submit(
                 request_id, replica_id=self.replica_id)
@@ -433,19 +495,26 @@ class PagedGenerationService:
                 else:  # "done"
                     result: PagedResult = payload
                     if result.finish_reason == "error":
-                        # typed: a stream that already delivered tokens is
-                        # non-resumable (replay would duplicate output), so
-                        # the caller's only move is a fresh request shortly
+                        # typed mid-stream death: THIS service cannot
+                        # restart a delivered-token stream without
+                        # duplicating output, but a fronting ReplicaSet can
+                        # resume it on a sibling by replay-prefilling the
+                        # delivered prefix (progress carries the splice)
                         raise ReplicaUnavailable(
-                            "paged decode failed mid-stream (stream is "
-                            "non-resumable)", retry_after_s=2.0,
-                            details={"replica": self.replica_id},
+                            "paged decode failed mid-stream", retry_after_s=2.0,
+                            details={"replica": self.replica_id,
+                                     "reason": "mid_stream"},
                         )
                     emitted = list(result.tokens)  # authoritative final sequence
                     if stats_out is not None:
                         # filled BEFORE the final yield so the consumer sees
                         # the numbers as soon as the iterator is exhausted
                         stats_out.update(result.stats_dict())
+                if progress is not None:
+                    # delivered-state mirror, rebound BEFORE the yield so a
+                    # consumer observing this piece (or this iteration's
+                    # exception) reads exactly the tokens behind it
+                    progress.tokens = emitted
                 text = tokenizer.decode(emitted)
                 if kind == "done":
                     # final flush is unconditional: the finished answer may
@@ -1062,6 +1131,8 @@ class PagedGenerationService:
                         temperature=ticket.temperature,
                         deadline_ts=ticket.deadline_ts,
                         top_k=ticket.top_k,
+                        prior_tokens=ticket.prior_tokens,
+                        seed=ticket.seed,
                     )
                     self._tickets[rid] = ticket
                 self._inbox.clear()

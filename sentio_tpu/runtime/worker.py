@@ -39,17 +39,41 @@ workers):
   copies (runtime/checkpoint.py stores ``np.savez`` zips uncompressed
   precisely so this works).
 
+**Router-side ticket shadowing** — the router mirrors every admitted-but-
+not-yet-answered request in a shadow queue of real
+:class:`~sentio_tpu.runtime.service._Ticket` objects (the same dataclass
+thread mode hands off), keyed by RPC id. A request leaves the shadow the
+moment its first answer frame arrives (first token frame for a stream,
+the result frame for a generate). When the fronting ReplicaSet enables
+handoff (:meth:`ProcessReplica.enable_shadow_handoff` — it does so
+whenever it supervises), worker death or stall-quarantine no longer fails
+those callers typed: ``extract_inbox``/``abandon`` return the shadowed
+tickets and the ReplicaSet's existing ``_handoff_inbox`` re-admits them
+on survivors via ``adopt()`` with the PR 10 WFQ recharge semantics —
+handoff parity with thread mode. A LIVE but quarantined worker
+additionally answers a bounded-timeout ``extract_inbox`` RPC that names
+exactly its never-dispatched inbox tickets (by ``shadow_id``), so only
+truly queued work moves and mid-decode work keeps its normal typed-
+failover path. ``adopt`` re-registers the SAME ticket object against the
+survivor's pipe — the blocked caller (event for generates, ``stream_q``
+for streams) just wakes with the survivor's answer, spending no failover
+budget. Without an enabling ReplicaSet the shadow stays passive and death
+keeps its fail-fast typed surface.
+
 Deliberate semantic deltas from thread mode, all documented here:
 
-* **no cross-process inbox handoff** — a dead worker's never-dispatched
-  tickets live in its process; their callers' blocked RPCs fail typed and
-  ride the normal failover budget instead of the zero-cost handoff
-  (:meth:`ProcessReplica.extract_inbox` returns ``[]``).
 * **stream cancellation propagates at chunk granularity** — closing the
   router-side iterator sends a cancel frame; the worker notices between
   token frames, so an abandoned stream decodes at most one more chunk.
 * **compile fences are per-process** — worker compiles never trip the
   router's fence; ``set_fence_exempt`` on the engine facade is a no-op.
+* **mid-decode generates may re-execute on handoff** — a dead worker
+  cannot report which shadowed generates had already dispatched, so after
+  a process death every shadowed (unanswered) ticket is handed off; a
+  re-executed generate is idempotent from the caller's view (no partial
+  output ever escaped). Streams are exact: delivered-token streams leave
+  the shadow at their first token frame and ride the ReplicaSet's
+  resume-by-replay path instead.
 """
 
 from __future__ import annotations
@@ -64,10 +88,17 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Iterator, Optional
 
+from sentio_tpu.infra import faults
 from sentio_tpu.infra.exceptions import (
     DeadlineExceededError,
     ReplicaUnavailable,
     SentioError,
+)
+from sentio_tpu.runtime.paged import PagedResult
+from sentio_tpu.runtime.service import (
+    StreamProgress,
+    _Ticket,
+    finish_ticket_error,
 )
 
 logger = logging.getLogger(__name__)
@@ -313,8 +344,19 @@ class _WorkerServer:
             elif method == "drain":
                 self._send(req_id, _F_OK, svc.drain(**kwargs))
             elif method == "abandon":
-                svc.abandon(kwargs.get("reason", "abandoned by router"))
-                self._send(req_id, _F_OK, None)
+                tickets = svc.abandon(kwargs.get("reason",
+                                                 "abandoned by router"))
+                # never-dispatched inbox tickets come back by shadow id so
+                # the router can hand EXACTLY them to survivors; the
+                # admitted tickets abandon() failed typed are reaching
+                # their callers as _F_ERR frames right now
+                self._send(req_id, _F_OK, self._shadow_ids(tickets))
+            elif method == "extract_inbox":
+                # breaker-flavor quarantine of a LIVE worker: name the
+                # never-dispatched inbox tickets (by shadow id) back to
+                # the router's shadow queue; only truly queued work moves
+                self._send(req_id, _F_OK,
+                           self._shadow_ids(svc.extract_inbox()))
             elif method == "duty_cycle":
                 self._send(req_id, _F_OK, svc.duty_cycle())
             elif method == "reset_duty_cycle":
@@ -338,22 +380,47 @@ class _WorkerServer:
         except BaseException as exc:  # noqa: BLE001 — everything goes typed  # lint: allow(baseexception-swallow) — converted to a typed wire frame
             self._send(req_id, _F_ERR, _encode_exc(exc))
 
+    @staticmethod
+    def _shadow_ids(tickets: list) -> list:
+        return [t.shadow_id for t in tickets if t.shadow_id is not None]
+
     def _handle_stream(self, req_id: int, kwargs: dict) -> None:
         """Token frames for one stream. The iterator is created (call-time
         validation) BEFORE the ok frame, so the router-side caller sees
-        validation errors synchronously — the SSE pre-200 contract."""
+        validation errors synchronously — the SSE pre-200 contract.
+
+        Each token frame carries ``(piece, token_id_delta)`` — the exact
+        ids behind the piece, mirrored from the service's
+        :class:`StreamProgress` — so the router can accumulate the
+        delivered prefix a mid-flight resume re-admits. The
+        ``worker.stream_chunk`` fault point fires BETWEEN delivered
+        chunks: chaos drills arm ``kill_process`` (a real mid-stream
+        SIGKILL) or a stall there via the ``inject_fault`` RPC."""
         stats_out: dict = {}
-        it = self.svc.generate_stream(stats_out=stats_out, **kwargs)
+        progress = StreamProgress()
+        it = self.svc.generate_stream(stats_out=stats_out,
+                                      progress=progress, **kwargs)
         self._send(req_id, _F_OK, None)
+        sent = 0
+        delivered = False
         try:
             for piece in it:
+                if delivered:
+                    faults.hit("worker.stream_chunk")
                 with self._cancel_lock:
                     if req_id in self._cancelled:
                         self._cancelled.discard(req_id)
                         it.close()  # marks the ticket cancelled in finally
                         return
-                self._send(req_id, _F_TOK, piece)
-            self._send(req_id, _F_END, stats_out)
+                toks = list(progress.tokens)
+                self._send(req_id, _F_TOK, (piece, toks[sent:]))
+                sent = len(toks)
+                delivered = True
+            # the end frame carries the AUTHORITATIVE final token ids:
+            # tokens whose text the UTF-8 withholding never flushed ride
+            # no token frame, and the router's delivered-state mirror must
+            # still converge on the service's final sequence
+            self._send(req_id, _F_END, (stats_out, list(progress.tokens)))
         except BaseException as exc:  # noqa: BLE001  # lint: allow(baseexception-swallow) — converted to a typed wire frame
             self._send(req_id, _F_ERR, _encode_exc(exc))
         finally:
@@ -501,6 +568,17 @@ class ProcessReplica:
         self._send_lock = threading.Lock()
         self._calls: dict[int, _PendingCall] = {}  # guarded-by: _mutex
         self._next_id = 1  # guarded-by: _mutex
+        # router-side ticket shadow (module docstring): every unanswered
+        # generate/stream mirrored as a real _Ticket keyed by its RPC id,
+        # so worker death or quarantine hands never-answered work to
+        # survivors instead of failing it typed. Passive until a
+        # supervising ReplicaSet calls enable_shadow_handoff().
+        self._handoff_enabled = False  # guarded-by: _mutex
+        self._shadow: dict[int, tuple[_Ticket, _PendingCall]] = {}  # guarded-by: _mutex
+        # tickets ADOPTED from a dead sibling: this replica executes them
+        # via RPC and the dispatcher finishes the ticket itself (the
+        # original caller blocks on the ticket, not on a pending call)
+        self._adopted: dict[int, dict] = {}  # guarded-by: _mutex
         self._dead = False  # guarded-by: _mutex
         self._death_reason = ""  # guarded-by: _mutex
         self._closed = False  # guarded-by: _mutex
@@ -564,28 +642,77 @@ class ProcessReplica:
                 self._status = payload
                 self._status_ts = time.perf_counter()
                 continue
+            call = None
             with self._mutex:
-                call = self._calls.get(req_id)
-                if call is not None and (
-                    kind in (_F_ERR, _F_END, _F_READY)
-                    or (kind == _F_OK and not call.streaming)
-                ):
-                    self._calls.pop(req_id, None)
-            if call is not None:
+                adopted = self._adopted.get(req_id)
+                if adopted is not None:
+                    if kind in (_F_ERR, _F_END) or (
+                        kind == _F_OK and not adopted["streaming"]
+                    ):
+                        self._adopted.pop(req_id, None)
+                else:
+                    call = self._calls.get(req_id)
+                    if call is not None and (
+                        kind in (_F_ERR, _F_END, _F_READY)
+                        or (kind == _F_OK and not call.streaming)
+                    ):
+                        self._calls.pop(req_id, None)
+                    # a request leaves the shadow at its first ANSWER
+                    # frame: result/err for generates, first token frame
+                    # (or end/err) for streams — the open ack only means
+                    # the worker built the iterator, not that it admitted
+                    if kind in (_F_TOK, _F_END, _F_ERR) or (
+                        kind == _F_OK
+                        and call is not None and not call.streaming
+                    ):
+                        self._shadow.pop(req_id, None)
+            if adopted is not None:
+                self._finish_adopted(adopted, kind, payload)
+            elif call is not None:
                 call.q.put((kind, payload))
 
-    def _on_death(self, reason: str, *, process_death: bool = True) -> None:
+    def _on_death(self, reason: str, *, process_death: bool = True,
+                  keep_shadow: Optional[bool] = None) -> None:
+        """Latch dead and wake every waiter. Shadowed tickets are the
+        exception: with handoff enabled (and the replica not closing),
+        they are KEPT for the supervisor's quarantine pass to extract and
+        re-admit on survivors — their callers stay blocked on the pending
+        queue until the handoff sentinel arrives. ``keep_shadow=False``
+        (abandon, close) fails the remainder typed instead."""
         with self._mutex:
             if self._dead:
                 return
             self._dead = True
             self._death_reason = reason
+            keep = (self._handoff_enabled and not self._closed
+                    if keep_shadow is None else keep_shadow)
+            shadow_entries: list[tuple[_Ticket, _PendingCall]] = []
+            if keep:
+                # shadowed callers must NOT get the typed death error —
+                # their tickets are about to move to a survivor
+                for rid in self._shadow:
+                    self._calls.pop(rid, None)
+            else:
+                for rid, entry in list(self._shadow.items()):
+                    self._calls.pop(rid, None)
+                    shadow_entries.append(entry)
+                self._shadow.clear()
+            adopted = list(self._adopted.values())
+            self._adopted.clear()
             pending = list(self._calls.values())
             self._calls.clear()
             closed = self._closed
         exc = self._death_error()
+        payload = _encode_exc(exc)
         for call in pending:
-            call.q.put((_F_ERR, _encode_exc(exc)))
+            call.q.put((_F_ERR, payload))
+        for ticket, call in shadow_entries:
+            call.q.put((_F_ERR, payload))
+            finish_ticket_error(ticket, exc, "failed_over")
+        for state in adopted:
+            # the adopting ReplicaSet already finished its handoff pass;
+            # a typed terminal outcome is all the remote caller needs
+            finish_ticket_error(state["ticket"], exc, "failed_over")
         if not closed:
             logger.warning("replica %d worker died: %s", self.replica_id,
                            reason)
@@ -617,35 +744,73 @@ class ProcessReplica:
             self._conn.send(frame)
 
     def _call(self, method: str, kwargs: dict,
-              timeout_s: Optional[float]) -> Any:
+              timeout_s: Optional[float],
+              shadow_ticket: Optional[_Ticket] = None) -> Any:
         """One blocking RPC. A dead worker — before or during the call —
         raises the typed death error; an unresponsive worker past
         ``timeout_s`` does too (a wedged RPC loop is indistinguishable
         from a dead one, and both are replica failures the caller should
-        fail over from)."""
+        fail over from).
+
+        With a ``shadow_ticket`` (generates, handoff enabled) the call is
+        mirrored in the shadow queue: on worker death the supervisor's
+        quarantine extracts the ticket and re-admits it on a survivor —
+        the ``("handoff", ticket)`` sentinel tells this caller to wait on
+        the ticket's event instead, spending no failover budget."""
         call = _PendingCall()
+        shadowed = False
         with self._mutex:
             if self._dead:
                 raise self._death_error()
             req_id = self._next_id
             self._next_id += 1
             self._calls[req_id] = call
+            if shadow_ticket is not None and self._handoff_enabled:
+                shadow_ticket.shadow_id = req_id
+                self._shadow[req_id] = (shadow_ticket, call)
+                kwargs = {**kwargs, "shadow_id": req_id}
+                shadowed = True
+        t0 = time.perf_counter()
         try:
             self._send_frame((req_id, method, kwargs))
         except (BrokenPipeError, OSError):
             self._on_death("worker pipe broken on send")
-            raise self._death_error() from None
+            if not shadowed:
+                with self._mutex:
+                    self._calls.pop(req_id, None)
+                raise self._death_error() from None
+            # shadowed: fall through to the wait — the worker never saw
+            # this request, so the dead-worker extraction hands it off
+            # wholesale and the sentinel below wakes us
+        wait = timeout_s if timeout_s and timeout_s > 0 else None
         try:
-            kind, payload = call.q.get(
-                timeout=timeout_s if timeout_s and timeout_s > 0 else None)
+            kind, payload = call.q.get(timeout=wait)
         except _queue.Empty:
             with self._mutex:
                 self._calls.pop(req_id, None)
+                # unanswered AND un-handed-off: drop the shadow so a late
+                # handoff cannot execute work whose caller already left
+                self._shadow.pop(req_id, None)
             raise ReplicaUnavailable(
                 f"worker RPC {method!r} unanswered after {timeout_s:.0f}s",
                 retry_after_s=2.0,
                 details={"replica": self.replica_id, "reason": "rpc_timeout"},
             ) from None
+        if kind == "handoff":
+            ticket: _Ticket = payload
+            remaining = (max(wait - (time.perf_counter() - t0), 1.0)
+                         if wait is not None else None)
+            if not ticket.event.wait(remaining):
+                raise ReplicaUnavailable(
+                    f"handed-off {method!r} unanswered after "
+                    f"{timeout_s:.0f}s",
+                    retry_after_s=2.0,
+                    details={"replica": self.replica_id,
+                             "reason": "handoff_timeout"},
+                )
+            if ticket.error is not None:
+                raise ticket.error
+            return ticket.result
         if kind == _F_ERR:
             raise _decode_exc(payload)
         return payload
@@ -681,16 +846,33 @@ class ProcessReplica:
         tenant: Optional[str] = None,
         priority: Optional[str] = None,
         cost_tokens: int = 0,
+        seed: Optional[int] = None,
     ):
         wait = (timeout_s or self.default_timeout_s) + 30.0
+        rel = self._rel_deadline(deadline_s, deadline_ts)
+        shadow = None
+        if self._handoff_enabled:  # lint: allow(lock-discipline) — GIL-atomic bool; _call re-checks under _mutex
+            # the shadow mirror a dead-worker handoff re-admits on a
+            # survivor; _call stamps shadow_id once the RPC id is known
+            shadow = _Ticket(
+                prompt, max_new_tokens, temperature, top_k=top_k,
+                request_id=request_id, t_submit=time.perf_counter(),
+                deadline_ts=(time.perf_counter() + rel
+                             if rel is not None else None),
+                tenant=tenant, priority=priority,
+                cost_tokens=int(cost_tokens), seed=seed,
+            )
         result = self._call("generate", dict(
             prompt=prompt, max_new_tokens=max_new_tokens,
             temperature=temperature, timeout_s=timeout_s,
             request_id=request_id,
-            deadline_s=self._rel_deadline(deadline_s, deadline_ts),
+            deadline_s=rel,
             top_k=top_k, tenant=tenant, priority=priority,
-            cost_tokens=cost_tokens,
-        ), timeout_s=wait)
+            cost_tokens=cost_tokens, seed=seed,
+        ), timeout_s=wait, shadow_ticket=shadow)
+        if shadow is not None and shadow.result is result:
+            # handed off: the SURVIVOR already stamped its own replica_id
+            return result
         result.replica_id = self.replica_id
         return result
 
@@ -708,6 +890,9 @@ class ProcessReplica:
         priority: Optional[str] = None,
         cost_tokens: int = 0,
         stats_out: Optional[dict] = None,
+        prior_tokens: Optional[list] = None,
+        seed: Optional[int] = None,
+        progress: Optional[StreamProgress] = None,
     ) -> Iterator[str]:
         """Lazy, matching thread mode: the ``stream_open`` RPC — which
         admits AND starts decoding in the worker — defers to the first
@@ -718,7 +903,12 @@ class ProcessReplica:
         delta: thread mode's CALL-time validation (top_k vs speculation)
         also moves to the first ``next()`` — the SSE handler's admission
         pre-check still runs before its 200, and a validation error past
-        that surfaces as the typed mid-stream error."""
+        that surfaces as the typed mid-stream error.
+
+        ``progress`` mirrors the token ids behind every yielded piece
+        (accumulated from the worker's per-frame token-id deltas), and
+        ``prior_tokens``/``seed`` ride the RPC into the worker's service —
+        the full resume-by-replay surface works across the boundary."""
         wait = (timeout_s or self.default_timeout_s) + 30.0
         return self._stream_open_and_pump(dict(
             prompt=prompt, max_new_tokens=max_new_tokens,
@@ -727,42 +917,83 @@ class ProcessReplica:
             deadline_s=deadline_s, deadline_ts=deadline_ts,
             top_k=top_k, tenant=tenant, priority=priority,
             cost_tokens=cost_tokens,
-        ), wait, stats_out)
+            prior_tokens=(list(prior_tokens) if prior_tokens else None),
+            seed=seed,
+        ), wait, stats_out, progress)
 
     def _stream_open_and_pump(self, req: dict, wait: float,
-                              stats_out: Optional[dict]) -> Iterator[str]:
+                              stats_out: Optional[dict],
+                              progress: Optional[StreamProgress],
+                              ) -> Iterator[str]:
         # generator body: nothing below runs until the first next()
+        abs_deadline = req["deadline_ts"]
         req["deadline_s"] = self._rel_deadline(
             req.pop("deadline_s"), req.pop("deadline_ts"))
+        if abs_deadline is None and req["deadline_s"]:
+            abs_deadline = time.perf_counter() + req["deadline_s"]
         call = _PendingCall(streaming=True)
+        shadowed = False
         with self._mutex:
             if self._dead:
                 raise self._death_error()
             req_id = self._next_id
             self._next_id += 1
             self._calls[req_id] = call
+            if self._handoff_enabled:
+                # the stream's shadow mirror: it leaves the shadow at its
+                # first token frame (a delivered-token stream rides the
+                # ReplicaSet resume path, not the handoff)
+                ticket = _Ticket(
+                    req["prompt"], req["max_new_tokens"],
+                    req["temperature"], top_k=req["top_k"],
+                    stream_q=_queue.Queue(),
+                    request_id=req.get("request_id"),
+                    t_submit=time.perf_counter(),
+                    deadline_ts=abs_deadline,
+                    tenant=req.get("tenant"), priority=req.get("priority"),
+                    cost_tokens=int(req.get("cost_tokens") or 0),
+                    prior_tokens=req.get("prior_tokens"),
+                    seed=req.get("seed"), shadow_id=req_id,
+                )
+                self._shadow[req_id] = (ticket, call)
+                req["shadow_id"] = req_id
+                shadowed = True
         try:
             self._send_frame((req_id, "stream_open", req))
         except (BrokenPipeError, OSError):
             self._on_death("worker pipe broken on send")
-            raise self._death_error() from None
+            if not shadowed:
+                with self._mutex:
+                    self._calls.pop(req_id, None)
+                raise self._death_error() from None
+            # shadowed: the dead-worker extraction hands the ticket off;
+            # the sentinel arrives on the pending queue below
         try:
             kind, payload = call.q.get(timeout=wait)
         except _queue.Empty:
             with self._mutex:
                 self._calls.pop(req_id, None)
+                self._shadow.pop(req_id, None)
             raise ReplicaUnavailable(
                 f"worker stream open unanswered after {wait:.0f}s",
                 retry_after_s=2.0,
                 details={"replica": self.replica_id, "reason": "rpc_timeout"},
             ) from None
+        if kind == "handoff":
+            yield from self._drain_adopted_stream(payload, wait,
+                                                  stats_out, progress)
+            return
         if kind == _F_ERR:
             raise _decode_exc(payload)
-        yield from self._stream_frames(req_id, call, wait, stats_out)
+        yield from self._stream_frames(req_id, call, wait, stats_out,
+                                       progress)
 
     def _stream_frames(self, req_id: int, call: _PendingCall, wait: float,
-                       stats_out: Optional[dict]) -> Iterator[str]:
+                       stats_out: Optional[dict],
+                       progress: Optional[StreamProgress],
+                       ) -> Iterator[str]:
         done = False
+        emitted: list[int] = []
         try:
             while True:
                 try:
@@ -774,13 +1005,30 @@ class ProcessReplica:
                         details={"replica": self.replica_id,
                                  "reason": "rpc_timeout"},
                     ) from None
+                if kind == "handoff":
+                    # never-dispatched stream moved to a survivor before
+                    # any token frame: nothing delivered, clean switch
+                    done = True
+                    yield from self._drain_adopted_stream(
+                        payload, wait, stats_out, progress)
+                    return
                 if kind == _F_TOK:
-                    yield payload
+                    piece, delta = payload
+                    emitted.extend(delta)
+                    if progress is not None:
+                        # rebound BEFORE the yield, like the service's own
+                        # mirror: a consumer observing this piece (or the
+                        # death exception) reads the delivered prefix
+                        progress.tokens = list(emitted)
+                    yield piece
                 elif kind == _F_END:
                     done = True
-                    if stats_out is not None and isinstance(payload, dict):
-                        payload["replica_id"] = self.replica_id
-                        stats_out.update(payload)
+                    stats, final_toks = payload
+                    if progress is not None and final_toks is not None:
+                        progress.tokens = list(final_toks)
+                    if stats_out is not None and isinstance(stats, dict):
+                        stats["replica_id"] = self.replica_id
+                        stats_out.update(stats)
                     return
                 else:  # _F_ERR
                     done = True
@@ -788,6 +1036,7 @@ class ProcessReplica:
         finally:
             with self._mutex:
                 self._calls.pop(req_id, None)
+                self._shadow.pop(req_id, None)
                 dead = self._dead
             if not done and not dead:
                 # consumer abandoned mid-stream: tell the worker (it cancels
@@ -797,6 +1046,75 @@ class ProcessReplica:
                                       {"stream_id": req_id}))
                 except (BrokenPipeError, OSError):
                     pass
+
+    def _drain_adopted_stream(self, ticket: _Ticket, wait: float,
+                              stats_out: Optional[dict],
+                              progress: Optional[StreamProgress],
+                              ) -> Iterator[str]:
+        """Consume a stream ticket a survivor adopted: the survivor's pump
+        (thread mode) or this class's adopt dispatcher (process mode)
+        feeds ``ticket.stream_q`` with the service queue vocabulary. Only
+        never-dispatched tickets are handed off, so nothing was delivered
+        yet and decoding starts clean — same UTF-8 withholding as the
+        service's own stream impl."""
+        tokenizer = self._tokenizer
+        emitted: list[int] = []
+        flushed = ""
+        done = False
+        try:
+            while True:
+                try:
+                    kind, payload = ticket.stream_q.get(timeout=wait)
+                except _queue.Empty:
+                    raise ReplicaUnavailable(
+                        f"handed-off stream stalled for {wait:.0f}s",
+                        retry_after_s=2.0,
+                        details={"replica": self.replica_id,
+                                 "reason": "handoff_timeout"},
+                    ) from None
+                if kind == "err":
+                    done = True
+                    raise payload
+                if kind == "toks":
+                    emitted.extend(payload)
+                else:  # "done"
+                    done = True
+                    result = payload
+                    if result.finish_reason == "error":
+                        raise ReplicaUnavailable(
+                            "paged decode failed mid-stream",
+                            retry_after_s=2.0,
+                            details={"replica": self.replica_id,
+                                     "reason": "mid_stream"},
+                        )
+                    emitted = list(result.tokens)
+                    if stats_out is not None:
+                        stats_out.update(result.stats_dict())
+                if progress is not None:
+                    progress.tokens = list(emitted)
+                text = tokenizer.decode(emitted)
+                if kind == "done":
+                    if len(text) > len(flushed):
+                        yield text[len(flushed):]
+                    return
+                safe = text[:-1] if text.endswith("�") else text
+                if len(safe) > len(flushed):
+                    yield safe[len(flushed):]
+                    flushed = safe
+        finally:
+            # consumer abandoned: in thread mode the adopting service's
+            # pump reads this flag at its next loop; in process mode the
+            # adopting replica's dispatcher observes it at the next token
+            # frame and forwards a chunk-granular stream_cancel to its
+            # worker. An EXPIRED ticket is left for the deadline sweep,
+            # which counts it as expired — marking it cancelled here
+            # would misfile a deadline miss under caller-abandoned (same
+            # rule as the service's own stream impl)
+            if not done and not (
+                ticket.deadline_ts is not None
+                and time.perf_counter() >= ticket.deadline_ts
+            ):
+                ticket.cancelled = True
 
     def check_admission(self, deadline_ts: Optional[float] = None) -> None:
         self._call("check_admission", {
@@ -903,33 +1221,206 @@ class ProcessReplica:
 
     # ------------------------------------------------ quarantine / handoff
 
+    def enable_shadow_handoff(self) -> None:
+        """Arm router-side ticket shadowing (module docstring). Called by a
+        SUPERVISING ReplicaSet: without a supervisor nobody would ever
+        extract the shadow queue, so the default stays passive and worker
+        death keeps its fail-fast typed surface."""
+        with self._mutex:
+            self._handoff_enabled = True
+
+    def _pop_shadow(self, ids: Optional[list] = None) -> list:
+        """Remove shadowed tickets (all of them, or exactly ``ids``) for
+        handoff, wake their callers with the ``("handoff", ticket)``
+        sentinel, and drop their pending-call registrations so a straggler
+        frame from the old worker cannot double-answer."""
+        entries: list[tuple[_Ticket, _PendingCall]] = []
+        with self._mutex:
+            take = (list(self._shadow.keys()) if ids is None
+                    else [i for i in ids if i in self._shadow])
+            for rid in take:
+                entries.append(self._shadow.pop(rid))
+                self._calls.pop(rid, None)
+        out = []
+        for ticket, call in entries:
+            call.q.put(("handoff", ticket))
+            out.append(ticket)
+        return out
+
+    def _fail_shadow(self, exc: ReplicaUnavailable) -> None:
+        """Terminal typed outcome for any shadow/adopted residue — close()
+        safety net for a death that latched with the shadow kept but whose
+        handoff never came."""
+        with self._mutex:
+            entries = list(self._shadow.values())
+            self._shadow.clear()
+            adopted = list(self._adopted.values())
+            self._adopted.clear()
+        payload = _encode_exc(exc)
+        for ticket, call in entries:
+            call.q.put((_F_ERR, payload))
+            finish_ticket_error(ticket, exc, "failed_over")
+        for state in adopted:
+            finish_ticket_error(state["ticket"], exc, "failed_over")
+
     def abandon(self, reason: str) -> list:
         """Stall-quarantine surface: ask the worker (its RPC loop survives a
         wedged pump) to abandon — admitted tickets fail typed in-worker,
-        which unblocks their router-side RPCs with the typed error — then
-        latch dead locally so every later call fails fast. No cross-process
-        inbox handoff: the returned list is empty and those callers spend
-        normal failover budget (module docstring)."""
-        try:
-            self._call("abandon", {"reason": reason}, timeout_s=10.0)
-        except Exception:  # noqa: BLE001 — wedged/dead worker: kill below
-            pass
+        which unblocks their router-side RPCs with the typed error, and the
+        never-dispatched inbox tickets come back BY SHADOW ID for handoff —
+        then latch dead locally so every later call fails fast. Remaining
+        shadowed work (mid-decode on the wedged worker) keeps its normal
+        typed-failover path."""
+        with self._mutex:
+            dead = self._dead
+            enabled = self._handoff_enabled
+        ids: Optional[list] = None
+        if not dead:
+            try:
+                ids = self._call("abandon", {"reason": reason},
+                                 timeout_s=10.0)
+            except Exception as exc:  # noqa: BLE001 — latch + hand off below
+                # a systematically failing abandon RPC must be diagnosable,
+                # not silent: one WARNING naming the worker (satellite fix)
+                logger.warning(
+                    "replica %d worker abandon RPC failed (%s: %s); "
+                    "latching dead and handing off every shadowed ticket",
+                    self.replica_id, type(exc).__name__, exc,
+                )
+        # RPC failed or worker already dead: ids=None hands off EVERY
+        # unanswered shadowed ticket (a dead worker cannot say which had
+        # dispatched; re-executed generates are idempotent caller-side)
+        tickets = self._pop_shadow(ids) if enabled else []
         alive = self._proc is not None and self._proc.is_alive()
-        self._on_death(f"abandoned: {reason}", process_death=not alive)
-        return []
+        self._on_death(f"abandoned: {reason}", process_death=not alive,
+                       keep_shadow=False)
+        return tickets
 
     def extract_inbox(self) -> list:
-        """Never-dispatched tickets live in the worker process; they cannot
-        move across the boundary (their callers block on THIS replica's
-        RPC frames). Quarantine fails them typed via the worker instead."""
-        return []
+        """Quarantine handoff surface. A LIVE worker answers a
+        bounded-timeout ``extract_inbox`` RPC naming exactly its
+        never-dispatched inbox tickets (mid-decode work keeps its typed
+        failover path); a dead (or unresponsive) worker hands off every
+        unanswered shadowed ticket wholesale — the module-docstring
+        re-execution contract."""
+        with self._mutex:
+            enabled = self._handoff_enabled
+            dead = self._dead
+        if not enabled:
+            return []
+        alive = (not dead and self._proc is not None
+                 and self._proc.is_alive())
+        ids: Optional[list] = None
+        if alive:
+            try:
+                ids = self._call("extract_inbox", {}, timeout_s=10.0)
+            except Exception:  # noqa: BLE001 — unresponsive == dead here
+                logger.warning(
+                    "replica %d extract_inbox RPC failed; handing off "
+                    "every shadowed ticket", self.replica_id,
+                )
+                ids = None
+        return self._pop_shadow(ids)
 
-    def adopt(self, ticket) -> None:  # noqa: ARG002
-        raise ReplicaUnavailable(
-            "process-mode replicas cannot adopt cross-process tickets",
-            retryable=False,
-            details={"replica": self.replica_id, "reason": "process_mode"},
+    def adopt(self, ticket: _Ticket) -> None:
+        """Admit a ticket handed off from a quarantined sibling replica:
+        re-register it against THIS worker's pipe. The original caller
+        still blocks on the ticket (event for generates, ``stream_q`` for
+        streams); the adopt dispatcher finishes the ticket from the
+        worker's answer frames — no failover budget spent caller-side.
+        Typed sheds surface synchronously (the handoff layer turns them
+        into the ticket's terminal outcome)."""
+        # the worker's own admission rules, checked without reserving —
+        # raises the same typed errors a fresh submit would
+        self.check_admission(ticket.deadline_ts)
+        streaming = ticket.stream_q is not None
+        req = dict(
+            prompt=ticket.prompt, max_new_tokens=ticket.max_new_tokens,
+            temperature=ticket.temperature, top_k=ticket.top_k,
+            timeout_s=None, request_id=ticket.request_id,
+            deadline_s=self._rel_deadline(None, ticket.deadline_ts),
+            tenant=ticket.tenant, priority=ticket.priority,
+            cost_tokens=ticket.cost_tokens, seed=ticket.seed,
         )
+        if streaming:
+            req["prior_tokens"] = ticket.prior_tokens
+        with self._mutex:
+            if self._dead:
+                raise self._death_error()
+            req_id = self._next_id
+            self._next_id += 1
+            req["shadow_id"] = req_id
+            self._adopted[req_id] = {
+                "ticket": ticket, "emitted": [], "streaming": streaming,
+                "req_id": req_id,
+            }
+        try:
+            self._send_frame(
+                (req_id, "stream_open" if streaming else "generate", req))
+        except (BrokenPipeError, OSError):
+            with self._mutex:
+                self._adopted.pop(req_id, None)
+            self._on_death("worker pipe broken on adopt send")
+            raise self._death_error() from None
+
+    def _finish_adopted(self, state: dict, kind: str, payload) -> None:
+        """Adopt-dispatcher leg of :meth:`adopt`: translate the worker's
+        answer frames into the ticket's terminal state. Runs on the
+        dispatcher thread; the ticket is exclusively this replica's (its
+        old service is dead), so no lock applies."""
+        ticket: _Ticket = state["ticket"]
+        if kind == _F_OK:
+            if state["streaming"]:
+                return  # stream open ack: admission is still in flight
+            result = payload
+            result.replica_id = self.replica_id
+            if ticket.event.is_set():
+                return
+            ticket.result = result
+            ticket.event.set()
+        elif kind == _F_TOK:
+            _piece, delta = payload
+            state["emitted"].extend(delta)
+            if ticket.cancelled and not state.get("cancel_sent"):
+                # the consumer abandoned the adopted stream: no pump on
+                # THIS side ever reads ticket.cancelled (the flag is set
+                # by the dead replica's drain loop), so forward the
+                # worker's chunk-granular stream cancel — same frame a
+                # directly-owned abandoned stream sends — instead of
+                # decoding the rest of the budget for nobody
+                state["cancel_sent"] = True
+                try:
+                    self._send_frame((0, "stream_cancel",
+                                      {"stream_id": state["req_id"]}))
+                except (BrokenPipeError, OSError):
+                    pass
+            if ticket.stream_q is not None:
+                ticket.stream_q.put(("toks", list(delta)))
+        elif kind == _F_END:
+            stats, final_toks = payload
+            stats = stats if isinstance(stats, dict) else {}
+            result = PagedResult(
+                request_id=-1, text="",
+                tokens=list(final_toks if final_toks is not None
+                            else state["emitted"]),
+                prompt_tokens=0,
+                finish_reason=str(stats.get("finish_reason") or "stop"),
+                logprob_sum=float(stats.get("logprob_sum") or 0.0),
+                logprob_min=float(stats.get("logprob_min") or 0.0),
+                logprob_count=int(stats.get("logprob_count") or 0),
+                replica_id=self.replica_id,
+            )
+            if ticket.event.is_set():
+                return
+            ticket.result = result
+            if ticket.stream_q is not None:
+                ticket.stream_q.put(("done", result))
+            ticket.event.set()
+        else:  # _F_ERR
+            exc = _decode_exc(payload)
+            if not isinstance(exc, Exception):
+                exc = RuntimeError(str(exc))
+            finish_ticket_error(ticket, exc, "failed_over")
 
     # ------------------------------------------------------------ lifecycle
 
@@ -937,10 +1428,18 @@ class ProcessReplica:
         """A fresh worker process from the same spec — the supervisor's
         rebuild path (``ReplicaSet._rebuild`` duck-types this instead of
         ``engine.spawn_fresh()``)."""
-        return ProcessReplica(
+        fresh = ProcessReplica(
             self.spec, self._tokenizer, replica_id=self.replica_id,
             build_timeout_s=self.build_timeout_s,
         )
+        with self._mutex:
+            enabled = self._handoff_enabled
+        if enabled:
+            # the supervising set armed shadowing at construction; the
+            # respawned incarnation inherits it (the set only enables
+            # replicas it was BUILT with)
+            fresh.enable_shadow_handoff()
+        return fresh
 
     def kill(self) -> None:
         """SIGKILL the worker — the chaos drill's real replica death. The
@@ -1001,4 +1500,12 @@ class ProcessReplica:
             self._conn.close()
         except OSError:
             pass
-        self._on_death("closed")
+        self._on_death("closed", keep_shadow=False)
+        # a death that latched EARLIER kept the shadow for a handoff that
+        # never came — a closed replica can never hand off, so fail the
+        # residue typed instead of leaving callers to their timeouts
+        self._fail_shadow(ReplicaUnavailable(
+            "replica worker closed before handoff",
+            retry_after_s=2.0,
+            details={"replica": self.replica_id, "reason": "closed"},
+        ))

@@ -404,7 +404,16 @@ async def _chat_stream(request: web.Request, container: DependencyContainer, req
     generator's token iterator is pumped from a worker thread into the
     response via a queue. The flight-record id travels in ``X-Request-Id``
     (client-pinnable via ``thread_id``) so a streamed request's trace is
-    retrievable from /debug/flight afterwards."""
+    retrievable from /debug/flight afterwards.
+
+    **Session continuity**: a replica dying mid-stream does NOT surface
+    here when a fronting ReplicaSet can resume it — the token iterator
+    below is the set's ``generate_stream``, whose resume-by-replay splices
+    the delivered prefix onto a survivor and keeps yielding post-splice
+    pieces, so the SSE wire sees one uninterrupted, gap- and
+    duplicate-free stream (the keepalive loop bridges the replay-prefill
+    gap). Only an opted-out or budget-exhausted stream still gets the
+    typed mid-stream error event (wire format unchanged)."""
     import re
     import uuid
 

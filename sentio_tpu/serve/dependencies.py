@@ -448,6 +448,10 @@ class DependencyContainer:
                         rebuild_budget=serve.replica_rebuild_budget,
                         rebuild_drain_s=serve.replica_rebuild_drain_s,
                         failover_budget=serve.replica_failover_budget,
+                        stream_resume_budget=(
+                            serve.stream_resume_budget
+                            if serve.stream_resume_budget >= 0 else None
+                        ),
                         rebuild_workers=serve.replica_rebuild_workers,
                     )
                 except BaseException:
@@ -525,6 +529,12 @@ class DependencyContainer:
                 rebuild_budget=serve.replica_rebuild_budget,
                 rebuild_drain_s=serve.replica_rebuild_drain_s,
                 failover_budget=serve.replica_failover_budget,
+                # resume-by-replay for delivered-token streams
+                # (STREAM_RESUME_BUDGET; -1 follows the failover budget)
+                stream_resume_budget=(
+                    serve.stream_resume_budget
+                    if serve.stream_resume_budget >= 0 else None
+                ),
                 rebuild_workers=serve.replica_rebuild_workers,
             )
 

@@ -813,3 +813,202 @@ class TestChaosDrill:
         with pytest.raises(ReplicaUnavailable, match="closed"):
             svc.generate("after drain-close")
         _assert_no_pump_threads()
+
+
+class TestResumableStreams:
+    """ISSUE 14 acceptance drills: a stream that already DELIVERED tokens
+    survives its replica's death by replay-prefill — the delivered prefix
+    re-admits on a survivor as a prior context suffix, decode continues
+    from the splice point, and the client sees one uninterrupted stream
+    whose output is token-identical to a run that never saw a fault."""
+
+    PROMPT = "resumable stream drill with a reasonably long prompt body"
+
+    def test_midstream_death_resumes_token_exact_thread_mode(self):
+        """Injected mid-stream death (thread mode): one of 2 replicas
+        fails a decode tick AFTER delivering at least one chunk of a live
+        stream. The stream must complete with output byte-identical to the
+        no-fault greedy run (zero duplicated, zero missing tokens), emit
+        the ``stream_resumed`` flight event, count into stats, and leave
+        the survivor's page pool conserved (sanitizer armed throughout)."""
+        from sentio_tpu.runtime.replica import ReplicaSet
+
+        e0 = ContinuousBatchingEngine(
+            max_slots=2, page_size=8, max_pages_per_seq=4, steps_per_tick=2,
+        )
+        e1 = ContinuousBatchingEngine(
+            params=e0.params, tokenizer=e0.tokenizer,
+            max_slots=2, page_size=8, max_pages_per_seq=4, steps_per_tick=2,
+        )
+        svc0 = PagedGenerationService(e0, retry_budget=1)
+        svc1 = PagedGenerationService(e1, retry_budget=1)
+        svc1.generate("drill warm one", max_new_tokens=2, timeout_s=180)
+        # the no-fault reference ALSO warms svc0's radix with the full
+        # prompt, so the drill stream deterministically routes to svc0
+        # (prefix affinity) — the replica the fault will kill
+        expected = svc0.generate(self.PROMPT, max_new_tokens=16,
+                                 temperature=0.0, timeout_s=180)
+        assert len(expected.tokens) >= 4, "drill needs a multi-chunk answer"
+        rs = ReplicaSet([svc0, svc1], supervise=False, failover_budget=1)
+        try:
+            # armed BEFORE the stream starts: tick 1 delivers a chunk
+            # (skip=1), tick 2 dies — at least one token is ALWAYS
+            # delivered before the death, no consumer-timing race. The
+            # reset succeeds, so this is a pure mid-stream casualty (the
+            # service requeues fresh work but can never restart a
+            # delivered-token stream itself).
+            faults.arm("paged.step", faults.FaultRule(
+                error=RuntimeError("drill: midstream death"),
+                times=1, skip=1))
+            stats_out: dict = {}
+            pieces = list(rs.generate_stream(
+                self.PROMPT, max_new_tokens=16, temperature=0.0,
+                timeout_s=120, stats_out=stats_out,
+            ))
+            faults.reset()
+            # token-exact vs the no-fault run: zero duplicated, zero
+            # missing tokens, one uninterrupted stream
+            assert "".join(pieces) == expected.text
+            assert stats_out.get("resumed") == 1, stats_out
+            assert stats_out.get("replayed_tokens", 0) >= 1, stats_out
+            assert stats_out.get("tokens") == len(expected.tokens), stats_out
+            stats = rs.stats()
+            assert stats["stream_resumes"] == 1
+            assert stats["resume_replayed_tokens"] >= 1
+            assert stats["resume_exhausted"] == 0
+            # the resume was evented for operators
+            from sentio_tpu.infra.flight import get_flight_recorder
+
+            events = [t for t in get_flight_recorder().timeline()
+                      if t.get("event") == "stream_resumed"]
+            assert events, "stream_resumed flight event missing"
+            assert events[-1]["replica_from"] == 0
+            assert events[-1]["replica_to"] == 1
+            assert events[-1]["replayed_tokens"] >= 1
+            # pages conserve on the survivor (and on the reset victim)
+            _assert_pages_conserved(svc1)
+            _assert_pages_conserved(svc0)
+            # the survivor still serves routed traffic afterwards
+            ok = rs.generate("post resume routed sanity", max_new_tokens=3,
+                             temperature=0.0, timeout_s=120)
+            assert ok.finish_reason in ("stop", "length")
+        finally:
+            faults.reset()
+            rs.close()
+        _assert_no_pump_threads()
+
+    def test_midstream_sigkill_resumes_token_exact_process_mode(self):
+        """ISSUE 14 process-mode drill: a REAL ``SIGKILL`` lands between
+        delivered stream chunks (the ``worker.stream_chunk`` injection
+        point, armed in-worker over the RPC fault surface, composes a
+        stall — the determinism window — with ``kill_process``). The
+        contract:
+
+        * the stream completes token-identical to a no-fault greedy run
+          (the resume replays the delivered prefix on the survivor);
+        * the dead worker's never-answered SHADOWED tickets hand off to
+          the survivor and complete WITHOUT spending caller failover
+          budget (``handed_off`` > 0 — thread-mode handoff parity);
+        * the supervisor respawns the worker; zero orphans at teardown."""
+        import dataclasses
+        import multiprocessing
+
+        from sentio_tpu.models.llama import LlamaConfig
+        from sentio_tpu.models.tokenizer import ByteTokenizer
+        from sentio_tpu.runtime.replica import ReplicaSet
+        from sentio_tpu.runtime.worker import ProcessReplica, WorkerSpec
+
+        cfg = LlamaConfig.tiny()
+        spec = WorkerSpec(factory_kwargs=dict(
+            model_config=dataclasses.asdict(cfg),
+            engine_kwargs=dict(max_slots=2, page_size=8, max_pages_per_seq=4,
+                               steps_per_tick=2),
+            service_kwargs=dict(retry_budget=1),
+        ))
+        tok = ByteTokenizer(cfg.vocab_size)
+        p0 = ProcessReplica(spec, tok, replica_id=0, build_timeout_s=300.0)
+        p1 = ProcessReplica(spec, tok, replica_id=1, build_timeout_s=300.0)
+        # no-fault reference from the survivor (seeded inits are identical
+        # across workers — pinned by test_worker's parity suite)
+        expected = p1.generate(self.PROMPT, max_new_tokens=16,
+                               temperature=0.0, timeout_s=180)
+        assert len(expected.tokens) >= 4
+        # prime p0's radix DEEPER than p1's reference insert so prefix
+        # affinity deterministically routes the drill stream to p0
+        p0.generate(self.PROMPT, max_new_tokens=2, temperature=0.0,
+                    timeout_s=180)
+        rs = ReplicaSet(
+            [p0, p1],
+            probe_interval_s=0.05, quarantine_backoff_s=0.1,
+            failover_budget=1, rebuild_drain_s=0.5,
+        )
+        probe_results: dict = {}
+
+        def probe(i):
+            try:
+                probe_results[i] = p0.generate(
+                    f"handoff probe {i}", max_new_tokens=24, timeout_s=120)
+            except Exception as exc:  # noqa: BLE001 — asserted below
+                probe_results[i] = exc
+
+        try:
+            # between delivered chunks: wedge 3s (the window the test uses
+            # to queue handoff probes), then a REAL SIGKILL — no handler
+            # runs, no frame unwinds
+            p0.inject_fault("worker.stream_chunk", stall_s=3.0,
+                            kill_process=True, times=1)
+            stats_out: dict = {}
+            it = rs.generate_stream(self.PROMPT, max_new_tokens=16,
+                                    temperature=0.0, timeout_s=120,
+                                    stats_out=stats_out)
+            pieces = [next(it)]  # chunk 1 delivered; chunk 2 arms the fault
+            # inside the stall window: wedge p0's pump so the probes cannot
+            # complete before the kill, then queue them (they register in
+            # the router-side shadow)
+            p0.inject_fault("paged.step", stall_s=30.0, times=1)
+            time.sleep(0.1)
+            threads = [threading.Thread(target=probe, args=(i,), daemon=True)
+                       for i in range(2)]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)
+            for piece in it:
+                pieces.append(piece)
+            # token-exact across a real SIGKILL
+            assert "".join(pieces) == expected.text
+            assert stats_out.get("resumed") == 1, stats_out
+            for t in threads:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in threads), (
+                "handoff probe hung across the SIGKILL"
+            )
+            # the shadowed probes completed on the survivor via handoff —
+            # typed results, no failover budget spent
+            for i, out in probe_results.items():
+                assert isinstance(out, PagedResult), (i, out)
+                assert out.finish_reason in ("stop", "length"), (i, out)
+                assert out.replica_id == 1, (i, out)
+            stats = rs.stats()
+            assert stats["handed_off"] >= 2, stats["handed_off"]
+            assert stats["stream_resumes"] >= 1
+            assert stats["resume_replayed_tokens"] >= 1
+            # the supervisor respawns the corpse and the set heals
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if rs.health_summary()["status"] == "healthy":
+                    break
+                time.sleep(0.05)
+            summary = rs.health_summary()
+            assert summary["status"] == "healthy", summary
+            ok = rs.generate("post sigkill routed sanity", max_new_tokens=3,
+                             temperature=0.0, timeout_s=120)
+            assert ok.finish_reason in ("stop", "length")
+        finally:
+            rs.close()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and multiprocessing.active_children():
+            time.sleep(0.05)
+        assert multiprocessing.active_children() == [], (
+            "orphan replica worker processes leaked"
+        )
+        _assert_no_pump_threads()

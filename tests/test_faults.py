@@ -77,6 +77,19 @@ class TestRuleMechanics:
             faults.hit("p")  # third hit passes
             assert rule.hits == 3 and rule.fired == 2
 
+    def test_skip_passes_the_first_hits_then_fires(self):
+        """``skip=N`` arms 'the N+1th dispatch dies' BEFORE the work
+        starts — the deterministic mid-stream kill shape (at least one
+        delivered chunk, then death), no consumer-timing race."""
+        with faults.inject("p", error=RuntimeError("late boom"),
+                           times=1, skip=2) as rule:
+            faults.hit("p")  # skipped
+            faults.hit("p")  # skipped
+            with pytest.raises(RuntimeError):
+                faults.hit("p")
+            faults.hit("p")  # times=1 exhausted
+            assert rule.hits == 4 and rule.fired == 1
+
     def test_probability_is_seed_deterministic(self):
         def count(seed):
             n = 0

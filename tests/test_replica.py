@@ -854,6 +854,124 @@ class TestStallTolerance:
             rs.close()
 
 
+class TestResumableStreamWfq:
+    """ISSUE 14: WFQ tenant accounting must stay balanced across every
+    resume path. Each attempt — fresh, failed-over, or resumed by
+    replay-prefill — releases its reservation before re-charging, so a
+    resumed stream records exactly one admission per attempt and leaves
+    ``pending`` at zero whether the resume succeeded, exhausted its
+    budget, or rode an overflow-bucketed tenant key."""
+
+    PROMPT = "wfq conservation drill stream with a decent prompt body"
+
+    @staticmethod
+    def _two_replica_set(**svc_kw):
+        e0 = _engine()
+        e1 = _engine(base=e0)
+        svc0 = PagedGenerationService(e0, **svc_kw)
+        svc1 = PagedGenerationService(e1, **svc_kw)
+        # both warmed BEFORE any fault arms: warmup ticks must not eat a
+        # skip-counted fault hit, and idle pumps exit after draining so the
+        # drill stream's replica is the only one stepping
+        svc0.generate("wfq warm zero", max_new_tokens=2, timeout_s=180)
+        svc1.generate("wfq warm one", max_new_tokens=2, timeout_s=180)
+        return svc0, svc1
+
+    def test_successful_resume_balances_tenant_accounting(self):
+        """(a) a mid-stream death resumed onto the survivor: the stream
+        completes, one admission per attempt, zero pending after."""
+        svc0, svc1 = self._two_replica_set()
+        rs = ReplicaSet([svc0, svc1], supervise=False, failover_budget=1)
+        try:
+            # tick 1 delivers a chunk (skip=1), tick 2 dies: at least one
+            # token is always delivered before the death
+            faults.arm("paged.step", faults.FaultRule(
+                error=RuntimeError("wfq drill: midstream death"),
+                times=1, skip=1))
+            out = "".join(rs.generate_stream(
+                self.PROMPT, max_new_tokens=8, temperature=0.0,
+                timeout_s=120, tenant="team-r",
+            ))
+            faults.reset()
+            assert out
+            stats = rs.stats()
+            assert stats["stream_resumes"] == 1
+            assert stats["resume_exhausted"] == 0
+            tenant = stats["tenants"]["per_tenant"]["team-r"]
+            assert tenant["pending"] == 0, "reservation leaked"
+            assert tenant["admitted"] == 2, "one admission per attempt"
+        finally:
+            faults.reset()
+            rs.close()
+
+    def test_exhausted_budget_balances_and_stays_typed(self):
+        """(b) the resumed attempt dies too and the budget is spent: the
+        caller gets the typed mid-stream error, the exhausted outcome is
+        counted, and the tenant's ledger is still balanced."""
+        # retry_budget=0: the survivor's failed tick kills the resumed
+        # ticket typed instead of requeueing it service-side, so the second
+        # death deterministically reaches the router's budget check
+        svc0, svc1 = self._two_replica_set(retry_budget=0)
+        rs = ReplicaSet([svc0, svc1], supervise=False, failover_budget=1)
+        try:
+            # hit 1 passes (a chunk delivers), hits 2+3 die: the original
+            # replica mid-stream, then the survivor's resumed attempt
+            faults.arm("paged.step", faults.FaultRule(
+                error=RuntimeError("wfq drill: double death"),
+                times=2, skip=1))
+            with pytest.raises(ReplicaUnavailable):
+                for _ in rs.generate_stream(
+                        self.PROMPT, max_new_tokens=8, temperature=0.0,
+                        timeout_s=120, tenant="team-x"):
+                    pass
+            faults.reset()
+            stats = rs.stats()
+            assert stats["stream_resumes"] == 1, "first resume still books"
+            assert stats["resume_exhausted"] == 1
+            tenant = stats["tenants"]["per_tenant"]["team-x"]
+            assert tenant["pending"] == 0, "reservation leaked"
+            assert tenant["admitted"] == 2, "one admission per attempt"
+        finally:
+            faults.reset()
+            rs.close()
+
+    def test_overflow_bucketed_tenant_resumes_balanced(self, monkeypatch):
+        """(c) the PR 11(a) regression shape under RESUME: a stream whose
+        fresh tenant key overflow-bucketed at admission must release and
+        re-charge the CHARGED key on every resume attempt — the raw key
+        was never registered and would silently leak the reservation."""
+        monkeypatch.setattr(TenantFairQueue, "MAX_TRACKED", 1)
+        svc0, svc1 = self._two_replica_set()
+        rs = ReplicaSet([svc0, svc1], supervise=False, failover_budget=1)
+        try:
+            # fill the (shrunken) tenant table so the stream's key buckets
+            rs.generate("seed tenant table", max_new_tokens=2,
+                        tenant="first", timeout_s=180)
+            overflow = TenantFairQueue.OVERFLOW_TENANT
+            # the bucket only registers at its first admission
+            before = rs.tenants.stats()["per_tenant"].get(
+                overflow, {"pending": 0, "admitted": 0})
+            assert before["pending"] == 0
+            faults.arm("paged.step", faults.FaultRule(
+                error=RuntimeError("wfq drill: bucketed death"),
+                times=1, skip=1))
+            out = "".join(rs.generate_stream(
+                self.PROMPT, max_new_tokens=8, temperature=0.0,
+                timeout_s=120, tenant="fresh-stream-tenant",
+            ))
+            faults.reset()
+            assert out
+            assert rs.stats()["stream_resumes"] == 1
+            after = rs.tenants.stats()["per_tenant"][overflow]
+            assert after["pending"] == 0, "bucketed reservation leaked"
+            assert after["admitted"] == before["admitted"] + 2, (
+                "one admission per attempt on the CHARGED key"
+            )
+        finally:
+            faults.reset()
+            rs.close()
+
+
 class TestVerifyTenantCharging:
     """ROADMAP item 1 leftover: verify-node decode admissions must be
     charged to the REQUESTING tenant's WFQ quota, not the shared default —

@@ -538,6 +538,162 @@ class TestPagedStreamingService:
             svc_b.close()
 
 
+class TestSseStreamResume:
+    """ISSUE 14 satellite: session continuity on the SSE wire. A replica
+    dying mid-stream under a 2-replica set must be INVISIBLE to the SSE
+    client — the delivered prefix replays onto the survivor and the wire
+    carries one gapless, duplicate-free token sequence. Only an exhausted
+    resume budget still surfaces the typed mid-stream error event (the
+    pre-resume wire format, unchanged)."""
+
+    QUESTION = "what compiles python to xla programs?"
+
+    @staticmethod
+    def _container(settings):
+        # meshless replicas, like every direct-engine replica test: the
+        # conftest forces 8 virtual CPU devices, and the dp-split mesh
+        # path shards each replica's pool onto a submesh while the shared
+        # weights stay on the full mesh — a layout mismatch that predates
+        # (and is orthogonal to) stream resumption
+        return DependencyContainer(settings=settings, mesh=None)
+
+    @staticmethod
+    def _settings(**serve_over):
+        return fast_settings(
+            generator=GeneratorConfig(
+                provider="tpu", model_preset="tiny", use_verifier=False,
+                max_new_tokens=24, mode="fast",  # greedy: deterministic
+                use_paged_decode=True, kv_page_size=16,
+                kv_max_pages_per_seq=8, max_batch_size=4,
+                # a 24-token answer must span several delivered chunks or
+                # there is no "mid-stream" window to kill inside: an idle
+                # queue runs the BIG tick (decode_max_tick_steps, default
+                # 64), which would ship the whole answer in one harvest
+                decode_steps_per_tick=4, decode_max_tick_steps=4,
+            ),
+            serve=ServeConfig(
+                replicas=2,
+                # no supervisor thread: the drill flips exactly one fault
+                # and must not race an async rebuild (supervised recovery
+                # is drilled in test_chaos)
+                replica_supervise=False,
+                **serve_over,
+            ),
+        )
+
+    @staticmethod
+    def _sse_events(raw: str) -> list:
+        import json as _json
+
+        events = []
+        for line in raw.splitlines():
+            if not line.startswith("data:"):
+                continue
+            payload = line[len("data:"):].strip()
+            if payload == "[DONE]":
+                events.append(("done", None))
+                continue
+            obj = _json.loads(payload)
+            (kind, value), = obj.items()
+            events.append((kind, value))
+        return events
+
+    def test_midstream_kill_is_invisible_on_the_wire(self):
+        from sentio_tpu.infra import faults
+        from sentio_tpu.infra.flight import get_flight_recorder
+
+        async def body(client, container):
+            await seed(client, ["jax compiles python functions to xla"])
+            # reference: the same question, no fault — greedy decode makes
+            # the answer deterministic, so the faulted run must match it
+            resp = await client.post("/chat", json={
+                "question": self.QUESTION, "stream": True,
+                "temperature": 0.0})
+            assert resp.status == 200
+            reference = self._sse_events((await resp.read()).decode())
+            want = "".join(v for k, v in reference if k == "token")
+            assert want, reference
+            # the serve engine pipelines dispatch (decode_pipeline_depth=2,
+            # the production default): tick 1's tokens harvest — and
+            # deliver — at tick 2, so the FIRST tick whose death finds a
+            # delivered chunk is tick 3 (skip=2). The victim replica is
+            # whichever pump is decoding this one stream, so no routing
+            # determinism is needed; the resume replays onto the idle
+            # sibling
+            faults.arm("paged.step", faults.FaultRule(
+                error=RuntimeError("sse drill: midstream death"),
+                times=1, skip=2))
+            try:
+                resp = await client.post("/chat", json={
+                    "question": self.QUESTION, "stream": True,
+                    "temperature": 0.0})
+                assert resp.status == 200
+                raw = (await resp.read()).decode()
+            finally:
+                faults.reset()
+            events = self._sse_events(raw)
+            got = "".join(v for k, v in events if k == "token")
+            # gapless, duplicate-free: byte-identical to the no-fault run
+            assert got == want, (got, want)
+            kinds = [k for k, _ in events]
+            assert "error" not in kinds, events
+            assert kinds[-1] == "done", events
+            # the resume is visible to OPERATORS: stats, flight, /metrics
+            stats = container.generation_service.stats()
+            assert stats["stream_resumes"] == 1, stats["stream_resumes"]
+            resumed = [t for t in get_flight_recorder().timeline()
+                       if t.get("event") == "stream_resumed"]
+            assert resumed and resumed[-1]["replayed_tokens"] >= 1
+            prom = await (await client.get("/metrics")).text()
+            assert 'sentio_tpu_stream_resumes_total{outcome="resumed"}' \
+                in prom
+
+        settings = self._settings()
+        run(with_client(settings, body, container=self._container(settings)))
+
+    def test_exhausted_budget_keeps_typed_error_wire_format(self):
+        from sentio_tpu.infra import faults
+
+        async def body(client, container):
+            await seed(client, ["jax compiles python functions to xla"])
+            # ticks 1+2 pass (pipelined dispatch: tick 1's tokens DELIVER
+            # at tick 2), hit 3 kills the victim mid-stream, hit 4 kills
+            # the RESUMED attempt on the survivor — the budget (1,
+            # following the failover budget) is spent, so the client gets
+            # the pre-resume contract: a typed mid-stream error event, then
+            # [DONE]; no new event kinds, no prose after real tokens
+            faults.arm("paged.step", faults.FaultRule(
+                error=RuntimeError("sse drill: double death"),
+                times=2, skip=2))
+            try:
+                resp = await client.post("/chat", json={
+                    "question": self.QUESTION, "stream": True,
+                    "temperature": 0.0})
+                assert resp.status == 200  # mid-stream: the 200 is committed
+                raw = (await resp.read()).decode()
+            finally:
+                faults.reset()
+            events = self._sse_events(raw)
+            kinds = [k for k, _ in events]
+            assert kinds.count("error") == 1, events
+            error = next(v for k, v in events if k == "error")
+            assert error["code"], error
+            assert "retryable" in error, error
+            # tokens were delivered before the death; the error event ends
+            # the stream (with [DONE]) instead of appending apology prose
+            assert kinds.index("error") > kinds.index("token"), events
+            assert kinds[-1] == "done", events
+            assert set(kinds) <= {"sources", "token", "error", "done"}
+            stats = container.generation_service.stats()
+            assert stats["resume_exhausted"] == 1, stats
+            prom = await (await client.get("/metrics")).text()
+            assert 'sentio_tpu_stream_resumes_total{outcome="exhausted"}' \
+                in prom
+
+        settings = self._settings(crash_retry_budget=0)
+        run(with_client(settings, body, container=self._container(settings)))
+
+
 class TestOverloadMapping:
     """Typed shed/deadline errors → HTTP 429/503/504 + Retry-After — the
     overload story's wire contract (ServiceOverloaded must NEVER be eaten
