@@ -1095,7 +1095,7 @@ def phase_load(llm_cfg, new_tokens):
     return result
 
 
-def phase_chaos(llm_cfg, new_tokens, replica_mode=None):
+def phase_chaos(llm_cfg, new_tokens, replica_mode=None, chaos_mode=None):
     """Replica chaos drill over the open-loop harness (BENCH_CHAOS=1):
     a 2-replica set serves a steady Poisson arrival stream; mid-run one
     replica suffers the scenario picked by ``BENCH_CHAOS_MODE``:
@@ -1117,6 +1117,16 @@ def phase_chaos(llm_cfg, new_tokens, replica_mode=None):
       ``splice_exact`` (every resumed stream byte-identical to its
       no-fault greedy reference) and ``non_resumable_errors`` (target 0
       within budget).
+    * ``partition`` (socket replicas only) — a HALF-OPEN network
+      partition instead of a death: the router's reads from the victim
+      stall (no EOF, no error, worker alive and decoding) while writes
+      still land, mid-delivery like the midstream drill. Detection rests
+      entirely on status-frame staleness (transport-liveness contract);
+      recovery is re-registration at a higher incarnation epoch, and
+      every pre-partition frame is dropped by the epoch fence. The
+      artifact's midstream fields apply, plus ``stale_frames_dropped``,
+      ``heal_vs_respawn`` (did the live worker keep its process?), and
+      the victim's post-incident ``incarnation``.
 
     The artifact answers the operator questions: **availability**
     (completed / arrivals — the error-budget fraction is its complement),
@@ -1162,11 +1172,19 @@ def phase_chaos(llm_cfg, new_tokens, replica_mode=None):
     kill_at_s = float(os.environ.get("BENCH_CHAOS_KILL_AT_S", "5"))
     max_slots = int(os.environ.get("BENCH_CHAOS_SLOTS", "8"))
     seed = int(os.environ.get("BENCH_CHAOS_SEED", "1234"))
-    mode = os.environ.get("BENCH_CHAOS_MODE", "kill").strip().lower()
+    mode = (chaos_mode
+            or os.environ.get("BENCH_CHAOS_MODE", "kill")).strip().lower()
     stall_budget_s = float(os.environ.get("BENCH_CHAOS_STALL_BUDGET_S", "2"))
     if replica_mode is None:
         replica_mode = os.environ.get(
             "BENCH_CHAOS_REPLICA_MODE", "thread").strip().lower()
+    if mode == "partition" and replica_mode != "socket":
+        return {"skipped": "partition chaos needs the socket transport "
+                           f"(replica_mode={replica_mode})",
+                "mode": mode, "replica_mode": replica_mode}
+    # partition traffic IS the midstream shape (all streams, several
+    # delivered chunks each) — only the armed fault differs
+    streamy = mode in ("midstream", "partition")
     gen_tokens = min(new_tokens, 16)
     rng = random.Random(seed)
 
@@ -1177,27 +1195,40 @@ def phase_chaos(llm_cfg, new_tokens, replica_mode=None):
     # default 2s is generous) but stay small next to the run window
     svc_kw = ({"tick_stall_budget_s": stall_budget_s}
               if mode == "stall" else {})
-    # midstream runs smaller ticks so every stream spans SEVERAL delivered
-    # chunks — at 8-step ticks an 8-token answer ships in one harvest and
-    # the kill can never land "between chunks" of a thread-mode stream
-    tick_steps = 4 if mode == "midstream" else 8
+    # midstream/partition run smaller ticks so every stream spans SEVERAL
+    # delivered chunks — at 8-step ticks an 8-token answer ships in one
+    # harvest and the fault can never land "between chunks" of a stream
+    tick_steps = 4 if streamy else 8
     engine_kw = dict(max_slots=max_slots, page_size=16, max_pages_per_seq=8,
                      steps_per_tick=tick_steps, max_tick_steps=tick_steps,
                      pipeline_depth=2, ignore_eos=True)
-    if replica_mode == "process":
+    registry = None
+    if replica_mode in ("process", "socket"):
         import dataclasses as _dc
 
         from sentio_tpu.models.tokenizer import ByteTokenizer
         from sentio_tpu.runtime.worker import ProcessReplica, WorkerSpec
 
-        spec = WorkerSpec(factory_kwargs=dict(
+        spec_kw = dict(factory_kwargs=dict(
             model_config=_dc.asdict(llm_cfg),
             engine_kwargs=engine_kw,
             service_kwargs=dict(svc_kw),
         ))
+        transport_kw = {}
+        if replica_mode == "socket":
+            from sentio_tpu.runtime.replica import WorkerRegistry
+
+            registry = WorkerRegistry("bench-chaos", slots=2)
+            spec_kw.update(auth_token="bench-chaos", status_interval_s=0.05,
+                           reconnect=True, reconnect_backoff_s=0.2,
+                           router_silence_timeout_s=0.8)
+            transport_kw = dict(transport_mode="socket", registry=registry,
+                                partition_timeout_s=1.0, ping_interval_s=0.2,
+                                heal_grace_s=15.0)
+        spec = WorkerSpec(**spec_kw)
         tok = ByteTokenizer(llm_cfg.vocab_size)
         replicas = [ProcessReplica(spec, tok, replica_id=i,
-                                   build_timeout_s=600.0)
+                                   build_timeout_s=600.0, **transport_kw)
                     for i in range(2)]
     else:
         e0 = ContinuousBatchingEngine(model_config=llm_cfg, **engine_kw)
@@ -1222,12 +1253,15 @@ def phase_chaos(llm_cfg, new_tokens, replica_mode=None):
     # Stream answers run LONGER than the generate traffic (several
     # delivered chunks at the shrunken midstream tick) so streams spend
     # most of their life mid-delivery — the window the kill must land in
-    stream_tokens = max(gen_tokens, 16) if mode == "midstream" \
-        else gen_tokens
+    stream_tokens = max(gen_tokens, 16) if streamy else gen_tokens
     stream_prompts = [f"midstream chaos session {i:02d} steady turn"
                       for i in range(8)]
     expected_text: dict = {}
-    if mode == "midstream":
+    victim_pid = victim_epoch = None
+    if replica_mode == "socket":
+        victim_pid = replicas[1].pid
+        victim_epoch = replicas[1].epoch
+    if streamy:
         # references run directly on the designated VICTIM (replica 1 —
         # the one the process-mode SIGKILL arms in): its radix then holds
         # every stream prompt's full prefix, so prefix affinity routes
@@ -1258,6 +1292,7 @@ def phase_chaos(llm_cfg, new_tokens, replica_mode=None):
     completions: list[tuple[float, float]] = []
     t_state = {"kill": None, "detect": None, "recover": None, "done": False}
     stall_release = threading.Event()
+    partition_release = threading.Event()
 
     def worker(prompt: str, t_rel: float) -> None:
         t0 = time.perf_counter()
@@ -1362,13 +1397,21 @@ def phase_chaos(llm_cfg, new_tokens, replica_mode=None):
         # into an idle-stream window would drill plain failover, not
         # resume-by-replay. Process mode needs no gate — the SIGKILL arms
         # at worker.stream_chunk, BETWEEN delivered chunks by definition.
-        if mode == "midstream" and replica_mode != "process":
+        if streamy and not (mode == "midstream"
+                            and replica_mode == "process"):
             with lock:
                 midstream_ready = live_delivered[0] > 0
         else:
             midstream_ready = True
         if not killed and t_rel >= kill_at_s and midstream_ready:
-            if replica_mode == "process":
+            if mode == "partition":
+                # half-open partition of the victim: the router's reads
+                # from replica 1 wedge (frames buffer unread) while its
+                # writes — and the worker itself — stay fully alive
+                faults.arm("transport.recv.r1", faults.FaultRule(
+                    stall_event=partition_release,
+                    stall_s=run_s + 300.0, times=1))
+            elif replica_mode in ("process", "socket"):
                 # the fault arms INSIDE the victim's worker process via
                 # the RPC fault surface: its next decode tick either takes
                 # a REAL mid-dispatch SIGKILL (no handler, no unwinding —
@@ -1407,12 +1450,12 @@ def phase_chaos(llm_cfg, new_tokens, replica_mode=None):
             killed = True
             log(f"phase CHAOS: replica {mode} armed at t={t_rel:.1f}s "
                 f"({replica_mode})")
-        if mode == "midstream":
-            # the midstream drill's offered traffic is ALL SSE-shaped
-            # streams (the generate path is what the kill/stall modes
-            # drill): combined with victim-side reference warming above,
-            # the one-shot fault lands on a pump with live delivered
-            # streams to splice
+        if streamy:
+            # the midstream/partition drills' offered traffic is ALL
+            # SSE-shaped streams (the generate path is what the
+            # kill/stall modes drill): combined with victim-side
+            # reference warming above, the one-shot fault lands on a
+            # pump with live delivered streams to splice
             sp = stream_prompts[seq % len(stream_prompts)]
             with lock:
                 mid["streams"] += 1
@@ -1441,6 +1484,9 @@ def phase_chaos(llm_cfg, new_tokens, replica_mode=None):
             time.sleep(0.1)
     t_state["done"] = True  # stop the watcher (it idles if never killed)
     stall_release.set()  # unwedge the abandoned pump so it can exit
+    # heal the partition AFTER recovery: the old connection's buffered
+    # pre-partition frames drain straight into the stale-epoch fence
+    partition_release.set()
     faults.reset()
 
     t_kill = t_state["kill"]
@@ -1488,7 +1534,7 @@ def phase_chaos(llm_cfg, new_tokens, replica_mode=None):
         "handed_off_tickets": set_stats.get("handed_off", 0),
         "stall_quarantines": set_stats.get("stall_quarantines", 0),
     }
-    if mode == "midstream":
+    if streamy:
         # resumable-stream telemetry: every delivered-token stream the
         # incident touched should RESUME (non_resumable_errors == 0 within
         # budget) and every resumed completion should be byte-identical to
@@ -1502,6 +1548,23 @@ def phase_chaos(llm_cfg, new_tokens, replica_mode=None):
         out["resumed_completions_checked"] = mid["splice_checked"]
         out["splice_exact"] = (mid["splice_mismatch"] == 0
                                if mid["splice_checked"] else None)
+    if mode == "partition" and registry is not None:
+        # the epoch fence at work: give the released (previously wedged)
+        # old connection a moment to drain its buffered pre-partition
+        # frames, then record how many the fence dropped, whether the
+        # live worker HEALED (kept its process across re-registration)
+        # or had to be respawned, and the victim's final incarnation
+        drain_end = time.perf_counter() + 15
+        while registry.stale_frames(1) == 0 and \
+                time.perf_counter() < drain_end:
+            time.sleep(0.1)
+        cur = rs._services[1]
+        out["stale_frames_dropped"] = registry.stale_frames(1)
+        out["heal_vs_respawn"] = (
+            ("heal" if cur.pid == victim_pid else "respawn")
+            if killed and t_recover is not None else None)
+        out["incarnation"] = cur.epoch
+        out["incarnation_before"] = victim_epoch
     if steady:
         out["steady_p95_ms"] = round(_percentile(steady, 0.95), 2)
     if incident:
@@ -1516,10 +1579,12 @@ def phase_chaos(llm_cfg, new_tokens, replica_mode=None):
             t.name == "paged-decode-pump" and t.is_alive()
             for t in threading.enumerate()):
         time.sleep(0.05)
-    if replica_mode == "process":
+    if registry is not None:
+        registry.close()
+    if replica_mode in ("process", "socket"):
         # acceptance telemetry: close() must have REAPED every worker
-        # (SIGKILLed, wedged, and respawned alike) — orphan_workers != 0
-        # in the artifact is a failed drill
+        # (SIGKILLed, wedged, partitioned-then-healed, and respawned
+        # alike) — orphan_workers != 0 in the artifact is a failed drill
         import multiprocessing
 
         reap_end = time.perf_counter() + 30
@@ -1529,11 +1594,15 @@ def phase_chaos(llm_cfg, new_tokens, replica_mode=None):
         out["orphan_workers"] = len(multiprocessing.active_children())
     set_metrics(MetricsCollector())
     extra = ""
-    if mode == "midstream":
+    if streamy:
         extra = (f" resumed={out['resumed_streams']} "
                  f"replayed={out['replayed_tokens_total']} "
                  f"splice_exact={out['splice_exact']} "
                  f"non_resumable={out['non_resumable_errors']}")
+    if mode == "partition":
+        extra += (f" stale_dropped={out.get('stale_frames_dropped')} "
+                  f"outcome={out.get('heal_vs_respawn')} "
+                  f"epoch={out.get('incarnation')}")
     log(f"phase CHAOS[{mode}/{replica_mode}]: "
         f"availability={out['availability']} "
         f"detect={out['detection_latency_s']}s "
@@ -1794,6 +1863,7 @@ def main() -> None:
     if os.environ.get("BENCH_CHAOS") == "1":
         chaos_modes = [m.strip().lower() for m in os.environ.get(
             "BENCH_CHAOS_REPLICA_MODE", "thread").split(",") if m.strip()]
+        scenario = os.environ.get("BENCH_CHAOS_MODE", "kill").strip().lower()
         if len(chaos_modes) <= 1:
             chaos = phase_chaos(
                 llm_cfg, new_tokens,
@@ -1806,6 +1876,13 @@ def main() -> None:
                     for m in chaos_modes
                 },
             }
+        if scenario != "partition" and "socket" in chaos_modes:
+            # socket replicas in the matrix: the half-open partition drill
+            # rides along (it is the fault class the socket tier exists
+            # for) — the artifact gains a dedicated `partition` section
+            chaos["partition"] = phase_chaos(
+                llm_cfg, new_tokens, replica_mode="socket",
+                chaos_mode="partition")
 
     total_s = time.perf_counter() - t_start
     log(f"bench wall {total_s:.0f}s")
@@ -1863,6 +1940,8 @@ def main() -> None:
         for sub in (chaos.get("per_replica_mode") or {}).values():
             if isinstance(sub, dict):
                 sub["device_platform"] = plat
+        if isinstance(chaos.get("partition"), dict):
+            chaos["partition"]["device_platform"] = plat
     print(json.dumps(payload))
     if fallback_reason:
         # repeated LAST so the banner cannot scroll away under phase logs

@@ -29,6 +29,7 @@ from sentio_tpu.analysis.hygiene import check_hygiene
 from sentio_tpu.analysis.locks import check_locks
 from sentio_tpu.analysis.phasing import check_phase_timer
 from sentio_tpu.analysis.retrace import check_retrace
+from sentio_tpu.analysis.sockcheck import check_sockets
 
 __all__ = ["lint_paths", "run_gate", "main", "DEFAULT_BASELINE"]
 
@@ -37,7 +38,7 @@ REPO_ROOT = PACKAGE_ROOT.parent
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
 
 RULES = (check_retrace, check_locks, check_hygiene, check_blocking,
-         check_phase_timer, check_fork)
+         check_phase_timer, check_fork, check_sockets)
 
 
 def _iter_py_files(path: Path):

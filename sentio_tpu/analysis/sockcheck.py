@@ -1,0 +1,161 @@
+"""Socket deadline discipline: no unbounded network blocking.
+
+The multi-host worker tier (runtime/transport.py) exists because the
+network fails in ways a pipe never does — and the nastiest failure is the
+one that raises nothing: a half-open link where a blocking ``recv`` (or a
+``send`` into a full buffer) simply never returns. The transport module is
+the ONE vetted place that machinery lives: every blocking socket op there
+carries a deadline (fixed ``settimeout`` poll + explicit frame deadlines),
+and everything above it observes liveness through status-frame staleness
+and the partition watchdog. This rule keeps anyone from quietly opening a
+raw, deadline-free socket elsewhere in the tree — the ``join-no-timeout``
+precedent, applied to the network:
+
+``socket-no-timeout``
+    Fires on:
+
+    * a ``socket.socket(...)`` (or bare ``socket(...)``) construction in a
+      function that never wires a deadline — no ``.settimeout(...)`` with
+      a non-``None`` argument and no ``setsockopt`` with
+      ``SO_RCVTIMEO``/``SO_SNDTIMEO`` anywhere in the same scope;
+    * a ``create_connection(...)`` call with no ``timeout=`` argument in
+      an unwired scope (its default is socket-global, i.e. usually
+      blocking-forever);
+    * a ``.recv(...)`` call on a socket-shaped receiver (a name containing
+      ``sock`` or ``conn``) lexically inside a ``while`` loop in an
+      unwired scope — the classic zero-timeout read loop that hangs a
+      reader thread on a stalled link.
+
+    The vetted transport internals (whose deadlines are enforced by
+    explicit ``perf_counter`` bookkeeping the AST cannot see) carry the
+    standard inline ``# lint: allow(socket-no-timeout)`` marker.
+
+Suppression: the standard inline ``# lint: allow(socket-no-timeout)``
+marker.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from sentio_tpu.analysis.findings import Finding, SourceFile
+
+__all__ = ["check_sockets"]
+
+RULE = "socket-no-timeout"
+
+# setsockopt option names that count as deadline wiring
+_DEADLINE_OPTS = ("SO_RCVTIMEO", "SO_SNDTIMEO")
+
+# receiver-name fragments that mark a .recv() call as socket-shaped
+_SOCKETISH = ("sock", "conn")
+
+
+def _call_name(node: ast.Call) -> str:
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return ""
+
+
+def _receiver_name(node: ast.Call) -> str:
+    """Trailing name of the object a method is called on:
+    ``self._sock.recv(...)`` → ``_sock``; bare calls → ''."""
+    if not isinstance(node.func, ast.Attribute):
+        return ""
+    obj = node.func.value
+    if isinstance(obj, ast.Attribute):
+        return obj.attr
+    if isinstance(obj, ast.Name):
+        return obj.id
+    return ""
+
+
+def _wires_deadline(node: ast.Call) -> bool:
+    """True when this call itself establishes a socket deadline."""
+    name = _call_name(node)
+    if name == "settimeout":
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        if not args:
+            return False
+        first = args[0]
+        return not (isinstance(first, ast.Constant) and first.value is None)
+    if name == "setsockopt":
+        for arg in ast.walk(node):
+            if isinstance(arg, ast.Attribute) and arg.attr in _DEADLINE_OPTS:
+                return True
+            if isinstance(arg, ast.Name) and arg.id in _DEADLINE_OPTS:
+                return True
+    return False
+
+
+def _has_timeout_kwarg(node: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in node.keywords)
+
+
+def check_sockets(tree: ast.Module, src: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+
+    def scope_nodes(scope: ast.AST):
+        """Direct statements of this scope, not descending into nested
+        function scopes (each function wires — or fails to wire — its own
+        deadlines)."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    def check_scope(scope: ast.AST) -> None:
+        wired = any(
+            isinstance(n, ast.Call) and _wires_deadline(n)
+            for n in scope_nodes(scope)
+        )
+        # second pass: flag creations and recv loops in unwired scopes
+        def visit(node: ast.AST, in_while: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                check_scope(node)
+                return
+            if isinstance(node, ast.While):
+                in_while = True
+            if isinstance(node, ast.Call) and not wired:
+                name = _call_name(node)
+                f = None
+                if name == "socket":
+                    f = src.finding(
+                        RULE, node.lineno,
+                        "socket.socket() with no settimeout/SO_* deadline "
+                        "wiring in scope — a half-open peer hangs every "
+                        "blocking op forever; wire a timeout (see "
+                        "runtime/transport.py) or annotate the vetted site",
+                    )
+                elif name == "create_connection" and not _has_timeout_kwarg(
+                        node):
+                    f = src.finding(
+                        RULE, node.lineno,
+                        "create_connection() without timeout= in an "
+                        "unwired scope — the connect (and every later op) "
+                        "can block forever on a partitioned host",
+                    )
+                elif (name == "recv" and in_while
+                      and any(s in _receiver_name(node).lower()
+                              for s in _SOCKETISH)):
+                    f = src.finding(
+                        RULE, node.lineno,
+                        "zero-timeout recv loop on a socket — a stalled "
+                        "link wedges this thread with no error ever "
+                        "raised; poll with a deadline and surface the "
+                        "staleness (see SocketTransport._recv_exact)",
+                    )
+                if f is not None:
+                    findings.append(f)
+            for child in ast.iter_child_nodes(node):
+                visit(child, in_while)
+
+        for stmt in ast.iter_child_nodes(scope):
+            visit(stmt, False)
+
+    check_scope(tree)
+    return findings

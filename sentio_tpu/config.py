@@ -467,6 +467,33 @@ class ServeConfig:
     # written for this long (a stalled decode otherwise looks identical
     # to a slow one from the client side); 0 disables
     sse_keepalive_s: float = 15.0
+    # ---- multi-host worker tier (REPLICA_MODE=socket) ----
+    # advertised remote workers "host:port,host:port" the router DIALS —
+    # one replica per address (overrides REPLICAS); empty = spawn local
+    # socket workers that self-register against the router's listener
+    replica_workers: str = ""
+    # shared secret for the versioned registration handshake. Spawned-
+    # local mode generates a per-process random token when empty; the
+    # dial-out mode (REPLICA_WORKERS) REQUIRES an explicit token set
+    # identically on both sides (the worker was started elsewhere)
+    socket_auth_token: str = ""
+    # worker-registry listener bind (self-registering workers dial this;
+    # bind a routable interface for workers on other hosts)
+    socket_bind_host: str = "127.0.0.1"
+    socket_bind_port: int = 0
+    # transport-liveness budget: NO frames from a worker for this long
+    # (status frames flow at ~100 ms) latches the typed partition death —
+    # the socket analogue of proc.is_alive() going false
+    socket_partition_timeout_s: float = 2.0
+    # frame codec bounds: an oversized frame is refused typed on both
+    # sides; a partial frame (or a write the peer stopped draining) past
+    # the timeout drops the connection instead of hanging a reader
+    socket_frame_max_bytes: int = 32 * 1024 * 1024
+    socket_frame_timeout_s: float = 30.0
+    # rebuild grace in which a live, link-partitioned worker may
+    # re-register (HEAL — keeps the process and its warm engine) before
+    # the supervisor reaps and respawns
+    socket_heal_grace_s: float = 5.0
 
     @classmethod
     def from_env(cls) -> "ServeConfig":
@@ -536,7 +563,37 @@ class ServeConfig:
                 ["REPLICA_REBUILD_WORKERS"], 1
             ),
             sse_keepalive_s=_env_float(["SSE_KEEPALIVE_S"], 15.0),
+            replica_workers=_env_str(["REPLICA_WORKERS"], ""),
+            socket_auth_token=_env_str(["SOCKET_AUTH_TOKEN"], ""),
+            socket_bind_host=_env_str(["SOCKET_BIND_HOST"], "127.0.0.1"),
+            socket_bind_port=_env_int(["SOCKET_BIND_PORT"], 0),
+            socket_partition_timeout_s=_env_float(
+                ["SOCKET_PARTITION_TIMEOUT_S"], 2.0
+            ),
+            socket_frame_max_bytes=_env_int(
+                ["SOCKET_FRAME_MAX_BYTES"], 32 * 1024 * 1024
+            ),
+            socket_frame_timeout_s=_env_float(
+                ["SOCKET_FRAME_TIMEOUT_S"], 30.0
+            ),
+            socket_heal_grace_s=_env_float(["SOCKET_HEAL_GRACE_S"], 5.0),
         )
+
+    def parsed_replica_workers(self) -> list[tuple[str, int]]:
+        """``"hostA:9101,hostB:9101"`` → [("hostA", 9101), ...];
+        malformed entries raise (a silently dropped worker address is a
+        silently smaller serving tier)."""
+        out: list[tuple[str, int]] = []
+        for part in self.replica_workers.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            host, sep, port = part.rpartition(":")
+            if not sep or not host:
+                raise ValueError(
+                    f"REPLICA_WORKERS entry {part!r} is not host:port")
+            out.append((host, int(port)))
+        return out
 
     def parsed_tenant_weights(self) -> dict[str, float]:
         """``"a:4,b:1"`` → {"a": 4.0, "b": 1.0}; malformed entries skipped."""

@@ -43,7 +43,8 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Iterator, Optional
 
-__all__ = ["FaultRule", "arm", "disarm", "reset", "hit", "inject", "active_rules"]
+__all__ = ["FaultRule", "arm", "disarm", "reset", "hit", "hit_frame",
+           "inject", "active_rules"]
 
 
 @dataclass
@@ -76,6 +77,15 @@ class FaultRule:
       (``ProcessReplica.inject_fault``) arms it in the right process.
       Composes with ``stall_s`` (wedge, then die) but not ``error`` —
       the process is gone before any raise.
+    * ``drop`` — the **network** fault, meaningful only at the transport
+      frame points (``transport.recv[...]`` / ``transport.send[...]``,
+      checked via :func:`hit_frame`): the frame is silently discarded —
+      unsent, or received-and-ignored. ``drop=True, times=N`` is "lose the
+      next N frames"; ``delay_s`` alone is a slow link; ``stall_s`` /
+      ``stall_event`` at a recv point is the **half-open partition**
+      (reads stall while the peer's writes — and this side's sends — keep
+      succeeding), the fault the worker registry's incarnation epochs
+      exist to make safe.
     """
 
     error: Optional[BaseException] = None
@@ -85,6 +95,7 @@ class FaultRule:
     stall_s: Optional[float] = None
     stall_event: Optional[threading.Event] = None
     kill_process: bool = False
+    drop: bool = False
     skip: int = 0
     rng: random.Random = field(default_factory=lambda: random.Random(0))
     hits: int = 0
@@ -124,14 +135,16 @@ def active_rules() -> dict[str, FaultRule]:
         return dict(_rules)
 
 
-def hit(point: str) -> None:
-    """Framework code calls this at an injection point. No-op unless armed."""
+def _hit_impl(point: str) -> bool:
+    """Shared body of :func:`hit` / :func:`hit_frame`: apply an armed
+    rule's stall/kill/delay/error effects; return whether a fired rule
+    asks for the frame to be DROPPED (transport points only)."""
     if not _rules:  # fast path: nothing armed anywhere
-        return
+        return False
     with _lock:
         rule = _rules.get(point)
         if rule is None:
-            return
+            return False
         rule.hits += 1
         fire = rule.should_fire()
         if fire:
@@ -159,6 +172,20 @@ def hit(point: str) -> None:
         time.sleep(delay)
     if error is not None:
         raise type(error)(*error.args)
+    return bool(fire and rule.drop)
+
+
+def hit(point: str) -> None:
+    """Framework code calls this at an injection point. No-op unless armed."""
+    _hit_impl(point)
+
+
+def hit_frame(point: str) -> bool:
+    """Frame-granular transport variant of :func:`hit`: same stall / delay
+    / error / kill semantics, plus a return value — True means an armed
+    ``drop`` rule fired and the caller must discard this frame (unsent on
+    the send path, read-and-ignored on the recv path)."""
+    return _hit_impl(point)
 
 
 @contextmanager
@@ -170,6 +197,7 @@ def inject(
     delay_s: float = 0.0,
     stall_s: Optional[float] = None,
     stall_event: Optional[threading.Event] = None,
+    drop: bool = False,
     skip: int = 0,
     seed: int = 0,
 ) -> Iterator[FaultRule]:
@@ -180,7 +208,7 @@ def inject(
     rule = FaultRule(
         error=error, times=times, probability=probability,
         delay_s=delay_s, stall_s=stall_s, stall_event=stall_event,
-        skip=skip, rng=random.Random(seed),
+        drop=drop, skip=skip, rng=random.Random(seed),
     )
     arm(point, rule)
     try:

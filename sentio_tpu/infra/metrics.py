@@ -273,6 +273,38 @@ class MetricsCollector:
                 "replica worker process deaths observed by the router",
                 ["replica"], registry=r,
             ),
+            # multi-host worker tier (runtime/transport.py + the worker
+            # registry in runtime/replica.py): each (re)registration of a
+            # socket worker bumps the slot's incarnation epoch — this
+            # gauge IS the epoch, so a sawtooth means the slot is churning
+            "worker_incarnation": Gauge(
+                "sentio_tpu_worker_incarnation",
+                "current incarnation epoch of each replica slot's worker "
+                "(bumped at every socket (re)registration)",
+                ["replica"], registry=r,
+            ),
+            # frames from a PREVIOUS incarnation dropped at dispatch — a
+            # partition healing is the normal source (the old connection
+            # drains its buffered pre-partition frames); nonzero during an
+            # incident is the epoch fence doing its job, a sustained rate
+            # outside incidents means a zombie connection never died
+            "worker_stale_frames": Counter(
+                "sentio_tpu_worker_stale_frames_total",
+                "worker frames dropped for carrying a stale incarnation "
+                "epoch",
+                ["replica"], registry=r,
+            ),
+            # worker (re)connection outcomes: heal = a live partitioned
+            # worker re-registered and kept its process; respawn = the
+            # supervisor spawned a fresh process; reconnected = a dialed
+            # remote worker accepted a fresh router connection; rejected_*
+            # = the registry refused a registration. monitoring.yaml's
+            # SentioTpuWorkerFlapping alerts on churn in this series.
+            "worker_reconnects": Counter(
+                "sentio_tpu_worker_reconnects_total",
+                "socket worker reconnection outcomes",
+                ["outcome"], registry=r,
+            ),
             # resumable streams (runtime/replica.py): mid-flight failovers
             # of delivered-token streams. outcome=resumed is the healthy
             # path; a sustained resume RATE means a replica is flapping —
@@ -471,6 +503,40 @@ class MetricsCollector:
         counter = self._prom.get("worker_deaths")
         if counter is not None:
             counter.labels(str(replica)).inc()
+
+    def record_worker_incarnation(self, replica: int, epoch: int) -> None:
+        """Publish one replica slot's CURRENT worker incarnation epoch
+        (worker registry, runtime/replica.py) — set at every socket
+        (re)registration."""
+        if not self.enabled:
+            return
+        self.memory.set_gauge("worker_incarnation", (str(replica),),
+                              float(epoch))
+        gauge = self._prom.get("worker_incarnation")
+        if gauge is not None:
+            gauge.labels(str(replica)).set(float(epoch))
+
+    def record_stale_frames(self, replica: int, n: int = 1) -> None:
+        """Count worker frames dropped for carrying a stale incarnation
+        epoch — a reconnected worker's pre-partition traffic hitting the
+        epoch fence instead of resurrecting dead tickets."""
+        if not self.enabled or n <= 0:
+            return
+        self.memory.inc("worker_stale_frames", (str(replica),), float(n))
+        counter = self._prom.get("worker_stale_frames")
+        if counter is not None:
+            counter.labels(str(replica)).inc(n)
+
+    def record_worker_reconnect(self, outcome: str) -> None:
+        """One socket-worker reconnection outcome (``heal`` | ``respawn``
+        | ``reconnected`` | ``rejected_auth`` | ``rejected_proto``) —
+        the churn series behind SentioTpuWorkerFlapping."""
+        if not self.enabled:
+            return
+        self.memory.inc("worker_reconnects", (outcome,))
+        counter = self._prom.get("worker_reconnects")
+        if counter is not None:
+            counter.labels(outcome).inc()
 
     def record_stream_resume(self, outcome: str) -> None:
         """One mid-flight stream resume outcome (``outcome``: resumed |

@@ -101,21 +101,28 @@ class TpuProvider:
         if stats is not None:
             stats.update(result.stats_dict())
 
-    def _stream_takes_stats(self) -> bool:
-        """Whether the attached service's ``generate_stream`` accepts the
-        ``stats_out`` sink — introspected ONCE per provider, not per
+    def _stream_takes(self, kwarg: str) -> bool:
+        """Whether the attached service's ``generate_stream`` accepts
+        ``kwarg`` — introspected ONCE per provider per kwarg, not per
         streamed request (the probe sits on the hot path)."""
-        cached = getattr(self, "_stream_stats_ok", None)
+        cache = getattr(self, "_stream_kwarg_ok", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(self, "_stream_kwarg_ok", cache)
+        cached = cache.get(kwarg)
         if cached is None:
             import inspect
 
             try:
-                cached = "stats_out" in inspect.signature(
+                cached = kwarg in inspect.signature(
                     self.service.generate_stream).parameters
             except (TypeError, ValueError):
                 cached = False
-            object.__setattr__(self, "_stream_stats_ok", cached)
+            cache[kwarg] = cached
         return cached
+
+    def _stream_takes_stats(self) -> bool:
+        return self._stream_takes("stats_out")
 
     def chat(self, prompt: str, max_new_tokens: int, temperature: float,
              request_id: Optional[str] = None,
@@ -160,7 +167,8 @@ class TpuProvider:
                deadline_ts: Optional[float] = None,
                tenant: Optional[str] = None,
                priority: Optional[str] = None,
-               stats: Optional[dict] = None) -> Iterator[str]:
+               stats: Optional[dict] = None,
+               resumable: Optional[bool] = None) -> Iterator[str]:
         if self.service is not None and hasattr(self.service, "generate_stream"):
             yielded_any = False
             stream_kwargs = self._tenant_kwargs(tenant, priority)
@@ -169,6 +177,12 @@ class TpuProvider:
                 # test fake with the bare generate_stream signature keeps
                 # working (the gate then sees no logprobs and never skips)
                 stream_kwargs["stats_out"] = stats
+            if resumable is False and self._stream_takes("resumable"):
+                # per-request opt-out of resume-by-replay (PR 14's knob,
+                # ReplicaSet.generate_stream): a mid-stream replica death
+                # then keeps the typed mid-stream error. Only the replica
+                # tier takes it; bare services have nothing to resume.
+                stream_kwargs["resumable"] = False
             try:
                 for piece in self.service.generate_stream(
                     prompt, max_new_tokens=max_new_tokens, temperature=temperature,
@@ -520,10 +534,14 @@ class LLMGenerator:
         tenant: Optional[str] = None,
         priority: Optional[str] = None,
         stats: Optional[dict] = None,
+        resumable: Optional[bool] = None,
     ) -> dict:
         """The optional per-request context kwargs (trace id, absolute
-        deadline, WFQ tenant key + priority tier, confidence-stats sink)
-        the provider's method is able to receive."""
+        deadline, WFQ tenant key + priority tier, confidence-stats sink,
+        stream-resumption opt-out) the provider's method is able to
+        receive. ``resumable`` is forwarded only on opt-OUT (False) —
+        True is every layer's default, so omitting it keeps minimal
+        test/third-party providers working."""
         out: dict = {}
         if request_id and self._method_accepts(method, "request_id"):
             out["request_id"] = request_id
@@ -535,6 +553,8 @@ class LLMGenerator:
             out["priority"] = priority
         if stats is not None and self._method_accepts(method, "stats"):
             out["stats"] = stats
+        if resumable is False and self._method_accepts(method, "resumable"):
+            out["resumable"] = False
         return out
 
     def generate(
@@ -572,6 +592,7 @@ class LLMGenerator:
         tenant: Optional[str] = None,
         priority: Optional[str] = None,
         stats: Optional[dict] = None,
+        resumable: Optional[bool] = None,
     ) -> Iterator[str]:
         prompt = self.build_prompt(query, documents)
         temp = temperature if temperature is not None else self.config.temperature(mode)
@@ -580,7 +601,7 @@ class LLMGenerator:
             max_new_tokens=max_new_tokens or self.config.max_new_tokens,
             temperature=temp,
             **self._trace_kwargs("stream", request_id, deadline_ts,
-                                 tenant, priority, stats),
+                                 tenant, priority, stats, resumable),
         )
 
     def chat_raw(self, prompt: str, max_new_tokens: int, temperature: float,

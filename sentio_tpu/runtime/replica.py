@@ -160,6 +160,7 @@ logger = logging.getLogger(__name__)
 __all__ = [
     "ReplicaSet",
     "TenantFairQueue",
+    "WorkerRegistry",
     "DEFAULT_TENANT",
     "PRIORITY_INTERACTIVE",
     "PRIORITY_BATCH",
@@ -463,6 +464,277 @@ class TenantFairQueue:
                     for name, state in self._tenants.items()
                 },
             }
+
+
+class WorkerRegistry:
+    """Router-side registry of SOCKET replica workers: who is connected,
+    at which **incarnation epoch**, and which frames are too old to trust.
+
+    The multi-host worker tier (``REPLICA_MODE=socket``,
+    runtime/worker.py + runtime/transport.py) replaces the spawn pipe's
+    built-in identity — one pipe, one process, one lifetime — with TCP
+    connections that can outlive, predate, or overlap a worker's useful
+    life. The registry restores identity with one monotonic counter per
+    replica slot:
+
+    * every (re)registration — a spawned worker's first connect, a
+      partitioned worker's reconnect, a router dial to an advertised
+      remote worker — bumps the slot's epoch and stamps it into the
+      connection's frame headers (``SocketTransport.epoch``);
+    * the router-side dispatcher drops any frame whose epoch is older
+      than the slot's CURRENT epoch (:meth:`note_stale_frame`): a worker
+      that vanished behind a partition and later heals can never
+      resurrect dead tickets or double-deliver stream chunks, because its
+      pre-partition frames are fenced the instant the new incarnation
+      registers;
+    * the supervisor's respawn path *awaits re-registration* here
+      (:meth:`await_registration`) before deciding between **heal** (a
+      live worker reconnected — adopt the new connection, keep the
+      process) and **respawn** (no re-registration in time — reap and
+      spawn fresh).
+
+    One listener serves every slot; worker hellos are authenticated with
+    the shared token (constant-time compare) and version-checked before
+    any epoch is granted. Rejections are counted into
+    ``sentio_tpu_worker_reconnects_total{outcome=rejected_*}``."""
+
+    def __init__(
+        self,
+        auth_token: str,
+        slots: int,
+        bind_host: str = "127.0.0.1",
+        bind_port: int = 0,
+        max_frame_bytes: int = 32 * 1024 * 1024,
+        frame_timeout_s: float = 30.0,
+        hello_timeout_s: float = 10.0,
+    ) -> None:
+        import socket as _socket
+
+        if not auth_token:
+            raise ValueError("WorkerRegistry needs a non-empty auth token")
+        self.auth_token = auth_token
+        self.slots = max(int(slots), 1)
+        self.max_frame_bytes = int(max_frame_bytes)
+        self.frame_timeout_s = float(frame_timeout_s)
+        self.hello_timeout_s = float(hello_timeout_s)
+        self._mutex = make_lock("WorkerRegistry._mutex")
+        self._epochs = [0] * self.slots  # guarded-by: _mutex
+        self._stale = [0] * self.slots  # guarded-by: _mutex
+        self._registrations = 0  # guarded-by: _mutex
+        self._rejections = 0  # guarded-by: _mutex
+        self._pending: list[_queue.Queue] = [
+            _queue.Queue() for _ in range(self.slots)
+        ]
+        self._stop = threading.Event()
+        listener = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        listener.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        # bounded accept wait: close() must be able to stop the loop
+        listener.settimeout(0.2)
+        listener.bind((bind_host, int(bind_port)))
+        listener.listen(max(2 * self.slots, 8))
+        self._listener = listener
+        self._addr = listener.getsockname()
+        self._accepter = threading.Thread(
+            target=self._accept_loop, name="worker-registry-accept",
+            daemon=True,
+        )
+        self._accepter.start()
+
+    @property
+    def address(self) -> tuple:
+        """(host, port) workers dial to (self-)register."""
+        return self._addr
+
+    # ------------------------------------------------------------ epoch book
+
+    def current_epoch(self, slot: int) -> int:
+        with self._mutex:
+            return self._epochs[slot]
+
+    def assign_epoch(self, slot: int) -> int:
+        """Bump + return the slot's incarnation epoch. The bump is the
+        fence: from this instant every frame of the PREVIOUS incarnation
+        is stale. Also used directly by the dial-out path
+        (``REPLICA_WORKERS``), where the router initiates the connection
+        and no listener registration happens."""
+        with self._mutex:
+            self._epochs[slot] += 1
+            epoch = self._epochs[slot]
+        try:
+            get_metrics().record_worker_incarnation(slot, epoch)
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            pass
+        return epoch
+
+    def note_stale_frame(self, slot: int) -> None:
+        with self._mutex:
+            self._stale[slot] += 1
+        try:
+            get_metrics().record_stale_frames(slot)
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            pass
+
+    def stale_frames(self, slot: int) -> int:
+        with self._mutex:
+            return self._stale[slot]
+
+    # ---------------------------------------------------------- registration
+
+    def _accept_loop(self) -> None:
+        import socket as _socket
+
+        while not self._stop.is_set():
+            try:
+                conn, _peer = self._listener.accept()
+            except _socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed under us: shutting down
+            # handshake on its own short-lived thread: a connector that
+            # never sends its hello must not stall the accept loop (the
+            # hello read is bounded by hello_timeout_s)
+            threading.Thread(
+                target=self._handshake, args=(conn,),
+                name="worker-registry-handshake", daemon=True,
+            ).start()
+
+    def _handshake(self, conn) -> None:
+        from sentio_tpu.runtime.transport import (
+            SocketTransport,
+            TransportClosed,
+            TransportError,
+            expect_hello,
+        )
+
+        transport = SocketTransport(
+            conn, max_frame_bytes=self.max_frame_bytes,
+            frame_timeout_s=self.frame_timeout_s,
+        )
+        try:
+            hello = expect_hello(transport, self.auth_token,
+                                 timeout_s=self.hello_timeout_s)
+        except TransportClosed as exc:
+            # a connection that never spoke (port scan, TCP liveness
+            # probe, flaky dialer): not a protocol rejection — booking it
+            # as rejected_* would pollute the series operators are told
+            # should be zero in steady state
+            logger.debug("silent connection to the worker registry "
+                         "dropped: %s", exc)
+            with self._mutex:
+                self._rejections += 1
+            transport.close()
+            return
+        except TransportError as exc:
+            self._reject(transport, None, str(exc))
+            return
+        except Exception:  # noqa: BLE001 — a hostile hello must not kill the thread
+            logger.exception("worker registration handshake crashed")
+            transport.close()
+            return
+        slot = hello.get("slot", -1)
+        if not isinstance(slot, int) or not (0 <= slot < self.slots):
+            self._reject(transport, transport, f"unknown slot {slot!r}")
+            return
+        epoch = self.assign_epoch(slot)
+        transport.fault_scope = f"r{slot}"
+        transport.epoch = epoch
+        try:
+            transport.send((0, "hello_ack", {"epoch": epoch}))
+        except TransportError:
+            transport.close()
+            return
+        with self._mutex:
+            self._registrations += 1
+        logger.info("worker registered for slot %d at epoch %d (pid %s)",
+                    slot, epoch, hello.get("pid"))
+        q = self._pending[slot]
+        # supersede by EPOCH, not by arrival order: two racing
+        # registrations for a slot (a partitioned worker's redial vs the
+        # supervisor's fresh respawn) may drain each other concurrently,
+        # and keeping whichever thread ran last would let the STALE
+        # connection bury the live one. Collect everything queued plus
+        # this one, keep the highest epoch, close the rest.
+        entries = [(transport, hello, epoch)]
+        while True:
+            try:
+                entries.append(q.get_nowait())
+            except _queue.Empty:
+                break
+        entries.sort(key=lambda e: e[2])
+        for old_transport, _h, _e in entries[:-1]:
+            old_transport.close()
+        q.put(entries[-1])
+
+    def _reject(self, transport, ackable, reason: str) -> None:
+        with self._mutex:
+            self._rejections += 1
+        outcome = ("rejected_auth" if "token" in reason
+                   else "rejected_proto")
+        logger.warning("worker registration rejected: %s", reason)
+        try:
+            get_metrics().record_worker_reconnect(outcome)
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            pass
+        if ackable is not None:
+            from sentio_tpu.runtime.transport import TransportError
+
+            try:
+                ackable.send((0, "hello_reject", {"reason": reason}))
+            except TransportError:
+                pass
+        transport.close()
+
+    def await_registration(self, slot: int, timeout_s: float):
+        """Block until a worker registers for ``slot`` (or raise a typed
+        :class:`ReplicaUnavailable` after ``timeout_s``). Returns
+        ``(transport, hello, epoch)`` for the NEWEST registration —
+        superseded ones were already fenced and closed."""
+        deadline = time.perf_counter() + max(timeout_s, 0.0)
+        q = self._pending[slot]
+        while True:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                raise ReplicaUnavailable(
+                    f"no worker registered for slot {slot} within "
+                    f"{timeout_s:.0f}s",
+                    retry_after_s=2.0,
+                    details={"replica": slot, "reason": "no_registration"},
+                )
+            try:
+                transport, hello, epoch = q.get(timeout=min(remaining, 0.5))
+            except _queue.Empty:
+                continue
+            if epoch < self.current_epoch(slot):
+                transport.close()  # superseded while queued
+                continue
+            return transport, hello, epoch
+
+    # ------------------------------------------------------------- lifecycle
+
+    def stats(self) -> dict:
+        with self._mutex:
+            return {
+                "epochs": list(self._epochs),
+                "stale_frames": list(self._stale),
+                "registrations": self._registrations,
+                "rejections": self._rejections,
+            }
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        if self._accepter.is_alive():
+            self._accepter.join(timeout=5.0)
+        for q in self._pending:
+            while True:
+                try:
+                    transport, _h, _e = q.get_nowait()
+                except _queue.Empty:
+                    break
+                transport.close()
 
 
 class ReplicaSet:
@@ -1736,7 +2008,8 @@ class ReplicaSet:
         "prefix_miss_tokens", "prefix_cache_pages", "prefix_cache_nodes",
         "queued_inbox", "ticks", "completed", "max_queue", "shed", "expired",
         "cancelled", "requeued", "tick_failures", "pump_leaked",
-        "spec_verifies", "spec_emitted",
+        "spec_verifies", "spec_emitted", "stale_frames",
+        "worker_reconnects",
     )
     _MAX_KEYS = ("max_active_slots", "draining")
 

@@ -60,6 +60,24 @@ for streams) just wakes with the survivor's answer, spending no failover
 budget. Without an enabling ReplicaSet the shadow stays passive and death
 keeps its fail-fast typed surface.
 
+**Transports & the multi-host tier** — the pickle-frame protocol runs
+behind a transport seam (runtime/transport.py): ``REPLICA_MODE=process``
+keeps the spawn pipe, byte-identical; ``REPLICA_MODE=socket`` runs the
+SAME frames over length-prefixed TCP with a versioned auth handshake —
+spawned workers self-register against the router's ``WorkerRegistry``
+listener (:func:`worker_main_socket`), or the router dials workers
+already serving on OTHER hosts (``REPLICA_WORKERS`` →
+:func:`worker_serve`). Every (re)registration is a fresh **incarnation
+epoch** stamped into frame headers; the dispatcher drops stale-epoch
+frames, so a worker that vanished behind a partition and later
+reconnects can never resurrect dead tickets or double-deliver stream
+chunks. Death detection generalizes to a transport-liveness contract —
+status-frame staleness past ``partition_timeout_s``, a broken ping
+write, EOF — feeding the same quarantine machinery; recovery prefers
+**heal** (the live worker re-registers, keeping its warm engine) over
+respawn, and duck-types to redial-with-backoff for remote workers the
+router cannot spawn.
+
 Deliberate semantic deltas from thread mode, all documented here:
 
 * **stream cancellation propagates at chunk granularity** — closing the
@@ -80,7 +98,6 @@ from __future__ import annotations
 
 import logging
 import os
-import pickle
 import queue as _queue
 import signal
 import threading
@@ -100,6 +117,18 @@ from sentio_tpu.runtime.service import (
     _Ticket,
     finish_ticket_error,
 )
+from sentio_tpu.runtime.transport import (
+    DEFAULT_FRAME_TIMEOUT_S,
+    DEFAULT_MAX_FRAME_BYTES,
+    FrameProtocolError,
+    PipeTransport,
+    SocketTransport,
+    TransportClosed,
+    TransportError,
+    dial,
+    expect_hello,
+    send_hello,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -107,13 +136,21 @@ __all__ = [
     "WorkerSpec",
     "ProcessReplica",
     "worker_main",
+    "worker_main_socket",
+    "worker_serve",
     "default_service_factory",
     "REPLICA_MODE_THREAD",
     "REPLICA_MODE_PROCESS",
+    "REPLICA_MODE_SOCKET",
 ]
 
 REPLICA_MODE_THREAD = "thread"
 REPLICA_MODE_PROCESS = "process"
+# socket transport: same worker protocol over length-prefixed TCP frames
+# (runtime/transport.py) — spawned workers self-register against the
+# router's WorkerRegistry listener; REPLICA_WORKERS=host:port,... makes the
+# router dial advertised workers on OTHER hosts instead of spawning
+REPLICA_MODE_SOCKET = "socket"
 
 # worker → router frame kinds (req_id 0 is reserved for unsolicited frames)
 _F_READY = "ready"
@@ -140,6 +177,31 @@ class WorkerSpec:
     # cadence of unsolicited status frames (the router-side supervisor's
     # probe source); also bounds how stale a liveness read can be
     status_interval_s: float = 0.1
+    # ---- socket transport (REPLICA_MODE=socket / REPLICA_WORKERS) ----
+    # shared secret for the versioned registration handshake; the registry
+    # rejects hellos that fail the constant-time compare
+    auth_token: str = ""
+    # frame bounds: an oversized frame is refused typed on both sides, a
+    # partial frame (or a write the peer stopped draining) past the
+    # timeout drops the connection instead of hanging a reader
+    max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES
+    frame_timeout_s: float = DEFAULT_FRAME_TIMEOUT_S
+    # worker-side re-registration: when the router link dies (EOF, broken
+    # write, or router silence past router_silence_timeout_s), redial the
+    # registry with exponential backoff — the reconnection is a FRESH
+    # incarnation (higher epoch); reconnect_deadline_s of continuous dial
+    # failure means the router is gone for good and the worker exits
+    # rather than orphan itself
+    reconnect: bool = False
+    reconnect_backoff_s: float = 0.5
+    reconnect_max_backoff_s: float = 5.0
+    reconnect_deadline_s: float = 60.0
+    # a socket worker that has heard NOTHING from the router (requests,
+    # pings, anything) for this long treats the link as partitioned and
+    # redials; 0 disables (pipe mode never needs it — a dead router is a
+    # broken pipe). The router pings at ping_interval_s, so a healthy
+    # idle link never trips this.
+    router_silence_timeout_s: float = 3.0
 
 
 def _resolve_factory(path: str):
@@ -276,26 +338,36 @@ def _decode_exc(data: dict) -> BaseException:
 
 class _WorkerServer:
     """Runs inside the child process: one recv loop dispatching RPC frames
-    to handler threads, a status thread pushing liveness, a send lock
-    (Connection.send is not thread-safe)."""
+    to handler threads, a status thread pushing liveness. Framing and
+    send-side locking live in the transport (runtime/transport.py) — the
+    server is transport-agnostic, so the spawn pipe and a TCP socket serve
+    the identical protocol.
 
-    def __init__(self, conn, spec: WorkerSpec) -> None:
-        self.conn = conn
+    A server instance covers ONE connection (one incarnation). In socket
+    reconnect mode the outer loop (:func:`worker_main_socket`) builds a
+    fresh server per connection, handing the already-built service across
+    so a reconnection is a fresh incarnation of the LINK, not of the
+    engine."""
+
+    def __init__(self, transport, spec: WorkerSpec, svc=None) -> None:
+        self.transport = transport
         self.spec = spec
-        self.svc = None
-        self._send_lock = threading.Lock()
+        self.svc = svc
         self._stop = threading.Event()
+        # why this run() returned: "shutdown" (router asked), "link_lost"
+        # (transport died / router silent), or "fatal" (factory failed)
+        self.outcome = ""
         # stream cancellation flags by req_id (checked between token frames)
         self._cancelled: set[int] = set()
         self._cancel_lock = threading.Lock()
 
     def _send(self, req_id: int, kind: str, payload: Any) -> None:
-        with self._send_lock:
-            try:
-                self.conn.send((req_id, kind, payload))
-            except (BrokenPipeError, OSError):
-                # router gone: nothing to report to; shut down
-                self._stop.set()
+        try:
+            self.transport.send((req_id, kind, payload))
+        except TransportError:
+            # router link gone (EOF, broken write, frame refused): stop
+            # this incarnation; the outer loop decides whether to redial
+            self._stop.set()
 
     # ------------------------------------------------------------- handlers
 
@@ -429,13 +501,19 @@ class _WorkerServer:
 
     # ----------------------------------------------------------------- main
 
-    def run(self) -> None:
-        try:
-            factory = _resolve_factory(self.spec.factory)
-            self.svc = factory(**self.spec.factory_kwargs)
-        except BaseException as exc:  # noqa: BLE001 — report, then die  # lint: allow(baseexception-swallow) — reported as a typed wire frame
-            self._send(0, _F_ERR, _encode_exc(exc))
-            return
+    def run(self) -> str:
+        """Serve this connection until shutdown / link loss. Returns the
+        outcome (also latched on ``self.outcome``); the SERVICE is left
+        open — the caller owns its lifetime (a socket reconnection reuses
+        it across incarnations)."""
+        if self.svc is None:
+            try:
+                factory = _resolve_factory(self.spec.factory)
+                self.svc = factory(**self.spec.factory_kwargs)
+            except BaseException as exc:  # noqa: BLE001 — report, then die  # lint: allow(baseexception-swallow) — reported as a typed wire frame
+                self._send(0, _F_ERR, _encode_exc(exc))
+                self.outcome = "fatal"
+                return self.outcome
         eng = self.svc.engine
         self._send(0, _F_READY, {
             "pid": os.getpid(),
@@ -450,17 +528,44 @@ class _WorkerServer:
         status = threading.Thread(target=self._status_loop,
                                   name="worker-status", daemon=True)
         status.start()
+        # router-silence watch (socket links only): a half-open partition
+        # can leave this side's reads idle forever while its writes still
+        # land — no error will ever arrive, so silence IS the signal
+        silence_s = (self.spec.router_silence_timeout_s
+                     if isinstance(self.transport, SocketTransport) else 0.0)
+        poll_s = 0.25 if silence_s > 0 else None
+        last_rx = time.perf_counter()
+        self.outcome = "link_lost"
         while not self._stop.is_set():
             try:
-                frame = self.conn.recv()
-            except (EOFError, OSError):
-                break  # router died or closed: shut down with it
-            except pickle.UnpicklingError:
-                logger.exception("worker dropped an undecodable frame")
+                got = self.transport.recv(timeout_s=poll_s)
+            except FrameProtocolError:
+                if isinstance(self.transport, PipeTransport):
+                    # a pipe preserves message boundaries: one undecodable
+                    # frame does not poison the next (pre-transport parity)
+                    logger.exception("worker dropped an undecodable frame")
+                    continue
+                logger.exception("worker dropped the connection on a "
+                                 "protocol error")
+                break
+            except TransportError:
+                break  # router died or closed: this incarnation is over
+            if got is None:
+                if (silence_s > 0
+                        and time.perf_counter() - last_rx > silence_s):
+                    logger.warning(
+                        "router silent for %.1fs; treating the link as "
+                        "partitioned", time.perf_counter() - last_rx)
+                    break
                 continue
+            frame, _epoch = got
+            last_rx = time.perf_counter()
             req_id, method, kwargs = frame
             if method == "__shutdown__":
+                self.outcome = "shutdown"
                 break
+            if method == "__ping__":
+                continue  # router liveness probe: receiving it IS the point
             if method == "stream_cancel":
                 with self._cancel_lock:
                     self._cancelled.add(int(kwargs["stream_id"]))
@@ -470,10 +575,7 @@ class _WorkerServer:
                 name=f"worker-rpc-{req_id}", daemon=True,
             ).start()
         self._stop.set()
-        try:
-            self.svc.close()
-        except Exception:  # noqa: BLE001 — exiting anyway
-            logger.exception("worker service close failed")
+        return self.outcome
 
 
 def worker_main(conn, spec: WorkerSpec) -> None:
@@ -483,12 +585,154 @@ def worker_main(conn, spec: WorkerSpec) -> None:
     # reaches the recv loop; SIGTERM from terminate() gets a fast exit
     signal.signal(signal.SIGTERM, lambda *_: os._exit(0))
     logging.basicConfig(level=logging.WARNING)
-    _WorkerServer(conn, spec).run()
+    server = _WorkerServer(PipeTransport(conn), spec)
+    server.run()
+    if server.svc is not None:
+        try:
+            server.svc.close()
+        except Exception:  # noqa: BLE001 — exiting anyway
+            logger.exception("worker service close failed")
     # skip interpreter/static teardown: daemon threads (pump, RPC
     # handlers) may still sit inside XLA, and C++ static destructors
     # running under them abort with "terminate called without an active
     # exception" — the service already closed, nothing left to flush
     os._exit(0)
+
+
+def worker_main_socket(addr, spec: WorkerSpec, slot: int) -> None:
+    """Child-process entry point for SOCKET workers spawned by
+    :class:`ProcessReplica` (``REPLICA_MODE=socket``): dial the router's
+    registry listener, register (versioned auth handshake → incarnation
+    epoch), serve the connection — and, with ``spec.reconnect``, REDIAL
+    with exponential backoff whenever the link dies. Each reconnection is
+    a fresh incarnation (higher epoch): the engine+service survive, the
+    link identity does not — everything sent before the reconnect is
+    fenced router-side as stale. A worker that cannot reach the router
+    for ``spec.reconnect_deadline_s`` straight exits rather than orphan
+    itself."""
+    signal.signal(signal.SIGTERM, lambda *_: os._exit(0))
+    logging.basicConfig(level=logging.WARNING)
+    svc = None
+    backoff = max(spec.reconnect_backoff_s, 0.05)
+    give_up_at = None
+    while True:
+        try:
+            transport = dial(
+                addr, max_frame_bytes=spec.max_frame_bytes,
+                frame_timeout_s=spec.frame_timeout_s, fault_scope="worker",
+            )
+            send_hello(transport, spec.auth_token, slot, os.getpid())
+        except FrameProtocolError as exc:
+            # definitive rejection (token/version drift): redialing burns
+            # the reconnect deadline on a config error — die loudly; the
+            # supervisor's respawn carries the current spec
+            logger.error("worker registration rejected: %s", exc)
+            break
+        except TransportError as exc:
+            now = time.perf_counter()
+            if give_up_at is None:
+                give_up_at = now + max(spec.reconnect_deadline_s, 1.0)
+            if svc is None and not spec.reconnect:
+                # never connected and no reconnect policy: die loudly; the
+                # router's registration wait surfaces the typed timeout
+                logger.error("worker registration failed: %s", exc)
+                break
+            if now >= give_up_at:
+                logger.error("router unreachable for %.0fs; worker exiting",
+                             spec.reconnect_deadline_s)
+                break
+            time.sleep(backoff)
+            backoff = min(backoff * 2.0, spec.reconnect_max_backoff_s)
+            continue
+        give_up_at = None
+        backoff = max(spec.reconnect_backoff_s, 0.05)
+        server = _WorkerServer(transport, spec, svc=svc)
+        outcome = server.run()
+        svc = server.svc
+        transport.close()
+        if outcome in ("shutdown", "fatal") or not spec.reconnect:
+            break
+        logger.warning("worker slot %d lost its router link; redialing",
+                       slot)
+    if svc is not None:
+        try:
+            svc.close()
+        except Exception:  # noqa: BLE001 — exiting anyway
+            logger.exception("worker service close failed")
+    os._exit(0)
+
+
+def worker_serve(
+    bind_host: str,
+    bind_port: int,
+    spec: WorkerSpec,
+    stop_event: Optional[threading.Event] = None,
+    bound_cb=None,
+) -> None:
+    """Advertised-worker entry (``REPLICA_WORKERS=host:port,...``): listen
+    on ``bind_host:bind_port`` and serve one ROUTER connection at a time.
+    The router dials in, authenticates (its hello carries the incarnation
+    epoch its registry assigned), and drives the same RPC protocol; when
+    the connection dies the worker goes back to accepting — the service
+    (engine, radix cache) survives across router reconnects. A router
+    ``__shutdown__`` closes the CONNECTION only: an advertised worker
+    belongs to its operator, not to whichever router last dialed it.
+    ``bound_cb`` (tests) receives the bound ``(host, port)``."""
+    import socket as _socket
+
+    stop = stop_event or threading.Event()
+    listener = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    listener.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+    listener.settimeout(0.2)
+    listener.bind((bind_host, int(bind_port)))
+    listener.listen(4)
+    if bound_cb is not None:
+        bound_cb(listener.getsockname())
+    svc = None
+    try:
+        while not stop.is_set():
+            try:
+                conn, _peer = listener.accept()
+            except _socket.timeout:
+                continue
+            except OSError:
+                break
+            transport = SocketTransport(
+                conn, max_frame_bytes=spec.max_frame_bytes,
+                frame_timeout_s=spec.frame_timeout_s, fault_scope="worker",
+            )
+            try:
+                hello = expect_hello(transport, spec.auth_token,
+                                     timeout_s=10.0)
+                epoch = int(hello.get("epoch", 0))
+                transport.epoch = epoch
+                transport.send((0, "hello_ack",
+                                {"epoch": epoch, "pid": os.getpid()}))
+            except TransportError as exc:
+                logger.warning("rejected router connection: %s", exc)
+                transport.close()
+                continue
+            except Exception:  # noqa: BLE001 — a hostile hello must not kill the listener
+                logger.exception("router handshake crashed; connection "
+                                 "dropped")
+                transport.close()
+                continue
+            server = _WorkerServer(transport, spec, svc=svc)
+            outcome = server.run()
+            svc = server.svc
+            transport.close()
+            if outcome == "fatal":
+                break
+    finally:
+        try:
+            listener.close()
+        except OSError:
+            pass
+        if svc is not None:
+            try:
+                svc.close()
+            except Exception:  # noqa: BLE001 — shutting down anyway
+                logger.exception("worker service close failed")
 
 
 # --------------------------------------------------------------------------
@@ -545,27 +789,37 @@ class ProcessReplica:
         tokenizer,
         replica_id: int = 0,
         build_timeout_s: float = 600.0,
+        transport_mode: str = REPLICA_MODE_PROCESS,
+        registry=None,
+        connect_addr: Optional[tuple] = None,
+        partition_timeout_s: float = 2.0,
+        ping_interval_s: float = 0.5,
+        heal_grace_s: float = 5.0,
+        _adopt_state: Optional[dict] = None,
     ) -> None:
-        import multiprocessing
-
         self.spec = spec
         self.replica_id = replica_id
         self.build_timeout_s = build_timeout_s
         self._tokenizer = tokenizer
-        # JAX is not fork-safe (see module docstring): the worker MUST come
-        # up via spawn so its runtime initializes in a clean interpreter
-        self._ctx = multiprocessing.get_context("spawn")
-        self._conn, child_conn = self._ctx.Pipe()
-        self._proc = self._ctx.Process(  # lint: allow(no-fork) — spawn context
-            target=worker_main, args=(child_conn, spec),
-            name=f"sentio-replica-worker-{replica_id}", daemon=True,
-        )
+        # transport tier: "process" = spawn pipe (single host, PR 13
+        # behavior, the default); "socket" = TCP frames — either a locally
+        # spawned worker self-registering against the router's
+        # WorkerRegistry listener, or (connect_addr set) an advertised
+        # worker on ANOTHER host the router dials (REPLICA_WORKERS)
+        self._transport_mode = (REPLICA_MODE_SOCKET
+                                if transport_mode == REPLICA_MODE_SOCKET
+                                else REPLICA_MODE_PROCESS)
+        self._registry = registry
+        self._connect_addr = connect_addr
+        self.partition_timeout_s = max(float(partition_timeout_s), 0.0)
+        self.ping_interval_s = max(float(ping_interval_s), 0.0)
+        self.heal_grace_s = max(float(heal_grace_s), 0.0)
+        if (self._transport_mode == REPLICA_MODE_SOCKET
+                and registry is None):
+            raise ValueError(
+                "socket transport needs a WorkerRegistry (it owns the "
+                "incarnation epochs and the stale-frame fence)")
         self._mutex = threading.Lock()
-        # Connection.send is not thread-safe (a >16KB frame goes out as
-        # separate header+body writes, and partial writes loop): concurrent
-        # router threads would interleave bytes and desync the pipe, making
-        # a healthy worker look dead. Mirrors the worker-side _send_lock.
-        self._send_lock = threading.Lock()
         self._calls: dict[int, _PendingCall] = {}  # guarded-by: _mutex
         self._next_id = 1  # guarded-by: _mutex
         # router-side ticket shadow (module docstring): every unanswered
@@ -581,12 +835,64 @@ class ProcessReplica:
         self._adopted: dict[int, dict] = {}  # guarded-by: _mutex
         self._dead = False  # guarded-by: _mutex
         self._death_reason = ""  # guarded-by: _mutex
+        self._death_kind = ""  # guarded-by: _mutex
         self._closed = False  # guarded-by: _mutex
         self._status: dict = {}
         self._status_ts = 0.0
         self._last_stats: dict = {}
-        self._proc.start()
-        child_conn.close()  # the parent's copy; the worker holds its own
+        self.epoch = 0  # incarnation epoch of THIS connection (socket)
+        self._proc = None
+        self._transport = None
+        if _adopt_state is not None:
+            # HEAL path (respawn after a partition): a live worker
+            # re-registered — adopt the fresh connection + epoch, keep the
+            # existing process
+            self._proc = _adopt_state.get("proc")
+            self._transport = _adopt_state["transport"]
+            self.epoch = _adopt_state["epoch"]
+        elif self._transport_mode == REPLICA_MODE_PROCESS:
+            import multiprocessing
+
+            # JAX is not fork-safe (see module docstring): the worker MUST
+            # come up via spawn so its runtime initializes in a clean
+            # interpreter
+            ctx = multiprocessing.get_context("spawn")
+            conn, child_conn = ctx.Pipe()
+            self._proc = ctx.Process(  # lint: allow(no-fork) — spawn context
+                target=worker_main, args=(child_conn, spec),
+                name=f"sentio-replica-worker-{replica_id}", daemon=True,
+            )
+            self._proc.start()
+            child_conn.close()  # the parent's copy; the worker holds its own
+            self._transport = PipeTransport(conn)
+        elif connect_addr is not None:
+            # REPLICA_WORKERS dial-out: the worker runs on another host
+            # behind worker_serve(); the router owns the epoch counter and
+            # ships it in its hello. Dial failures retry with backoff up
+            # to the build timeout — re-registration IS redialing here.
+            self._transport, self.epoch = self._dial_advertised(
+                build_timeout_s)
+        else:
+            # local socket spawn: the worker connects BACK to the
+            # registry's listener and registers; frames then carry the
+            # granted epoch
+            import multiprocessing
+
+            ctx = multiprocessing.get_context("spawn")
+            self._proc = ctx.Process(  # lint: allow(no-fork) — spawn context
+                target=worker_main_socket,
+                args=(tuple(registry.address), spec, replica_id),
+                name=f"sentio-replica-worker-{replica_id}", daemon=True,
+            )
+            self._proc.start()
+            try:
+                (self._transport, _hello,
+                 self.epoch) = registry.await_registration(
+                    replica_id, build_timeout_s)
+            except BaseException:
+                # the spawned child must not outlive a failed construction
+                self._reap(join_timeout_s=5.0)
+                raise
         # the handshake call is registered BEFORE the dispatcher starts: a
         # factory that fails instantly would otherwise race its err frame
         # past an unregistered req_id 0 and the build would time out instead
@@ -606,6 +912,80 @@ class ProcessReplica:
         self.default_deadline_s = ready["default_deadline_s"]
         self.retry_budget = ready["retry_budget"]
         self.tick_stall_budget_s = ready["tick_stall_budget_s"]
+        if self._transport_mode == REPLICA_MODE_SOCKET:
+            # socket liveness, send side: periodic pings keep the worker's
+            # router-silence watch fed, and a ping whose write breaks is
+            # the broken-write death signal no status frame can deliver.
+            # Stamp the handshake as the first "status" so the partition
+            # detector has a baseline before the first status frame lands.
+            self._status_ts = time.perf_counter()
+            if self.ping_interval_s > 0:
+                threading.Thread(
+                    target=self._ping_loop,
+                    name=f"replica-worker-ping-{replica_id}", daemon=True,
+                ).start()
+
+    def _dial_advertised(self, build_timeout_s: float):
+        """Dial a REPLICA_WORKERS-advertised worker with backoff; the
+        registry assigns the incarnation epoch the hello carries."""
+        deadline = time.perf_counter() + max(build_timeout_s, 1.0)
+        backoff = 0.25
+        last: Optional[Exception] = None
+        while time.perf_counter() < deadline:
+            transport = None
+            try:
+                transport = dial(
+                    self._connect_addr,
+                    max_frame_bytes=self.spec.max_frame_bytes,
+                    frame_timeout_s=self.spec.frame_timeout_s,
+                    fault_scope=f"r{self.replica_id}",
+                )
+                epoch = self._registry.assign_epoch(self.replica_id)
+                send_hello(transport, self.spec.auth_token, self.replica_id,
+                           os.getpid(), epoch=epoch)
+                return transport, epoch
+            except FrameProtocolError as exc:
+                # a DEFINITIVE rejection (bad token, version mismatch):
+                # redialing cannot fix configuration — fail fast so the
+                # operator sees the real error instead of a 10-minute
+                # build timeout
+                if transport is not None:
+                    transport.close()
+                raise ReplicaUnavailable(
+                    f"advertised worker {self._connect_addr} rejected the "
+                    f"handshake: {exc}",
+                    retryable=False,
+                    details={"replica": self.replica_id,
+                             "reason": "handshake_rejected"},
+                ) from exc
+            except TransportError as exc:
+                last = exc
+                if transport is not None:
+                    transport.close()
+                time.sleep(min(backoff,
+                               max(deadline - time.perf_counter(), 0.0)))
+                backoff = min(backoff * 2.0, 5.0)
+        raise ReplicaUnavailable(
+            f"advertised worker {self._connect_addr} unreachable within "
+            f"{build_timeout_s:.0f}s: {last}",
+            retry_after_s=2.0,
+            details={"replica": self.replica_id, "reason": "dial_failed"},
+        )
+
+    def _ping_loop(self) -> None:
+        while True:
+            time.sleep(self.ping_interval_s)
+            with self._mutex:
+                if self._dead or self._closed:
+                    return
+            try:
+                self._send_frame((0, "__ping__", {}))
+            except (TransportError, OSError):
+                self._on_death(
+                    "worker link broken on ping (broken write)",
+                    kind="partition",
+                )
+                return
 
     # ------------------------------------------------------------- plumbing
 
@@ -630,12 +1010,31 @@ class ProcessReplica:
         return payload
 
     def _dispatch_loop(self) -> None:
+        transport = self._transport
         while True:
             try:
-                frame = self._conn.recv()
-            except (EOFError, OSError, pickle.UnpicklingError):
-                self._on_death("worker connection lost")
+                got = transport.recv()
+            except TransportError as exc:
+                # the dispatcher owns the read side: when it exits, the
+                # connection is spent — close it so a dead incarnation
+                # never parks an open fd (the partition-heal window keeps
+                # the transport open precisely BECAUSE this loop is still
+                # draining it; once it errors out, the drain is over)
+                transport.close()
+                self._on_death(f"worker connection lost: {exc}")
                 return
+            frame, epoch = got
+            if (self._registry is not None
+                    and epoch != self._registry.current_epoch(
+                        self.replica_id)):
+                # incarnation fence: this frame was sent by a PREVIOUS
+                # incarnation of the slot's worker (e.g. buffered behind a
+                # partition that later healed). Its tickets are already
+                # terminal router-side — delivering it could resurrect a
+                # dead ticket or double-deliver a stream chunk, so it is
+                # dropped and counted instead.
+                self._registry.note_stale_frame(self.replica_id)
+                continue
             req_id, kind, payload = frame
             if kind == _F_STATUS:
                 # plain attribute writes: GIL-atomic snapshot for probes
@@ -672,18 +1071,23 @@ class ProcessReplica:
                 call.q.put((kind, payload))
 
     def _on_death(self, reason: str, *, process_death: bool = True,
-                  keep_shadow: Optional[bool] = None) -> None:
+                  keep_shadow: Optional[bool] = None,
+                  kind: str = "") -> None:
         """Latch dead and wake every waiter. Shadowed tickets are the
         exception: with handoff enabled (and the replica not closing),
         they are KEPT for the supervisor's quarantine pass to extract and
         re-admit on survivors — their callers stay blocked on the pending
         queue until the handoff sentinel arrives. ``keep_shadow=False``
-        (abandon, close) fails the remainder typed instead."""
+        (abandon, close) fails the remainder typed instead.
+        ``kind="partition"`` marks a LINK death of a possibly-live worker:
+        the rebuild path then waits for re-registration (heal) before
+        reaching for the reap-and-respawn hammer."""
         with self._mutex:
             if self._dead:
                 return
             self._dead = True
             self._death_reason = reason
+            self._death_kind = kind
             keep = (self._handoff_enabled and not self._closed
                     if keep_shadow is None else keep_shadow)
             shadow_entries: list[tuple[_Ticket, _PendingCall]] = []
@@ -740,8 +1144,7 @@ class ProcessReplica:
         )
 
     def _send_frame(self, frame: tuple) -> None:
-        with self._send_lock:
-            self._conn.send(frame)
+        self._transport.send(frame)
 
     def _call(self, method: str, kwargs: dict,
               timeout_s: Optional[float],
@@ -773,7 +1176,7 @@ class ProcessReplica:
         t0 = time.perf_counter()
         try:
             self._send_frame((req_id, method, kwargs))
-        except (BrokenPipeError, OSError):
+        except (TransportClosed, BrokenPipeError, OSError):
             self._on_death("worker pipe broken on send")
             if not shadowed:
                 with self._mutex:
@@ -960,7 +1363,7 @@ class ProcessReplica:
                 shadowed = True
         try:
             self._send_frame((req_id, "stream_open", req))
-        except (BrokenPipeError, OSError):
+        except (TransportClosed, BrokenPipeError, OSError):
             self._on_death("worker pipe broken on send")
             if not shadowed:
                 with self._mutex:
@@ -1044,7 +1447,7 @@ class ProcessReplica:
                 try:
                     self._send_frame((0, "stream_cancel",
                                       {"stream_id": req_id}))
-                except (BrokenPipeError, OSError):
+                except (TransportClosed, BrokenPipeError, OSError):
                     pass
 
     def _drain_adopted_stream(self, ticket: _Ticket, wait: float,
@@ -1190,6 +1593,22 @@ class ProcessReplica:
         if self._proc is not None and not self._proc.is_alive():
             self._on_death(f"worker exited (code {self._proc.exitcode})")
             return True
+        if (self._transport_mode == REPLICA_MODE_SOCKET
+                and self.partition_timeout_s > 0 and self._status_ts > 0):
+            # transport-liveness leg the pipe never needed: a half-open
+            # partition delivers no EOF and no broken write on THIS side —
+            # the only observable is the worker's status stream going
+            # silent. Staleness past the budget latches the same typed
+            # death the supervisor's quarantine machinery already handles;
+            # the (possibly live) worker rejoins as a fresh incarnation.
+            stale = time.perf_counter() - self._status_ts
+            if stale > self.partition_timeout_s:
+                self._on_death(
+                    f"partition suspected: no worker frames for "
+                    f"{stale:.1f}s (budget {self.partition_timeout_s:.1f}s)",
+                    process_death=False, kind="partition",
+                )
+                return True
         return bool(self._status.get("broken"))
 
     @property
@@ -1209,14 +1628,37 @@ class ProcessReplica:
 
     @property
     def pid(self) -> Optional[int]:
-        return self._proc.pid if self._proc is not None else None
+        if self._proc is not None:
+            return self._proc.pid
+        # dialed remote worker: no local process handle — the worker
+        # reported its pid in the handshake/status stream
+        return self._status.get("pid")
+
+    def _proc_alive(self) -> bool:
+        """Best liveness guess for the WORKER (not the link): a local
+        process handle answers exactly; a dialed remote worker is presumed
+        alive until its link death says otherwise."""
+        if self._proc is not None:
+            return self._proc.is_alive()
+        with self._mutex:
+            return not self._dead
+
+    def _transport_stats(self) -> dict:
+        if self._transport_mode != REPLICA_MODE_SOCKET:
+            return {}
+        out = {"transport": "socket", "incarnation": self.epoch}
+        if self._registry is not None:
+            out["stale_frames"] = self._registry.stale_frames(
+                self.replica_id)
+        return out
 
     def stats(self) -> dict:
         try:
             self._last_stats = self._call("stats", {}, timeout_s=10.0)
         except Exception:  # noqa: BLE001 — dead replica: last known stats
-            return {**self._last_stats, "replica": self.replica_id,
-                    "worker_dead": 1}
+            return {**self._last_stats, **self._transport_stats(),
+                    "replica": self.replica_id, "worker_dead": 1}
+        self._last_stats.update(self._transport_stats())
         return self._last_stats
 
     # ------------------------------------------------ quarantine / handoff
@@ -1291,7 +1733,7 @@ class ProcessReplica:
         # unanswered shadowed ticket (a dead worker cannot say which had
         # dispatched; re-executed generates are idempotent caller-side)
         tickets = self._pop_shadow(ids) if enabled else []
-        alive = self._proc is not None and self._proc.is_alive()
+        alive = self._proc_alive()
         self._on_death(f"abandoned: {reason}", process_death=not alive,
                        keep_shadow=False)
         return tickets
@@ -1308,8 +1750,7 @@ class ProcessReplica:
             dead = self._dead
         if not enabled:
             return []
-        alive = (not dead and self._proc is not None
-                 and self._proc.is_alive())
+        alive = not dead and self._proc_alive()
         ids: Optional[list] = None
         if alive:
             try:
@@ -1357,7 +1798,7 @@ class ProcessReplica:
         try:
             self._send_frame(
                 (req_id, "stream_open" if streaming else "generate", req))
-        except (BrokenPipeError, OSError):
+        except (TransportClosed, BrokenPipeError, OSError):
             with self._mutex:
                 self._adopted.pop(req_id, None)
             self._on_death("worker pipe broken on adopt send")
@@ -1392,7 +1833,7 @@ class ProcessReplica:
                 try:
                     self._send_frame((0, "stream_cancel",
                                       {"stream_id": state["req_id"]}))
-                except (BrokenPipeError, OSError):
+                except (TransportClosed, BrokenPipeError, OSError):
                     pass
             if ticket.stream_q is not None:
                 ticket.stream_q.put(("toks", list(delta)))
@@ -1425,13 +1866,29 @@ class ProcessReplica:
     # ------------------------------------------------------------ lifecycle
 
     def respawn(self) -> "ProcessReplica":
-        """A fresh worker process from the same spec — the supervisor's
-        rebuild path (``ReplicaSet._rebuild`` duck-types this instead of
-        ``engine.spawn_fresh()``)."""
-        fresh = ProcessReplica(
-            self.spec, self._tokenizer, replica_id=self.replica_id,
-            build_timeout_s=self.build_timeout_s,
-        )
+        """A fresh worker incarnation — the supervisor's rebuild path
+        (``ReplicaSet._rebuild`` duck-types this instead of
+        ``engine.spawn_fresh()``). Pipe mode always spawns a fresh
+        process. Socket mode decides:
+
+        * **heal** — the (possibly live, link-partitioned) worker already
+          re-registered, or does so within ``heal_grace_s``: adopt the new
+          connection + epoch and keep the process (its engine, radix
+          cache, and warm compiles survive the partition);
+        * **respawn** — no re-registration in time: reap the old process
+          (SIGTERM→SIGKILL) and spawn a fresh one, which self-registers;
+        * **reconnected** — a dialed ``REPLICA_WORKERS`` worker: the
+          router cannot spawn remotely, so 'respawn' duck-types to
+          redialing with backoff (re-registration from the router's
+          side); a still-unreachable worker surfaces the typed error and
+          rides the supervisor's existing rebuild backoff."""
+        if self._transport_mode == REPLICA_MODE_SOCKET:
+            fresh = self._respawn_socket()
+        else:
+            fresh = ProcessReplica(
+                self.spec, self._tokenizer, replica_id=self.replica_id,
+                build_timeout_s=self.build_timeout_s,
+            )
         with self._mutex:
             enabled = self._handoff_enabled
         if enabled:
@@ -1440,6 +1897,73 @@ class ProcessReplica:
             # replicas it was BUILT with)
             fresh.enable_shadow_handoff()
         return fresh
+
+    def _respawn_socket(self) -> "ProcessReplica":
+        common = dict(
+            replica_id=self.replica_id,
+            build_timeout_s=self.build_timeout_s,
+            transport_mode=REPLICA_MODE_SOCKET,
+            registry=self._registry,
+            partition_timeout_s=self.partition_timeout_s,
+            ping_interval_s=self.ping_interval_s,
+            heal_grace_s=self.heal_grace_s,
+        )
+        if self._connect_addr is not None:
+            if self._transport is not None:
+                self._transport.close()  # the dead link's fd, if still open
+            fresh = ProcessReplica(self.spec, self._tokenizer,
+                                   connect_addr=self._connect_addr, **common)
+            outcome = "reconnected"
+        else:
+            adopt = None
+            if (self._proc is not None and self._proc.is_alive()
+                    and self.heal_grace_s > 0):
+                try:
+                    transport, _hello, epoch = (
+                        self._registry.await_registration(
+                            self.replica_id, self.heal_grace_s))
+                    adopt = {"proc": self._proc, "transport": transport,
+                             "epoch": epoch}
+                except ReplicaUnavailable:
+                    adopt = None
+            if adopt is not None:
+                fresh = ProcessReplica(self.spec, self._tokenizer,
+                                       _adopt_state=adopt, **common)
+                outcome = "heal"
+                logger.info(
+                    "replica %d healed: worker pid %s re-registered at "
+                    "epoch %d", self.replica_id, fresh.pid, fresh.epoch)
+            else:
+                # the heal never came: the old link is spent for good —
+                # close it (a dispatcher wedged in a silent recv would
+                # otherwise park the fd forever) and reap the process
+                if self._transport is not None:
+                    self._transport.close()
+                self._reap(join_timeout_s=5.0)
+                fresh = ProcessReplica(self.spec, self._tokenizer, **common)
+                outcome = "respawn"
+        try:
+            from sentio_tpu.infra.metrics import get_metrics
+
+            get_metrics().record_worker_reconnect(outcome)
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            pass
+        return fresh
+
+    def _reap(self, join_timeout_s: float = 5.0) -> None:
+        """Make sure the local worker process is gone: join a corpse,
+        SIGTERM→SIGKILL a survivor. No-op for dialed remote workers."""
+        proc = self._proc
+        if proc is None:
+            return
+        if proc.is_alive():
+            proc.terminate()
+            proc.join(timeout=max(join_timeout_s, 0.5))
+        if proc.is_alive():
+            proc.kill()
+            proc.join(timeout=max(join_timeout_s, 0.5))
+        if not proc.is_alive():
+            proc.join(timeout=0.1)  # reap the zombie entry
 
     def kill(self) -> None:
         """SIGKILL the worker — the chaos drill's real replica death. The
@@ -1464,42 +1988,68 @@ class ProcessReplica:
         except Exception:  # noqa: BLE001 — the worker may already be dead
             pass
 
+    def _heal_candidate(self) -> bool:
+        """True when the right rebuild move is to AWAIT this live,
+        link-partitioned worker's re-registration instead of reaping it:
+        socket-spawned, reconnect-armed, died of a partition, and the
+        process is demonstrably still alive."""
+        with self._mutex:
+            dead, kind = self._dead, self._death_kind
+        return (self._transport_mode == REPLICA_MODE_SOCKET
+                and self._connect_addr is None
+                and self.spec.reconnect
+                and dead and kind == "partition"
+                and self._proc is not None and self._proc.is_alive())
+
     def drain(self, deadline_s: float = 30.0) -> dict:
         """Worker-side graceful drain, then local close. A dead worker
-        drains vacuously (its backlog died with it)."""
+        drains vacuously (its backlog died with it). A PARTITIONED worker
+        that may heal is special: no shutdown frame (the half-open link
+        may still deliver it and kill a worker about to re-register), no
+        reap, transport left open so the dispatcher can drain — and
+        stale-count — the pre-partition frames when the link unwedges."""
+        heal = self._heal_candidate()
         result = {"drained": False, "abandoned": 0}
-        try:
-            result = self._call("drain", {"deadline_s": deadline_s},
-                                timeout_s=deadline_s + 30.0)
-        except Exception:  # noqa: BLE001 — dead worker: nothing to drain
-            pass
-        self.close(join_timeout_s=max(deadline_s, 1.0))
+        if not heal:
+            try:
+                result = self._call("drain", {"deadline_s": deadline_s},
+                                    timeout_s=deadline_s + 30.0)
+            except Exception:  # noqa: BLE001 — dead worker: nothing to drain
+                pass
+        self.close(join_timeout_s=max(deadline_s, 1.0), reap=not heal)
         return result
 
-    def close(self, join_timeout_s: float = 10.0) -> None:
+    def close(self, join_timeout_s: float = 10.0, reap: bool = True) -> None:
         """Shut the worker down and REAP it: graceful shutdown frame, then
         SIGTERM, then SIGKILL — close() never returns with the child still
-        runnable, so a closed set cannot leak orphan processes."""
+        runnable, so a closed set cannot leak orphan processes. (Dialed
+        remote workers have no local process: their shutdown frame closes
+        the CONNECTION; ``worker_serve`` keeps the worker alive for its
+        operator.)
+
+        ``reap=False`` is the rebuild path's partition-heal window: the
+        worker process stays alive to re-register, and the old transport
+        stays open so buffered pre-partition frames drain into the stale-
+        frame fence instead of vanishing. ``respawn()`` reaps if the heal
+        never comes; a later full ``close()`` reaps regardless."""
         with self._mutex:
             self._closed = True
         proc = self._proc
-        if proc is None:
-            return
-        try:
-            self._send_frame((0, "__shutdown__", {}))
-        except (BrokenPipeError, OSError):
-            pass
-        proc.join(timeout=max(join_timeout_s, 0.5))
-        if proc.is_alive():
-            proc.terminate()
-            proc.join(timeout=5.0)
-        if proc.is_alive():
-            proc.kill()
-            proc.join(timeout=5.0)
-        try:
-            self._conn.close()
-        except OSError:
-            pass
+        if reap:
+            try:
+                self._send_frame((0, "__shutdown__", {}))
+            except (TransportClosed, BrokenPipeError, OSError):
+                pass
+            if proc is not None:
+                proc.join(timeout=max(join_timeout_s, 0.5))
+                if proc.is_alive():
+                    proc.terminate()
+                    proc.join(timeout=5.0)
+                if proc.is_alive():
+                    proc.kill()
+                    proc.join(timeout=5.0)
+            if self._transport is not None:
+                self._transport.close()
         self._on_death("closed", keep_shadow=False)
         # a death that latched EARLIER kept the shadow for a handoff that
         # never came — a closed replica can never hand off, so fail the

@@ -319,6 +319,19 @@ def _resolve_deadline_ts(request: web.Request, req, serve_cfg) -> Optional[float
     return time.perf_counter() + deadline_ms / 1e3
 
 
+def _resolve_resumable(request: web.Request, req) -> bool:
+    """Per-request stream-resumption opt-out: body ``resumable`` beats the
+    ``X-Resumable`` header beats the server default (resume). Only the
+    explicit falsy header values opt out — proxies inject headers the
+    caller never wrote, so anything unrecognized means default."""
+    if req.resumable is not None:
+        return bool(req.resumable)
+    raw = request.headers.get("X-Resumable", "").strip().lower()
+    if raw in ("0", "false", "no", "off"):
+        return False
+    return True
+
+
 _TENANT_RE = None
 
 
@@ -381,7 +394,8 @@ async def chat(request: web.Request) -> web.Response:
                 # pre-blocking here would 500 a servable stream
                 logger.debug("stream admission pre-check skipped", exc_info=True)
         return await _chat_stream(request, container, req, deadline_ts,
-                                  tenant=tenant, priority=priority)
+                                  tenant=tenant, priority=priority,
+                                  resumable=_resolve_resumable(request, req))
     result = await container.chat_handler.process_chat_request(
         question=req.question,
         top_k=req.top_k,
@@ -398,7 +412,8 @@ async def chat(request: web.Request) -> web.Response:
 async def _chat_stream(request: web.Request, container: DependencyContainer, req,
                        deadline_ts: Optional[float] = None,
                        tenant: Optional[str] = None,
-                       priority: Optional[str] = None) -> web.StreamResponse:
+                       priority: Optional[str] = None,
+                       resumable: bool = True) -> web.StreamResponse:
     """SSE token streaming (reference generator.py:298-333 / openai SSE).
     Retrieval + selection run first (blocking stage on a thread), then the
     generator's token iterator is pumped from a worker thread into the
@@ -475,6 +490,7 @@ async def _chat_stream(request: web.Request, container: DependencyContainer, req
             deadline_ts=deadline_ts,
             tenant=tenant,
             priority=priority,
+            resumable=resumable,
         ):
             if not put((kind, payload)):
                 return

@@ -257,23 +257,25 @@ class DependencyContainer:
 
             n_replicas = max(serve.replicas, 1)
             replica_mode = serve.replica_mode
-            if replica_mode not in ("thread", "process"):
+            if replica_mode not in ("thread", "process", "socket"):
                 # a typo must not SILENTLY degrade to the GIL-bound thread
                 # tier while the operator believes they have OS-level
                 # failure domains
                 logger.warning(
-                    "REPLICA_MODE=%r unknown (expected thread|process); "
-                    "using thread mode", replica_mode,
+                    "REPLICA_MODE=%r unknown (expected "
+                    "thread|process|socket); using thread mode",
+                    replica_mode,
                 )
                 replica_mode = "thread"
-            if replica_mode == "process" and self.mesh is not None:
+            if replica_mode in ("process", "socket") and self.mesh is not None:
                 # per-process replicas over dp-axis mesh slices need
                 # coordinated multi-process device init — the remaining
                 # ROADMAP item 1 leg. Fall back rather than half-work.
                 logger.warning(
-                    "REPLICA_MODE=process ignored: a device mesh is "
-                    "configured (MESH_* > 1) and multi-host process "
-                    "replicas are not wired yet; using thread mode"
+                    "REPLICA_MODE=%s ignored: a device mesh is "
+                    "configured (MESH_* > 1) and mesh-sliced worker "
+                    "replicas are not wired yet; using thread mode",
+                    replica_mode,
                 )
                 replica_mode = "thread"
 
@@ -294,7 +296,7 @@ class DependencyContainer:
                         "and paged speculation requires whole-prompt "
                         "admission (the draft prefills full prompts)"
                     )
-                elif replica_mode == "process":
+                elif replica_mode in ("process", "socket"):
                     # workers load the draft themselves (mmap-shared, via
                     # WorkerSpec below) — loading a private router-process
                     # copy here would defeat the one-copy-per-host goal
@@ -346,15 +348,22 @@ class DependencyContainer:
                     "retrieve", instruction=prompts.load("profile")
                 ) or ""
 
-            if replica_mode == "process":
-                # process-mode replica tier (runtime/worker.py): each
-                # replica is a spawned worker process owning its private
-                # engine+service+pump; the router keeps only a thin RPC
-                # shim per replica. Weights are NOT shipped through the
-                # spawn pipe — each worker loads the checkpoint itself,
-                # memory-mapped, so N workers share one page-cache copy
-                # per host (or re-derives the identical seeded random
-                # init in the no-checkpoint dev mode).
+            if replica_mode in ("process", "socket"):
+                # worker replica tier (runtime/worker.py): each replica is
+                # a worker process owning its private engine+service+pump;
+                # the router keeps only a thin RPC shim per replica.
+                # Weights are NOT shipped through the transport — each
+                # worker loads the checkpoint itself, memory-mapped, so N
+                # workers share one page-cache copy per host (or re-derive
+                # the identical seeded random init in the no-checkpoint
+                # dev mode). "process" runs the spawn-pipe transport;
+                # "socket" runs the TCP transport: spawned local workers
+                # self-register against the router's WorkerRegistry
+                # listener, or — with REPLICA_WORKERS=host:port,... — the
+                # router dials workers already serving on OTHER hosts
+                # (started there via runtime.worker.worker_serve) and the
+                # supervisor's rebuild duck-types to re-dial/await
+                # re-registration with backoff.
                 import dataclasses as _dc
 
                 from sentio_tpu.runtime.worker import (
@@ -390,6 +399,34 @@ class DependencyContainer:
                     # the prefill_chunk incompatibility warning above
                     # applies identically
                     draft_path = cfg.draft_checkpoint_path
+                registry = None
+                worker_addrs: list = []
+                auth_token = ""
+                if replica_mode == "socket":
+                    import secrets as _secrets
+
+                    from sentio_tpu.runtime.replica import WorkerRegistry
+
+                    worker_addrs = serve.parsed_replica_workers()
+                    if worker_addrs:
+                        # advertised remote workers: one replica per
+                        # address; both sides must share the explicit token
+                        if not serve.socket_auth_token:
+                            raise ValueError(
+                                "REPLICA_WORKERS needs SOCKET_AUTH_TOKEN "
+                                "set identically on router and workers"
+                            )
+                        n_replicas = len(worker_addrs)
+                    auth_token = (serve.socket_auth_token
+                                  or _secrets.token_hex(16))
+                    registry = WorkerRegistry(
+                        auth_token, slots=n_replicas,
+                        bind_host=serve.socket_bind_host,
+                        bind_port=serve.socket_bind_port,
+                        max_frame_bytes=serve.socket_frame_max_bytes,
+                        frame_timeout_s=serve.socket_frame_timeout_s,
+                    )
+                    self._cache["worker_registry"] = registry
                 services = []
                 try:
                     for i in range(n_replicas):
@@ -409,14 +446,32 @@ class DependencyContainer:
                             service_kwargs={**service_kwargs,
                                             "replica_id": i},
                             warm_prefix_text=warm_head,
-                        ))
+                        ), **({} if replica_mode != "socket" else dict(
+                            auth_token=auth_token,
+                            reconnect=True,
+                            max_frame_bytes=serve.socket_frame_max_bytes,
+                            frame_timeout_s=serve.socket_frame_timeout_s,
+                        )))
+                        transport_kwargs = (
+                            {} if replica_mode != "socket" else dict(
+                                transport_mode="socket",
+                                registry=registry,
+                                connect_addr=(worker_addrs[i]
+                                              if worker_addrs else None),
+                                partition_timeout_s=(
+                                    serve.socket_partition_timeout_s),
+                                heal_grace_s=serve.socket_heal_grace_s,
+                            ))
                         services.append(ProcessReplica(
                             spec, engine.tokenizer, replica_id=i,
+                            **transport_kwargs,
                         ))
                     logger.info(
-                        "process-mode replica tier: %d worker processes "
-                        "(pids %s)", n_replicas,
+                        "%s-mode replica tier: %d workers (pids %s%s)",
+                        replica_mode, n_replicas,
                         [s.pid for s in services],
+                        (f", registry {registry.address}" if registry
+                         else ""),
                     )
                     return ReplicaSet(
                         services,
@@ -465,6 +520,12 @@ class DependencyContainer:
                             s.close(join_timeout_s=5.0)
                         except Exception:  # noqa: BLE001 — reap best-effort
                             pass
+                    if registry is not None:
+                        try:
+                            registry.close()
+                        except Exception:  # noqa: BLE001 — best-effort
+                            pass
+                        self._cache.pop("worker_registry", None)
                     raise
 
             services = []
@@ -678,7 +739,10 @@ class DependencyContainer:
 
     def cleanup(self) -> None:
         with self._lock:
-            for name in ("generation_service", "embedder"):
+            # worker_registry closes AFTER the generation service: the
+            # ReplicaSet's close reaps workers whose re-registrations the
+            # listener may still be fielding
+            for name in ("generation_service", "embedder", "worker_registry"):
                 component = self._cache.get(name)
                 if component is not None and hasattr(component, "close"):
                     try:

@@ -197,6 +197,7 @@ class ChatHandler:
         deadline_ts: Optional[float] = None,
         tenant: Optional[str] = None,
         priority: Optional[str] = None,
+        resumable: bool = True,
     ):
         """Typed-event generator for SSE, with FULL graph-stage parity
         (reference factory.py:191-208 — streaming traverses the same graph):
@@ -253,6 +254,7 @@ class ChatHandler:
                 question, selected, mode=mode, temperature=temperature,
                 request_id=request_id, deadline_ts=deadline_ts,
                 tenant=tenant, priority=priority, stats=gen_stats,
+                resumable=resumable,
             ):
                 chunks.append(piece)
                 yield ("token", piece)

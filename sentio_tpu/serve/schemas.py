@@ -43,6 +43,11 @@ class ChatRequest:
     # header and the serve default fill it when absent) — the decode service
     # sheds/cancels work that cannot finish inside it
     deadline_ms: Optional[float] = None
+    # streaming session continuity opt-out (body field; the X-Resumable
+    # header fills it when absent): false = a replica dying mid-stream
+    # surfaces the typed mid-stream error instead of resuming the
+    # delivered prefix on a survivor. None = server default (resume).
+    resumable: Optional[bool] = None
 
 
 @dataclass
@@ -96,6 +101,11 @@ def parse_chat_request(body: Any, limits: ServeConfig) -> ChatRequest:
         errors.append({"field": "thread_id", "error": "must be a string"})
         thread_id = None
 
+    resumable = body.get("resumable")
+    if resumable is not None and not isinstance(resumable, bool):
+        errors.append({"field": "resumable", "error": "must be a boolean"})
+        resumable = None
+
     deadline_ms = body.get("deadline_ms")
     if deadline_ms is not None:
         if not isinstance(deadline_ms, (int, float)) or isinstance(deadline_ms, bool) or not (
@@ -119,6 +129,7 @@ def parse_chat_request(body: Any, limits: ServeConfig) -> ChatRequest:
         thread_id=thread_id,
         stream=bool(body.get("stream", False)),
         deadline_ms=deadline_ms,
+        resumable=resumable,
     )
 
 

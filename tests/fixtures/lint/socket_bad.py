@@ -1,0 +1,51 @@
+"""Seeded socket-no-timeout violations (tests/test_lint.py pins the exact
+findings): raw sockets and recv loops with no deadline wiring — the
+unbounded network blocking the vetted transport module exists to prevent.
+Line numbers matter to the test; edit with care."""
+
+import socket
+from socket import create_connection
+
+
+def leaky_listener():  # no settimeout anywhere in this scope
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)  # FINDING: bare socket
+    s.bind(("0.0.0.0", 9000))
+    return s
+
+
+def leaky_dial(host):
+    return create_connection((host, 9000))  # FINDING: no timeout=
+
+
+def leaky_reader(sock):
+    chunks = []
+    while True:
+        data = sock.recv(4096)  # FINDING: zero-timeout recv loop
+        if not data:
+            break
+        chunks.append(data)
+    return b"".join(chunks)
+
+
+def wired_listener():
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)  # clean: wired below
+    s.settimeout(0.2)
+    return s
+
+
+def wired_dial(host):
+    return create_connection((host, 9000), timeout=5.0)  # clean: bounded
+
+
+def wired_reader(conn):
+    conn.settimeout(0.5)
+    while True:
+        if not conn.recv(4096):  # clean: scope wires a deadline
+            return
+
+
+def not_a_socket(transport):
+    while True:
+        frame = transport.recv()  # clean: not socket-shaped (transport owns deadlines)
+        if frame is None:
+            return

@@ -63,6 +63,37 @@ def _catch(fn, **kwargs):
         return exc
 
 
+def _build_parallel(build_fn, n=2, timeout_s=360.0):
+    """Construct ``n`` replicas CONCURRENTLY. Each ProcessReplica
+    constructor blocks through a full spawn + jax init + handshake
+    (~10 s on CPU); building the drills' 2-worker sets serially doubles
+    that startup wall time for no isolation benefit."""
+    out: dict = {}
+    errs: dict = {}
+
+    def run(i):
+        try:
+            out[i] = build_fn(i)
+        except BaseException as exc:  # noqa: BLE001 — re-raised below
+            errs[i] = exc
+
+    threads = [threading.Thread(target=run, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout_s)
+    if errs:
+        for built in out.values():  # don't leak the siblings that DID spawn
+            try:
+                built.close()
+            except Exception:  # noqa: BLE001 — already failing
+                pass
+        raise next(iter(errs.values()))
+    assert len(out) == n, "replica construction timed out"
+    return [out[i] for i in range(n)]
+
+
 def _assert_no_pump_threads(timeout_s: float = 15.0):
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
@@ -562,12 +593,13 @@ class TestChaosDrill:
             service_kwargs=dict(retry_budget=1),
         ))
         tok = ByteTokenizer(cfg.vocab_size)
-        p0 = ProcessReplica(spec, tok, replica_id=0, build_timeout_s=300.0)
-        p1 = ProcessReplica(spec, tok, replica_id=1, build_timeout_s=300.0)
-        # pre-compile both workers so the drill's traffic exercises the
-        # failure machinery instead of waiting out XLA compiles
-        p0.generate("drill warm zero", max_new_tokens=2, timeout_s=180)
-        p1.generate("drill warm one", max_new_tokens=2, timeout_s=180)
+        p0, p1 = _build_parallel(lambda i: ProcessReplica(
+            spec, tok, replica_id=i, build_timeout_s=300.0))
+        # pre-compile both workers (concurrently — separate processes) so
+        # the drill's traffic exercises the failure machinery instead of
+        # waiting out XLA compiles
+        _build_parallel(lambda i: [p0, p1][i].generate(
+            f"drill warm {i}", max_new_tokens=2, timeout_s=180))
         rs = ReplicaSet(
             [p0, p1],
             probe_interval_s=0.05, quarantine_backoff_s=0.1,
@@ -774,9 +806,13 @@ class TestChaosDrill:
         result: dict = {}
 
         def call():
+            # long enough (24 ticks at 2 steps/tick) that the drain below
+            # provably starts while this is mid-decode; the old 150-token
+            # budget bought ~40 extra seconds of tiny-model decode without
+            # widening any assertion
             result["r"] = svc.generate(
                 "long generation that must finish during drain",
-                max_new_tokens=150, temperature=0.0, timeout_s=120,
+                max_new_tokens=48, temperature=0.0, timeout_s=120,
             )
 
         t = threading.Thread(target=call)
@@ -926,17 +962,21 @@ class TestResumableStreams:
             service_kwargs=dict(retry_budget=1),
         ))
         tok = ByteTokenizer(cfg.vocab_size)
-        p0 = ProcessReplica(spec, tok, replica_id=0, build_timeout_s=300.0)
-        p1 = ProcessReplica(spec, tok, replica_id=1, build_timeout_s=300.0)
+        p0, p1 = _build_parallel(lambda i: ProcessReplica(
+            spec, tok, replica_id=i, build_timeout_s=300.0))
         # no-fault reference from the survivor (seeded inits are identical
-        # across workers — pinned by test_worker's parity suite)
-        expected = p1.generate(self.PROMPT, max_new_tokens=16,
-                               temperature=0.0, timeout_s=180)
+        # across workers — pinned by test_worker's parity suite); p0's
+        # radix is primed DEEPER than p1's reference insert so prefix
+        # affinity deterministically routes the drill stream to p0.
+        # Independent workers: both (compile-heavy) warms run concurrently
+        ref_out = _build_parallel(lambda i: (
+            p1.generate(self.PROMPT, max_new_tokens=16, temperature=0.0,
+                        timeout_s=180)
+            if i else
+            p0.generate(self.PROMPT, max_new_tokens=2, temperature=0.0,
+                        timeout_s=180)))
+        expected = ref_out[1]
         assert len(expected.tokens) >= 4
-        # prime p0's radix DEEPER than p1's reference insert so prefix
-        # affinity deterministically routes the drill stream to p0
-        p0.generate(self.PROMPT, max_new_tokens=2, temperature=0.0,
-                    timeout_s=180)
         rs = ReplicaSet(
             [p0, p1],
             probe_interval_s=0.05, quarantine_backoff_s=0.1,
@@ -1005,6 +1045,177 @@ class TestResumableStreams:
             assert ok.finish_reason in ("stop", "length")
         finally:
             rs.close()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and multiprocessing.active_children():
+            time.sleep(0.05)
+        assert multiprocessing.active_children() == [], (
+            "orphan replica worker processes leaked"
+        )
+        _assert_no_pump_threads()
+
+    def test_half_open_partition_drill_socket_transport(self):
+        """ISSUE 15 acceptance drill: a HALF-OPEN network partition of 1
+        of 2 SOCKET-transport workers mid-traffic — router reads from the
+        victim stall (no EOF, no error; its process stays alive and keeps
+        decoding) while writes still land. The contract:
+
+        * the partition is DETECTED from status-frame staleness alone
+          (transport-liveness contract) and the victim is quarantined
+          typed within budget;
+        * a delivered-token stream in flight RESUMES token-exact on the
+          survivor (same machinery as replica death — partitions ride the
+          HEALTHY→QUARANTINED path unchanged);
+        * the victim's shadowed never-answered tickets hand off to the
+          survivor without spending caller failover budget;
+        * the partitioned worker re-registers at a HIGHER incarnation
+          epoch (heal: same process — its engine and radix survive), and
+          every pre-partition frame it sent — buffered status frames AND
+          the answers it kept computing for handed-off work — is dropped
+          by the epoch fence (stale_frames > 0): a healed worker can
+          never resurrect dead tickets or double-deliver stream chunks;
+        * zero orphan processes/threads at teardown."""
+        import dataclasses
+        import multiprocessing
+
+        from sentio_tpu.models.llama import LlamaConfig
+        from sentio_tpu.models.tokenizer import ByteTokenizer
+        from sentio_tpu.runtime.replica import ReplicaSet, WorkerRegistry
+        from sentio_tpu.runtime.worker import ProcessReplica, WorkerSpec
+
+        cfg = LlamaConfig.tiny()
+        registry = WorkerRegistry("partition-drill", slots=2)
+        spec = WorkerSpec(
+            factory_kwargs=dict(
+                model_config=dataclasses.asdict(cfg),
+                engine_kwargs=dict(max_slots=2, page_size=8,
+                                   max_pages_per_seq=4, steps_per_tick=2),
+                service_kwargs=dict(retry_budget=1),
+            ),
+            auth_token="partition-drill", status_interval_s=0.05,
+            reconnect=True, reconnect_backoff_s=0.2,
+            router_silence_timeout_s=0.8,
+        )
+        tok = ByteTokenizer(cfg.vocab_size)
+        kw = dict(build_timeout_s=300.0, transport_mode="socket",
+                  registry=registry, partition_timeout_s=1.0,
+                  ping_interval_s=0.2, heal_grace_s=15.0)
+        p0, p1 = _build_parallel(lambda i: ProcessReplica(
+            spec, tok, replica_id=i, **kw))
+        old_pid, old_epoch = p0.pid, p0.epoch
+        # no-fault greedy reference from the survivor (seeded inits are
+        # identical across workers — pinned by the parity suites) and the
+        # VICTIM's radix primed so prefix affinity routes the drill
+        # stream onto the replica that will be partitioned — concurrent
+        # warms, the workers are independent processes
+        ref_out = _build_parallel(lambda i: (
+            p1.generate(self.PROMPT, max_new_tokens=16, temperature=0.0,
+                        timeout_s=180)
+            if i else
+            p0.generate(self.PROMPT, max_new_tokens=2, temperature=0.0,
+                        timeout_s=180)))
+        expected = ref_out[1]
+        assert len(expected.tokens) >= 4
+        rs = ReplicaSet(
+            [p0, p1],
+            probe_interval_s=0.05, quarantine_backoff_s=0.1,
+            failover_budget=1, rebuild_drain_s=0.5,
+        )
+        release = threading.Event()
+        probe_results: dict = {}
+        t_state: dict = {"armed": None, "detect": None}
+
+        def probe(i):
+            try:
+                probe_results[i] = p0.generate(
+                    f"partition handoff probe {i}", max_new_tokens=12,
+                    timeout_s=120)
+            except Exception as exc:  # noqa: BLE001 — asserted below
+                probe_results[i] = exc
+
+        def watch_detection():
+            while t_state["detect"] is None:
+                if t_state["armed"] is not None:
+                    state = rs.health_summary()["replicas"][0]["state"]
+                    if state != "HEALTHY":
+                        t_state["detect"] = time.monotonic()
+                        return
+                time.sleep(0.01)
+
+        watcher = threading.Thread(target=watch_detection, daemon=True)
+        watcher.start()
+        try:
+            stats_out: dict = {}
+            it = rs.generate_stream(self.PROMPT, max_new_tokens=16,
+                                    temperature=0.0, timeout_s=120,
+                                    stats_out=stats_out)
+            pieces = [next(it)]  # ≥1 chunk DELIVERED before the partition
+            # half-open partition: the router's reads from p0 wedge (its
+            # frames buffer unread); router→worker writes keep succeeding
+            faults.arm("transport.recv.r0", faults.FaultRule(
+                stall_event=release, stall_s=120.0, times=1))
+            t_state["armed"] = time.monotonic()
+            # probes launched INTO the partition: their request frames
+            # reach the live worker (writes work), its answers never come
+            # back (reads stall) — they stay router-side shadowed until
+            # the quarantine hands them to the survivor
+            threads = [threading.Thread(target=probe, args=(i,),
+                                        daemon=True) for i in range(2)]
+            for t in threads:
+                t.start()
+            # the stream blocks at the partition, gets the typed death,
+            # and resumes on the survivor — one uninterrupted iterator
+            for piece in it:
+                pieces.append(piece)
+            assert "".join(pieces) == expected.text
+            assert stats_out.get("resumed") == 1, stats_out
+            assert stats_out.get("replayed_tokens", 0) >= 1, stats_out
+            # detection came from staleness, within budget
+            watcher.join(timeout=30)
+            assert t_state["detect"] is not None, "partition never detected"
+            assert t_state["detect"] - t_state["armed"] <= 5.0
+            for t in threads:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in threads), (
+                "probe hung across the partition")
+            for i, out in probe_results.items():
+                assert isinstance(out, PagedResult), (i, out)
+                assert out.finish_reason in ("stop", "length"), (i, out)
+                assert out.replica_id == 1, (i, out)
+            stats = rs.stats()
+            assert stats["handed_off"] >= 2, stats["handed_off"]
+            assert stats["stream_resumes"] >= 1
+            # HEAL: the live partitioned worker re-registers at a higher
+            # epoch — same process, fresh incarnation
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if rs.health_summary()["status"] == "healthy":
+                    break
+                time.sleep(0.05)
+            summary = rs.health_summary()
+            assert summary["status"] == "healthy", summary
+            healed = rs._services[0]
+            assert healed.epoch > old_epoch, "reconnect must bump the epoch"
+            assert healed.pid == old_pid, (
+                "expected HEAL (same process re-registered), got a respawn")
+            # release the wedged read: the old connection drains its
+            # buffered pre-partition frames straight into the epoch fence
+            release.set()
+            deadline = time.monotonic() + 30
+            while registry.stale_frames(0) == 0 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert registry.stale_frames(0) > 0, (
+                "pre-partition frames were not stale-dropped")
+            # the healed set serves routed traffic
+            ok = rs.generate("post partition routed sanity",
+                             max_new_tokens=3, temperature=0.0,
+                             timeout_s=120)
+            assert ok.finish_reason in ("stop", "length")
+        finally:
+            release.set()
+            faults.reset()
+            rs.close()
+            registry.close()
         deadline = time.monotonic() + 30
         while time.monotonic() < deadline and multiprocessing.active_children():
             time.sleep(0.05)

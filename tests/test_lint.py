@@ -102,6 +102,23 @@ class TestSeededFixtures:
         ]
         assert "fork" in got[0].message and "spawn" in got[0].message
 
+    def test_socket_fixture_exact_findings(self):
+        """Deadline-free network blocking (the hang class the multi-host
+        worker tier's partition watchdog exists to detect): the bare
+        socket construction, the timeout-less create_connection, and the
+        zero-timeout recv loop all fire; the settimeout-wired scopes, the
+        timeout= dial, and the non-socket transport.recv() loop produce
+        nothing."""
+        got = _findings("socket_bad.py")
+        assert [(f.rule, f.line) for f in got] == [
+            ("socket-no-timeout", 11),
+            ("socket-no-timeout", 17),
+            ("socket-no-timeout", 23),
+        ]
+        assert "settimeout" in got[0].message
+        assert "timeout=" in got[1].message
+        assert "recv loop" in got[2].message
+
     def test_clock_fixture_exact_finding(self):
         got = _findings("clock_bad.py")
         assert [(f.rule, f.line) for f in got] == [("wall-clock-duration", 6)]

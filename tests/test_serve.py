@@ -693,6 +693,67 @@ class TestSseStreamResume:
         settings = self._settings(crash_retry_budget=0)
         run(with_client(settings, body, container=self._container(settings)))
 
+    def test_per_request_resumable_opt_out(self):
+        """ISSUE 15 satellite: the env-only PR 14 opt-out becomes
+        per-request — body ``resumable: false`` (and the ``X-Resumable``
+        header) ride HTTP → handler → generator → ReplicaSet, so a
+        mid-stream death under an opted-out stream keeps the typed
+        mid-stream error event even though the resume budget was
+        available; an opted-IN sibling request on the same set still
+        resumes."""
+        from sentio_tpu.infra import faults
+
+        async def body(client, container):
+            await seed(client, ["jax compiles python functions to xla"])
+
+            async def faulted_stream(payload, headers=None):
+                faults.arm("paged.step", faults.FaultRule(
+                    error=RuntimeError("sse drill: opt-out death"),
+                    times=1, skip=2))
+                try:
+                    resp = await client.post("/chat", json=payload,
+                                             headers=headers or {})
+                    assert resp.status == 200
+                    return self._sse_events((await resp.read()).decode())
+                finally:
+                    faults.reset()
+
+            # body-field opt-out: delivered tokens + typed error event
+            events = await faulted_stream({
+                "question": self.QUESTION, "stream": True,
+                "temperature": 0.0, "resumable": False})
+            kinds = [k for k, _ in events]
+            assert kinds.count("error") == 1, events
+            assert kinds.index("error") > kinds.index("token"), events
+            assert kinds[-1] == "done", events
+            # header opt-out: same typed wire contract
+            events = await faulted_stream(
+                {"question": self.QUESTION, "stream": True,
+                 "temperature": 0.0},
+                headers={"X-Resumable": "0"})
+            assert [k for k, _ in events].count("error") == 1, events
+            stats = container.generation_service.stats()
+            # the opt-out is per-request, not a latched mode: nothing was
+            # resumed (test_midstream_kill_is_invisible_on_the_wire pins
+            # that a default request on this same config DOES resume)
+            assert stats["stream_resumes"] == 0, stats
+            prom = await (await client.get("/metrics")).text()
+            assert 'sentio_tpu_stream_resumes_total{outcome="opt_out"}' \
+                in prom
+
+        settings = self._settings()
+        run(with_client(settings, body, container=self._container(settings)))
+
+    def test_resumable_field_validation(self):
+        async def body(client, container):
+            resp = await client.post("/chat", json={
+                "question": "any", "stream": True, "resumable": "nope"})
+            assert resp.status == 422
+            data = await resp.json()
+            assert any(e["field"] == "resumable" for e in data["details"])
+
+        run(with_client(fast_settings(), body))
+
 
 class TestOverloadMapping:
     """Typed shed/deadline errors → HTTP 429/503/504 + Retry-After — the

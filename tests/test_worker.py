@@ -5,6 +5,13 @@ init is re-derived identically in the worker), same typed sheds and
 deadline errors, same mid-stream failure semantics, and a teardown that
 REAPS the worker (no orphan processes, asserted via ``active_children``).
 
+ISSUE 15 adds the SOCKET transport parity half: the same worker behind
+length-prefixed TCP frames (runtime/transport.py) must be token-IDENTICAL
+to the pipe transport from the same seed, cross every typed error intact,
+and run the SIGKILL → typed quarantine → re-registration (higher
+incarnation epoch) → serving-again lifecycle the pipe mode's respawn
+drill pins.
+
 Workers here run tiny seeded-random llama engines (no checkpoint), so the
 suite exercises the RPC/liveness machinery, not model quality."""
 
@@ -22,6 +29,7 @@ from sentio_tpu.infra.exceptions import (
 )
 from sentio_tpu.models.llama import LlamaConfig
 from sentio_tpu.models.tokenizer import ByteTokenizer
+from sentio_tpu.runtime.replica import WorkerRegistry
 from sentio_tpu.runtime.worker import ProcessReplica, WorkerSpec
 
 CFG = LlamaConfig.tiny()
@@ -49,6 +57,173 @@ def worker():
                         build_timeout_s=300.0)
     yield pr
     pr.close()
+
+
+@pytest.fixture(scope="module")
+def socket_worker():
+    """ONE socket-transport worker (+ its registry) for the module: each
+    spawn pays a fresh interpreter + jax init + first-tick compiles. The
+    small max_queue makes the typed-shed drill cheap; parity tests run
+    serially and never queue."""
+    registry = WorkerRegistry("drill-token", slots=1)
+    spec = _spec(retry_budget=1, max_queue=2)
+    spec = dataclasses.replace(spec, auth_token="drill-token",
+                               status_interval_s=0.05)
+    pr = ProcessReplica(spec, _tokenizer(), replica_id=0,
+                        build_timeout_s=300.0, transport_mode="socket",
+                        registry=registry, partition_timeout_s=2.0,
+                        ping_interval_s=0.2)
+    yield pr, registry
+    pr.close()
+    registry.close()
+
+
+class TestSocketParity:
+    """ISSUE 15 acceptance: N=1 socket-transport parity — token-IDENTICAL
+    output vs the pipe transport from the same seed, typed errors crossing
+    the TCP boundary intact, and the SIGKILL → re-registration lifecycle
+    (LAST test: it consumes the module worker)."""
+
+    def test_generate_and_stream_token_parity_with_pipe_transport(
+            self, worker, socket_worker):
+        """The SAME request through the pipe worker and the socket worker
+        must produce IDENTICAL tokens and text: the transport seam carries
+        frames, never semantics."""
+        sock, _registry = socket_worker
+        prompt = "transport parity probe prompt"
+        via_pipe = worker.generate(prompt, max_new_tokens=6,
+                                   temperature=0.0, timeout_s=120)
+        via_sock = sock.generate(prompt, max_new_tokens=6,
+                                 temperature=0.0, timeout_s=120)
+        assert list(via_sock.tokens) == list(via_pipe.tokens)
+        assert via_sock.text == via_pipe.text
+        assert via_sock.finish_reason == via_pipe.finish_reason
+        # streaming: same pieces reassembled, same stats surface
+        pipe_stats: dict = {}
+        sock_stats: dict = {}
+        pipe_text = "".join(worker.generate_stream(
+            prompt, max_new_tokens=6, temperature=0.0, timeout_s=120,
+            stats_out=pipe_stats))
+        sock_text = "".join(sock.generate_stream(
+            prompt, max_new_tokens=6, temperature=0.0, timeout_s=120,
+            stats_out=sock_stats))
+        assert sock_text == pipe_text
+        assert sock_stats.get("tokens") == pipe_stats.get("tokens")
+        assert sock.epoch == 1  # first incarnation
+        stats = sock.stats()
+        assert stats["transport"] == "socket"
+        assert stats["incarnation"] == 1
+        assert stats["stale_frames"] == 0
+
+    def test_typed_deadline_error_crosses_the_socket(self, socket_worker):
+        sock, _registry = socket_worker
+        with pytest.raises(DeadlineExceededError):
+            sock.generate("expired before submit", max_new_tokens=2,
+                          deadline_ts=time.perf_counter() - 0.5,
+                          timeout_s=30)
+
+    def test_typed_shed_crosses_the_socket(self, socket_worker):
+        """Wedge the worker's pump (in-worker stall fault over the RPC
+        surface), oversubscribe the tiny queue: admissions beyond the
+        bound shed typed ServiceOverloaded (429 + Retry-After) across the
+        TCP boundary; the admitted requests complete once the stall
+        lifts."""
+        sock, _registry = socket_worker
+        sock.inject_fault("paged.step", stall_s=2.5, times=1)
+        outcomes: dict = {}
+
+        def call(i):
+            try:
+                outcomes[i] = sock.generate(f"shed probe {i}",
+                                            max_new_tokens=2,
+                                            temperature=0.0, timeout_s=120)
+            except Exception as exc:  # noqa: BLE001 — typed or bust
+                outcomes[i] = exc
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads)
+        sock.reset_faults()
+        sheds = [o for o in outcomes.values()
+                 if isinstance(o, ServiceOverloaded)]
+        served = [o for o in outcomes.values() if not isinstance(o, Exception)]
+        assert sheds, f"no typed shed crossed the boundary: {outcomes}"
+        assert all(s.status in (429, 503) for s in sheds)
+        assert served, "the admitted requests never completed"
+        untyped = [o for o in outcomes.values()
+                   if isinstance(o, Exception)
+                   and not isinstance(o, (ServiceOverloaded,
+                                          ReplicaUnavailable,
+                                          DeadlineExceededError))]
+        assert untyped == []
+
+    def test_typed_midstream_error_crosses_the_socket(self, socket_worker):
+        sock, _registry = socket_worker
+        sock.inject_fault("paged.step", delay_s=0.1)
+        it = sock.generate_stream("midstream failure over tcp prompt",
+                                  max_new_tokens=200, temperature=0.0,
+                                  timeout_s=120)
+        assert next(it)  # tokens flowed before the fault arms
+        sock.inject_fault("paged.step", error=RuntimeError("boom"), times=1)
+        with pytest.raises(ReplicaUnavailable):
+            for _ in it:
+                pass
+        sock.reset_faults()
+        ok = sock.generate("post failure sanity", max_new_tokens=3,
+                           temperature=0.0, timeout_s=120)
+        assert ok.finish_reason in ("stop", "length")
+
+    def test_sigkill_typed_then_reregisters_at_higher_epoch(
+            self, socket_worker):
+        """LAST (kills the module worker) — ISSUE 15 acceptance: a real
+        SIGKILL under socket transport runs the same typed lifecycle as
+        pipe-mode respawn, except recovery is RE-REGISTRATION: the fresh
+        worker dials the registry and joins at a HIGHER incarnation
+        epoch."""
+        sock, registry = socket_worker
+        old_pid, old_epoch = sock.pid, sock.epoch
+        sock.inject_fault("paged.step", delay_s=0.2)  # keep it in flight
+        outcome: dict = {}
+
+        def call():
+            try:
+                outcome["r"] = sock.generate(
+                    "inflight kill over tcp", max_new_tokens=100,
+                    temperature=0.0, timeout_s=60)
+            except Exception as exc:  # noqa: BLE001 — typed or bust
+                outcome["r"] = exc
+
+        t = threading.Thread(target=call)
+        t.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and sock.backlog() < 1:
+            time.sleep(0.01)
+        assert sock.backlog() >= 1, "request never reached the worker"
+        sock.kill()  # real SIGKILL — no handlers, no unwinding
+        t.join(timeout=30)
+        assert not t.is_alive(), "caller hung across the worker SIGKILL"
+        assert isinstance(outcome["r"], ReplicaUnavailable), outcome
+        assert sock.broken
+        fresh = sock.respawn()
+        try:
+            assert fresh.pid != old_pid, "respawn reused the corpse's pid?"
+            assert fresh.epoch > old_epoch, "re-registration must bump epoch"
+            assert registry.current_epoch(0) == fresh.epoch
+            fresh_pid = fresh.pid
+            ok = fresh.generate("re-registered worker serves",
+                                max_new_tokens=3, temperature=0.0,
+                                timeout_s=180)
+            assert ok.finish_reason in ("stop", "length")
+        finally:
+            fresh.close()
+        # zero orphans from THIS drill (the pipe-parity module worker is
+        # still legitimately alive for the next test class)
+        alive = [p.pid for p in multiprocessing.active_children()]
+        assert old_pid not in alive, "SIGKILLed corpse never reaped"
+        assert fresh_pid not in alive, "re-registered worker leaked"
 
 
 class TestProcessParity:
