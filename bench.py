@@ -1167,6 +1167,8 @@ def phase_chaos(llm_cfg, new_tokens, replica_mode=None, chaos_mode=None):
     from sentio_tpu.runtime.replica import ReplicaSet
     from sentio_tpu.runtime.service import PagedGenerationService
 
+    from sentio_tpu.infra.metrics import get_metrics
+
     qps = float(os.environ.get("BENCH_CHAOS_QPS", "8"))
     run_s = float(os.environ.get("BENCH_CHAOS_SECONDS", "30"))
     kill_at_s = float(os.environ.get("BENCH_CHAOS_KILL_AT_S", "5"))
@@ -1291,6 +1293,10 @@ def phase_chaos(llm_cfg, new_tokens, replica_mode=None, chaos_mode=None):
     # (arrival time relative to t_start, e2e latency ms) for completions
     completions: list[tuple[float, float]] = []
     t_state = {"kill": None, "detect": None, "recover": None, "done": False}
+    # telemetry-plane-under-fire bookkeeping (process/socket): worst
+    # telemetry age observed inside the incident window (the observability
+    # gap the outage opened) and the worst clock-offset uncertainty bound
+    tel = {"gap_max_s": None, "offset_bound_max_s": None}
     stall_release = threading.Event()
     partition_release = threading.Event()
 
@@ -1384,10 +1390,37 @@ def phase_chaos(llm_cfg, new_tokens, replica_mode=None, chaos_mode=None):
                     return
             time.sleep(0.02)
 
+    def telemetry_watcher() -> None:
+        # the telemetry plane under fire: sample the VICTIM's telemetry
+        # age and clock bound through the drill — always re-reading
+        # rs._services[1], because heal/respawn replaces the shim object —
+        # and keep the worst gap seen inside the incident window
+        while not t_state["done"]:
+            svc = rs._services[1]
+            age_fn = getattr(svc, "telemetry_age", None)
+            if callable(age_fn):
+                try:
+                    age = age_fn()
+                except Exception:  # noqa: BLE001 — shim mid-replacement
+                    age = None
+                if age is not None and t_state["kill"] is not None:
+                    if tel["gap_max_s"] is None or age > tel["gap_max_s"]:
+                        tel["gap_max_s"] = age
+            clock_fn = getattr(svc, "clock_sync", None)
+            if callable(clock_fn):
+                est = clock_fn()
+                if est is not None and (
+                        tel["offset_bound_max_s"] is None
+                        or est["uncertainty_s"] > tel["offset_bound_max_s"]):
+                    tel["offset_bound_max_s"] = est["uncertainty_s"]
+            time.sleep(0.05)
+
     threads: list[threading.Thread] = []
     t_start = time.perf_counter()
     w = threading.Thread(target=watcher, args=(t_start,), daemon=True)
     w.start()
+    if replica_mode in ("process", "socket"):
+        threading.Thread(target=telemetry_watcher, daemon=True).start()
     killed = False
     seq = 0
     while time.perf_counter() - t_start < run_s:
@@ -1565,6 +1598,24 @@ def phase_chaos(llm_cfg, new_tokens, replica_mode=None, chaos_mode=None):
             if killed and t_recover is not None else None)
         out["incarnation"] = cur.epoch
         out["incarnation_before"] = victim_epoch
+    if replica_mode in ("process", "socket"):
+        # the observability plane's own incident report: how long the
+        # fleet flew blind (worst telemetry age inside the incident
+        # window), how many stale-epoch deltas the merge fence refused
+        # (double-count protection at work), and the worst clock-offset
+        # uncertainty bound the trace re-basing had to wear
+        stale_dropped = sum(
+            v for k, v in get_metrics().memory.counters.items()
+            if k.startswith("worker_telemetry_dropped")
+            and "stale_epoch" in k)
+        out["telemetry"] = {
+            "gap_max_s": (round(tel["gap_max_s"], 3)
+                          if tel["gap_max_s"] is not None else None),
+            "stale_deltas_dropped": int(stale_dropped),
+            "clock_offset_bound_max_s": (
+                round(tel["offset_bound_max_s"], 6)
+                if tel["offset_bound_max_s"] is not None else None),
+        }
     if steady:
         out["steady_p95_ms"] = round(_percentile(steady, 0.95), 2)
     if incident:

@@ -30,6 +30,7 @@ from sentio_tpu.analysis.locks import check_locks
 from sentio_tpu.analysis.phasing import check_phase_timer
 from sentio_tpu.analysis.retrace import check_retrace
 from sentio_tpu.analysis.sockcheck import check_sockets
+from sentio_tpu.analysis.telemetry import check_telemetry
 
 __all__ = ["lint_paths", "run_gate", "main", "DEFAULT_BASELINE"]
 
@@ -38,7 +39,7 @@ REPO_ROOT = PACKAGE_ROOT.parent
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
 
 RULES = (check_retrace, check_locks, check_hygiene, check_blocking,
-         check_phase_timer, check_fork, check_sockets)
+         check_phase_timer, check_fork, check_sockets, check_telemetry)
 
 
 def _iter_py_files(path: Path):

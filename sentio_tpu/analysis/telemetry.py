@@ -1,0 +1,105 @@
+"""Telemetry label-cardinality discipline: no request-derived label values.
+
+The metrics layer keeps every label space BOUNDED by construction — phase
+names come from the fixed ``TICK_PHASES`` tuple, shed reasons from typed
+enums, replica ids from the configured replica count. One call site that
+threads a request-derived value (tenant name, request id, prompt text)
+into a ``record_*`` label breaks that globally: series cardinality then
+grows with traffic, the Prometheus scrape bloats without bound, and the
+fleet-merge path (``merge_worker_series``) faithfully ships the explosion
+from every worker to the router. The merge layer has a runtime cardinality
+guard (``MAX_WORKER_SERIES_PER_REPLICA``) that caps the damage — this rule
+catches the mistake at review time, before a guard has to drop data.
+
+``telemetry-unbounded-labels``
+    A ``<obj>.record_*(...)`` / ``<obj>.merge_worker_series(...)`` /
+    ``<obj>.set_replica_stat(...)`` call where some argument's value
+    derives from a request-scoped identifier: a name/attribute/subscript/
+    f-string whose terminal identifier is one of the SUSPECT set
+    (``tenant``, ``request_id``, ``prompt``, ...). Recorders that are
+    bounded by design are exempt: flight-recorder ``record_tick`` (a
+    deque, not a label space) and the tenant-fairness pair
+    ``record_tenant_admitted``/``record_tenant_shed`` (the tenant gauge
+    set is capped by ``TenantFairQueue.MAX_TRACKED`` eviction).
+
+Suppression: the standard inline ``# lint: allow(<rule>)`` marker for
+call sites that bound the value some other way.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from sentio_tpu.analysis.findings import Finding, SourceFile
+
+__all__ = ["check_telemetry"]
+
+RULE_UNBOUNDED = "telemetry-unbounded-labels"
+
+# request-scoped identifiers: any of these feeding a metric label value
+# makes series cardinality a function of traffic, not configuration
+_SUSPECT = frozenset({
+    "tenant", "tenant_id", "request_id", "req_id", "rid", "query_id",
+    "ticket_id", "session_id", "prompt", "question", "query_text",
+    "user", "user_id", "api_key",
+})
+
+# bounded-by-design recorders (see module docstring)
+_EXEMPT = frozenset({
+    "record_tick", "record_tenant_admitted", "record_tenant_shed",
+})
+
+
+def _is_telemetry_call(func: ast.expr) -> bool:
+    if not isinstance(func, ast.Attribute):
+        return False
+    name = func.attr
+    if name in _EXEMPT:
+        return False
+    return (name.startswith("record_")
+            or name in ("merge_worker_series", "set_replica_stat"))
+
+
+def _suspect_in(expr: ast.expr) -> str:
+    """First SUSPECT identifier reachable inside ``expr`` (names, attribute
+    terminals, constant subscript keys, f-string parts), or ''."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in _SUSPECT:
+            return node.id
+        if isinstance(node, ast.Attribute) and node.attr in _SUSPECT:
+            return node.attr
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.slice, ast.Constant)
+                and node.slice.value in _SUSPECT):
+            return str(node.slice.value)
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get" and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value in _SUSPECT):
+            return str(node.args[0].value)
+    return ""
+
+
+def check_telemetry(tree: ast.Module, src: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not _is_telemetry_call(node.func):
+            continue
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        for arg in args:
+            suspect = _suspect_in(arg)
+            if not suspect:
+                continue
+            f = src.finding(
+                RULE_UNBOUNDED, node.lineno,
+                f"telemetry call {node.func.attr}(...) takes a value "
+                f"derived from request-scoped {suspect!r} — label "
+                f"cardinality would grow with traffic (and the fleet merge "
+                f"ships it from every worker); use a bounded enum/bucket, "
+                f"or suppress if the value is capped elsewhere",
+            )
+            if f is not None:
+                findings.append(f)
+            break  # one finding per call site
+    return findings

@@ -125,11 +125,19 @@ def _cmd_trace(args: argparse.Namespace) -> int:
     if args.chrome:
         # the WHOLE flight timeline (every tick with its phase split, every
         # request span, verify verdicts) as a Chrome/Perfetto trace — open
-        # the file in ui.perfetto.dev
+        # the file in ui.perfetto.dev. --fleet additionally pulls every
+        # worker replica's flight buffer and lays the fleet out on ONE
+        # clock-aligned timeline (one lane per worker incarnation)
         from sentio_tpu.infra.chrome_trace import flight_to_chrome
 
+        chrome = _fleet_trace(container) if args.fleet else None
+        if chrome is None:
+            if args.fleet:
+                print("--fleet: no worker replicas (thread mode?) — "
+                      "falling back to the local timeline", file=sys.stderr)
+            chrome = flight_to_chrome()
         with open(args.chrome, "w") as fh:
-            json.dump(flight_to_chrome(), fh)
+            json.dump(chrome, fh)
         print(f"chrome trace written to {args.chrome} "
               f"(open in ui.perfetto.dev)", file=sys.stderr)
     if args.documents:
@@ -139,6 +147,48 @@ def _cmd_trace(args: argparse.Namespace) -> int:
         ]
     print(json.dumps(trace, indent=2, default=str))
     return 0
+
+
+def _fleet_trace(container):
+    """Fetch every worker replica's flight buffer (ticks + records) over
+    the ``fetch_flight`` RPC and lay the fleet out on one clock-aligned
+    Chrome trace: router request lanes on top, one synthetic process row
+    per worker INCARNATION below, worker timestamps re-based onto the
+    router's perf_counter timeline with the ClockSync offset (the lane
+    name carries the ± uncertainty bound). Returns None when no worker
+    replicas exist (thread mode) — the caller falls back to the local
+    single-recorder export."""
+    from sentio_tpu.infra.chrome_trace import build_fleet_trace
+    from sentio_tpu.infra.flight import get_flight_recorder
+
+    service = container.peek("generation_service")
+    members = list(getattr(service, "_services", None) or ())
+    fetchable = [svc for svc in members
+                 if callable(getattr(svc, "fetch_flight", None))]
+    if not fetchable:
+        return None
+    recorder = get_flight_recorder()
+    router_origin = recorder.origin()
+    workers = []
+    for svc in fetchable:
+        try:
+            reply = svc.fetch_flight()
+        except Exception as exc:  # noqa: BLE001 — dead worker: lane absent
+            print(f"--fleet: replica {getattr(svc, 'replica_id', '?')} "
+                  f"unavailable ({type(exc).__name__}) — lane omitted",
+                  file=sys.stderr)
+            continue
+        shift, bound = svc.flight_shift_s(router_origin)
+        workers.append({
+            "replica": reply.get("replica"),
+            "epoch": reply.get("epoch") or 0,
+            "shift_s": shift,
+            "uncertainty_s": bound,
+            "ticks": reply.get("ticks") or [],
+            "records": reply.get("records") or [],
+        })
+    return build_fleet_trace(workers, router_ticks=recorder.timeline(),
+                             router_records=recorder.records())
 
 
 def _cmd_convert(args: argparse.Namespace) -> int:
@@ -342,6 +392,10 @@ def main(argv: list[str] | None = None) -> int:
     p_trace.add_argument("--chrome", default="", metavar="OUT_JSON",
                          help="also dump the full flight timeline as a "
                               "Chrome/Perfetto trace (ui.perfetto.dev)")
+    p_trace.add_argument("--fleet", action="store_true",
+                         help="with --chrome: fetch every worker replica's "
+                              "flight buffer and emit ONE clock-aligned "
+                              "fleet trace (a lane per worker incarnation)")
     p_trace.set_defaults(fn=_cmd_trace)
 
     p_conv = sub.add_parser("convert", help="convert a local HF checkpoint dir")

@@ -494,6 +494,11 @@ class ServeConfig:
     # re-register (HEAL — keeps the process and its warm engine) before
     # the supervisor reaps and respawns
     socket_heal_grace_s: float = 5.0
+    # fleet telemetry plane: cadence at which a process/socket worker ships
+    # its metrics-registry deltas + duty snapshot over the RPC link as
+    # low-priority `telemetry` frames (0 disables — the RPC hot path is
+    # then byte-identical to the pre-telemetry protocol)
+    telemetry_interval_s: float = 1.0
 
     @classmethod
     def from_env(cls) -> "ServeConfig":
@@ -577,6 +582,7 @@ class ServeConfig:
                 ["SOCKET_FRAME_TIMEOUT_S"], 30.0
             ),
             socket_heal_grace_s=_env_float(["SOCKET_HEAL_GRACE_S"], 5.0),
+            telemetry_interval_s=_env_float(["TELEMETRY_INTERVAL_S"], 1.0),
         )
 
     def parsed_replica_workers(self) -> list[tuple[str, int]]:

@@ -36,7 +36,7 @@ from typing import Optional
 
 from sentio_tpu.infra.phases import TICK_PHASES
 
-__all__ = ["build_chrome_trace", "flight_to_chrome"]
+__all__ = ["build_chrome_trace", "build_fleet_trace", "flight_to_chrome"]
 
 # tick args copied onto the tick slice (bounded, plot-friendly)
 _TICK_ARGS = (
@@ -46,6 +46,11 @@ _TICK_ARGS = (
 
 _PUMP_TID = 0
 _REQUEST_TID_BASE = 1
+
+# fleet traces: worker lanes get synthetic pids well above any router
+# replica id — one process row per worker INCARNATION, so a slot that
+# healed or respawned mid-trace shows its epochs as separate lanes
+_FLEET_PID_BASE = 1000
 
 
 def _us(seconds: float) -> float:
@@ -202,6 +207,61 @@ def build_chrome_trace(ticks: list[dict], records: list[dict],
         "otherData": {"source": label},
         "traceEvents": events,
     }
+
+
+def build_fleet_trace(workers: list[dict], router_ticks: Optional[list] = None,
+                      router_records: Optional[list] = None,
+                      label: str = "sentio-tpu-fleet") -> dict:
+    """One coherent Chrome trace across the fleet: router request lanes on
+    top (their native pids, 0..N), one synthetic process row per WORKER
+    INCARNATION below, every worker timestamp re-based onto the router's
+    timeline before layout.
+
+    Each ``workers`` entry is plain data (pure function — the golden test
+    hands fixtures): ``{"replica", "epoch", "shift_s", "uncertainty_s",
+    "ticks", "records"}`` where ``shift_s`` is the caller-computed
+    worker-timeline → router-timeline correction
+    (``worker_origin − clock_offset − router_origin`` for cross-process
+    clocks; see ProcessReplica.fetch_flight) and ``uncertainty_s`` is the
+    ClockSync bound, stamped on the lane name — a reader can see exactly
+    how far causality claims stretch."""
+    all_ticks = [dict(t) for t in (router_ticks or [])]
+    all_records = [dict(r) for r in (router_records or [])]
+    names: dict[int, str] = {}
+    for worker in workers:
+        replica = int(worker.get("replica", 0))
+        epoch = int(worker.get("epoch", 0))
+        shift = float(worker.get("shift_s", 0.0))
+        pid = _FLEET_PID_BASE * (replica + 1) + epoch
+        bound = worker.get("uncertainty_s")
+        names[pid] = (
+            f"worker {replica} epoch {epoch}"
+            + (f" (clock ±{float(bound) * 1e3:.1f}ms)"
+               if bound is not None else " (clock unaligned)")
+        )
+        for tick in worker.get("ticks") or []:
+            shifted = dict(tick, replica=pid)
+            if "t_s" in shifted:
+                shifted["t_s"] = round(float(shifted["t_s"]) + shift, 6)
+            all_ticks.append(shifted)
+        for record in worker.get("records") or []:
+            shifted = dict(record)
+            engine = dict(shifted.get("engine") or {})
+            engine["replica_id"] = pid
+            if engine.get("t_submit_s") is not None:
+                engine["t_submit_s"] = round(
+                    float(engine["t_submit_s"]) + shift, 6)
+            shifted["engine"] = engine
+            if shifted.get("t_start_s") is not None:
+                shifted["t_start_s"] = round(
+                    float(shifted["t_start_s"]) + shift, 6)
+            all_records.append(shifted)
+    trace = build_chrome_trace(all_ticks, all_records, label=label)
+    for event in trace["traceEvents"]:
+        if (event.get("ph") == "M" and event.get("name") == "process_name"
+                and event["pid"] in names):
+            event["args"]["name"] = names[event["pid"]]
+    return trace
 
 
 def flight_to_chrome(recorder=None, request_id: Optional[str] = None,

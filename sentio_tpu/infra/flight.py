@@ -252,6 +252,26 @@ class FlightRecorder:
                 for record in self._records.values()
             ]
 
+    def origin(self) -> float:
+        """This recorder's timeline zero as a raw ``perf_counter`` value.
+        Two recorders in one PROCESS (router + its thread-mode services)
+        share a clock but not an origin; across processes the clock itself
+        differs — fleet trace stitching needs both the origin (same-clock
+        re-basing) and a ClockSync offset (cross-process re-basing)."""
+        return self._t0
+
+    def highwater(self) -> dict:
+        """Ring/table occupancy counters only — the bounded stats a 1 Hz
+        telemetry frame can afford (``snapshot()`` inlines every retained
+        tick and is far too heavy to ship on a cadence)."""
+        with self._lock:
+            return {
+                "ticks_recorded": self._tick_seq,
+                "ticks_retained": len(self._ticks),
+                "requests_retained": len(self._records),
+                "requests_dropped": self.dropped_requests,
+            }
+
     def snapshot(self) -> dict:
         """Aggregate view for bench artifacts / debugging."""
         with self._lock:

@@ -9,6 +9,7 @@ use, batch occupancy, generated tokens/s (SURVEY.md §2.10 build column).
 
 from __future__ import annotations
 
+import ast
 import time
 from contextlib import contextmanager
 from typing import Any, Optional
@@ -27,6 +28,31 @@ try:
     PROMETHEUS_AVAILABLE = True
 except ImportError:  # pragma: no cover - prometheus is in the image
     PROMETHEUS_AVAILABLE = False
+
+
+# fleet telemetry (runtime/worker.py telemetry frames → merge_worker_series):
+# distinct worker-originated series a single replica may mint on the router.
+# The worker's own registry is already label-bounded (phase/family/reason
+# sets are fixed tuples), so this cap only fires if a worker starts lying —
+# overflow series are dropped and counted, never merged.
+MAX_WORKER_SERIES_PER_REPLICA = 512
+
+
+def _parse_series_key(key: str):
+    """Split an :class:`InMemoryMetrics` storage key (``f"{name}{labels}"``
+    with ``labels`` a tuple) back into ``(name, labels)``. Returns
+    ``(None, ())`` for keys that do not round-trip — a malformed key from a
+    byte-damaged frame must be dropped, not crash the merge."""
+    cut = key.find("(")
+    if cut < 0:
+        return key, ()
+    try:
+        labels = ast.literal_eval(key[cut:])
+    except (ValueError, SyntaxError):
+        return None, ()
+    if not isinstance(labels, tuple):
+        labels = (labels,)
+    return key[:cut], tuple(str(item) for item in labels)
 
 
 class InMemoryMetrics:
@@ -96,6 +122,12 @@ class MetricsCollector:
         self._inflight = 0  # guarded-by: _inflight_lock
         self._inflight_lock = make_lock("MetricsCollector._inflight_lock")
         self._serving_last: dict[str, float] = {}
+        # per-replica worker-telemetry merge state: cumulative baselines +
+        # the (pid, epoch) fence. Lives on the COLLECTOR, not the replica
+        # shim — a heal replaces the ProcessReplica object, and losing the
+        # baselines there would double-count every series post-heal.
+        self._worker_last: dict[int, dict] = {}  # guarded-by: _worker_lock
+        self._worker_lock = make_lock("MetricsCollector._worker_lock")
         if PROMETHEUS_AVAILABLE and enabled:
             self.registry = CollectorRegistry()
             self._build_prom()
@@ -316,6 +348,73 @@ class MetricsCollector:
                 "spent, typed error surfaced; failed = no survivor could "
                 "take the splice; opt_out = caller disabled resumption)",
                 ["outcome"], registry=r,
+            ),
+            # fleet telemetry plane (runtime/worker.py telemetry frames):
+            # worker-process metric registries shipped as monotonic deltas
+            # and re-published here under {replica} — /metrics shows one
+            # truthful fleet view in every replica mode. Counters (not
+            # gauges): rate() stays correct across scrapes and worker
+            # respawns (merge_worker_series resets baselines on pid change).
+            "worker_tick_phase_seconds": Counter(
+                "sentio_tpu_worker_tick_phase_seconds_total",
+                "cumulative pump-iteration seconds per named phase, per "
+                "worker replica (fleet-merged from telemetry frames)",
+                ["replica", "phase"], registry=r,
+            ),
+            "worker_tick_phase_ticks": Counter(
+                "sentio_tpu_worker_tick_phase_ticks_total",
+                "pump iterations observed per named phase, per worker "
+                "replica (fleet-merged from telemetry frames)",
+                ["replica", "phase"], registry=r,
+            ),
+            "worker_verify": Counter(
+                "sentio_tpu_worker_verify_total",
+                "answer verifications landed inside a worker process, by "
+                "mode and outcome (fleet-merged from telemetry frames)",
+                ["replica", "mode", "outcome"], registry=r,
+            ),
+            "worker_compiles": Counter(
+                "sentio_tpu_worker_compiles_total",
+                "XLA compilations observed inside a worker process at "
+                "registered jit families (fleet-merged)",
+                ["replica", "family"], registry=r,
+            ),
+            "worker_events": Counter(
+                "sentio_tpu_worker_events_total",
+                "other worker-process counter series, flattened to one "
+                "bounded series label (fleet-merged)",
+                ["replica", "series"], registry=r,
+            ),
+            "worker_observed_sum": Counter(
+                "sentio_tpu_worker_observed_sum",
+                "worker-process histogram value sums per series "
+                "(fleet-merged; pairs with ..._observed_count for means)",
+                ["replica", "series"], registry=r,
+            ),
+            "worker_observed_count": Counter(
+                "sentio_tpu_worker_observed_count",
+                "worker-process histogram observation counts per series "
+                "(fleet-merged)",
+                ["replica", "series"], registry=r,
+            ),
+            # telemetry silence made observable: seconds since the last
+            # ACCEPTED telemetry frame from each worker. Climbs ~1 s/s
+            # through a partition, snaps back at the first post-heal frame —
+            # monitoring.yaml's SentioTpuWorkerTelemetryStale alerts on it
+            "worker_telemetry_age": Gauge(
+                "sentio_tpu_worker_telemetry_age_seconds",
+                "seconds since the router last merged a telemetry frame "
+                "from this replica's worker",
+                ["replica"], registry=r,
+            ),
+            # the telemetry epoch fence + cardinality guard, visible:
+            # stale_epoch = a healed worker's pre-partition buffer hit the
+            # fence (normal during incidents); cardinality = a worker tried
+            # to mint more distinct series than the per-replica cap
+            "worker_telemetry_dropped": Counter(
+                "sentio_tpu_worker_telemetry_dropped_total",
+                "worker telemetry frames/series dropped at merge",
+                ["replica", "reason"], registry=r,
             ),
         }
 
@@ -548,6 +647,174 @@ class MetricsCollector:
         counter = self._prom.get("stream_resumes")
         if counter is not None:
             counter.labels(outcome).inc()
+
+    # ----------------------------------------------- fleet telemetry merge
+
+    def export_worker_series(self) -> dict[str, Any]:
+        """CUMULATIVE snapshot of this process's counter/histogram registry,
+        the payload a worker's telemetry frame carries (runtime/worker.py).
+        Cheap: three dict copies under the memory lock, no histogram windows
+        (quantiles stay worker-local — only monotonic aggregates ship, so
+        the router can difference them into deltas safely)."""
+        memory = self.memory
+        with memory._lock:
+            return {
+                "counters": dict(memory.counters),
+                "histo_count": dict(memory._histo_total),
+                "histo_sum": dict(memory._histo_sum),
+            }
+
+    def _publish_worker_delta(self, replica: str, name: str,
+                                 labels: tuple, delta_sum: float,
+                                 delta_count: float, is_histo: bool) -> None:
+        """Route one accepted worker-series delta into the {replica}-labeled
+        fleet families. Known bounded-label series keep their label
+        structure (phase / mode+outcome / family); everything else flattens
+        into one ``series`` label so an unknown worker series can never mint
+        an unbounded label SET, only a new value under the guard's cap."""
+        if is_histo:
+            if name == "tick_phase" and len(labels) == 1:
+                self.memory.inc("worker_tick_phase_seconds",
+                                (replica, labels[0]), delta_sum)
+                self.memory.inc("worker_tick_phase_ticks",
+                                (replica, labels[0]), delta_count)
+                sec = self._prom.get("worker_tick_phase_seconds")
+                cnt = self._prom.get("worker_tick_phase_ticks")
+                if sec is not None and delta_sum:
+                    sec.labels(replica, labels[0]).inc(delta_sum)
+                if cnt is not None and delta_count:
+                    cnt.labels(replica, labels[0]).inc(delta_count)
+                return
+            series = "_".join((name,) + labels) if labels else name
+            self.memory.inc("worker_observed_sum", (replica, series),
+                            delta_sum)
+            self.memory.inc("worker_observed_count", (replica, series),
+                            delta_count)
+            osum = self._prom.get("worker_observed_sum")
+            ocnt = self._prom.get("worker_observed_count")
+            if osum is not None and delta_sum > 0:
+                osum.labels(replica, series).inc(delta_sum)
+            if ocnt is not None and delta_count:
+                ocnt.labels(replica, series).inc(delta_count)
+            return
+        if name == "verify" and len(labels) == 2:
+            self.memory.inc("worker_verify", (replica,) + labels, delta_sum)
+            counter = self._prom.get("worker_verify")
+            if counter is not None:
+                counter.labels(replica, labels[0], labels[1]).inc(delta_sum)
+            return
+        if name == "xla_compiles" and len(labels) == 1:
+            self.memory.inc("worker_compiles", (replica, labels[0]),
+                            delta_sum)
+            counter = self._prom.get("worker_compiles")
+            if counter is not None:
+                counter.labels(replica, labels[0]).inc(delta_sum)
+            return
+        series = "_".join((name,) + labels) if labels else name
+        self.memory.inc("worker_events", (replica, series), delta_sum)
+        counter = self._prom.get("worker_events")
+        if counter is not None:
+            counter.labels(replica, series).inc(delta_sum)
+
+    def merge_worker_series(self, replica: int, series: dict,
+                            epoch: int = 0,
+                            pid: Optional[int] = None) -> dict:
+        """Fold one worker telemetry frame's CUMULATIVE series snapshot
+        (:meth:`export_worker_series` shape) into the router's fleet
+        families under ``{replica}`` labels, differencing against the last
+        accepted snapshot.
+
+        Fencing & continuity contract (ISSUE 16 leg 4):
+
+        * ``epoch`` below the last accepted epoch → the whole frame is a
+          healed worker's pre-partition buffer draining late; DROPPED and
+          counted (``reason="stale_epoch"``) — merging it would double-count
+          everything the current epoch already shipped.
+        * same pid, same-or-higher epoch (a HEAL) → baselines are KEPT: the
+          process never died, its cumulative registry kept growing, so the
+          next delta is exactly the partition window's truth.
+        * pid change (a RESPAWN) → baselines reset to zero: the fresh
+          process's registry restarts from nothing and differencing against
+          the corpse's totals would swallow the first interval.
+        """
+        if not self.enabled or not isinstance(series, dict):
+            return {"accepted": False, "merged": 0}
+        rep = str(replica)
+        merged = 0
+        with self._worker_lock:
+            state = self._worker_last.get(replica)
+            if state is None:
+                state = {"pid": None, "epoch": int(epoch), "cum": {}}
+                self._worker_last[replica] = state
+            if int(epoch) < state["epoch"]:
+                self.record_telemetry_dropped(replica, "stale_epoch")
+                return {"accepted": False, "merged": 0}
+            if pid is not None and state["pid"] not in (None, pid):
+                state["cum"] = {}  # respawn: fresh process, fresh baselines
+            state["epoch"] = int(epoch)
+            if pid is not None:
+                state["pid"] = pid
+            cum = state["cum"]
+            plan: list[tuple] = []
+            for kind, is_histo in (("counters", False),
+                                   ("histo_sum", True)):
+                counts = series.get("histo_count", {}) if is_histo else {}
+                for key, value in (series.get(kind) or {}).items():
+                    name, labels = _parse_series_key(str(key))
+                    if name is None:
+                        self.record_telemetry_dropped(replica, "malformed")
+                        continue
+                    scoped = f"{kind}:{key}"
+                    if (scoped not in cum and
+                            len(cum) >= 2 * MAX_WORKER_SERIES_PER_REPLICA):
+                        self.record_telemetry_dropped(replica, "cardinality")
+                        continue
+                    last_sum, last_count = cum.get(scoped, (0.0, 0.0))
+                    delta_sum = max(float(value) - last_sum, 0.0)
+                    new_count = float(counts.get(key, 0.0))
+                    delta_count = max(new_count - last_count, 0.0)
+                    cum[scoped] = (float(value), new_count)
+                    if delta_sum <= 0.0 and delta_count <= 0.0:
+                        continue
+                    plan.append((name, labels, delta_sum, delta_count,
+                                 is_histo))
+        for name, labels, delta_sum, delta_count, is_histo in plan:
+            self._publish_worker_delta(rep, name, labels, delta_sum,
+                                          delta_count, is_histo)
+            merged += 1
+        return {"accepted": True, "merged": merged}
+
+    def record_telemetry_age(self, replica: int, age_s: float) -> None:
+        """Publish seconds since the last ACCEPTED telemetry frame from one
+        replica's worker — set each supervisor pass, so the gauge climbs
+        ~1 s/s through a partition and snaps back at the first post-heal
+        frame (the SentioTpuWorkerTelemetryStale signal)."""
+        if not self.enabled:
+            return
+        self.memory.set_gauge("worker_telemetry_age", (str(replica),),
+                              float(age_s))
+        gauge = self._prom.get("worker_telemetry_age")
+        if gauge is not None:
+            gauge.labels(str(replica)).set(float(age_s))
+
+    def record_telemetry_dropped(self, replica: int, reason: str,
+                                 n: int = 1) -> None:
+        """Count telemetry frames/series refused at merge (``reason``:
+        stale_epoch | cardinality | malformed)."""
+        if not self.enabled or n <= 0:
+            return
+        self.memory.inc("worker_telemetry_dropped", (str(replica), reason),
+                        float(n))
+        counter = self._prom.get("worker_telemetry_dropped")
+        if counter is not None:
+            counter.labels(str(replica), reason).inc(n)
+
+    def worker_telemetry_epoch(self, replica: int) -> Optional[int]:
+        """The last accepted telemetry epoch for one replica (None before
+        any frame merged) — the epoch-fence drill's assertion hook."""
+        with self._worker_lock:
+            state = self._worker_last.get(replica)
+            return None if state is None else state["epoch"]
 
     def record_replica_health(self, replica: int, state: str) -> None:
         """Publish one replica's health-state transition: the new state's

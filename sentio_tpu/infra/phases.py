@@ -59,6 +59,7 @@ __all__ = [
     "PhaseTimer",
     "duty_fractions",
     "phases_to_ms",
+    "sum_phase_totals",
 ]
 
 # the one bounded key set — flight `phase_ms`, the tick-phase histogram's
@@ -149,6 +150,22 @@ def phases_to_ms(phase_s: dict) -> dict:
     ONE definition — the pump's flight records and PhaseTimer.snapshot_ms
     must never drift (the chrome-trace golden fixture pins the format)."""
     return {k: round(v * 1e3, 3) for k, v in phase_s.items()}
+
+
+def sum_phase_totals(rows) -> tuple:
+    """Fold per-replica stats rows (each carrying cumulative
+    ``phase_seconds`` + ``duty_elapsed_s``) into fleet totals:
+    ``(phase_totals, duty_elapsed_s)``. ONE definition shared by
+    ``ReplicaSet.stats()`` and the telemetry merge path — the fleet's
+    phase arithmetic must not drift between replica modes. Rows without
+    phase data (a dead worker's fallback stats) contribute nothing."""
+    totals: dict[str, float] = {}
+    elapsed = 0.0
+    for row in rows:
+        for key, val in (row.get("phase_seconds") or {}).items():
+            totals[key] = totals.get(key, 0.0) + float(val)
+        elapsed += float(row.get("duty_elapsed_s", 0.0))
+    return totals, elapsed
 
 
 def duty_fractions(phase_totals: dict, elapsed_s: float) -> dict:

@@ -148,7 +148,7 @@ from sentio_tpu.infra.exceptions import (
     ServiceOverloaded,
 )
 from sentio_tpu.infra.metrics import get_metrics
-from sentio_tpu.infra.phases import duty_fractions
+from sentio_tpu.infra.phases import duty_fractions, sum_phase_totals
 from sentio_tpu.runtime.service import (
     PagedGenerationService,
     StreamProgress,
@@ -1639,6 +1639,16 @@ class ReplicaSet:
                 # duty cycle rides the same supervisor cadence, so the
                 # host/device/idle gauge stays fresh between scrapes
                 get_metrics().record_duty_cycle(idx, svc.duty_cycle())
+                # telemetry freshness gauge (process/socket replicas only —
+                # duck-typed so thread services stay untouched): seconds
+                # since the last ACCEPTED worker telemetry frame. The alert
+                # joins this against replica health: stale telemetry on a
+                # HEALTHY worker means the observability plane itself broke
+                tel_age = getattr(svc, "telemetry_age", None)
+                if callable(tel_age):
+                    age_t = tel_age()
+                    if age_t is not None:
+                        get_metrics().record_telemetry_age(idx, age_t)
             except Exception:  # noqa: BLE001 — telemetry best-effort
                 pass
             if age is not None and age > budget:
@@ -2059,12 +2069,7 @@ class ReplicaSet:
         # across replicas; the set-level duty cycle is summed busy time
         # over summed wall time — i.e. the per-replica AVERAGE split (the
         # per-replica rows below keep the individual gauges honest)
-        phase_totals: dict = {}
-        duty_elapsed = 0.0
-        for s in per:
-            for key, val in (s.get("phase_seconds") or {}).items():
-                phase_totals[key] = phase_totals.get(key, 0.0) + val
-            duty_elapsed += s.get("duty_elapsed_s", 0.0)
+        phase_totals, duty_elapsed = sum_phase_totals(per)
         if duty_elapsed > 0:
             agg["phase_seconds"] = {k: round(v, 6)
                                     for k, v in phase_totals.items()}

@@ -64,6 +64,7 @@ import socket
 import struct
 import threading
 import time
+from collections import deque
 from typing import Any, Optional
 
 from sentio_tpu.infra import faults
@@ -76,6 +77,7 @@ __all__ = [
     "FrameProtocolError",
     "PipeTransport",
     "SocketTransport",
+    "ClockSync",
     "send_hello",
     "expect_hello",
     "dial",
@@ -344,6 +346,59 @@ class SocketTransport:
 
     def fileno(self) -> int:
         return self._sock.fileno()
+
+
+class ClockSync:
+    """NTP-style clock-offset estimator over the worker ping loop.
+
+    Router and worker each run their own ``perf_counter`` — unrelated
+    origins, so a worker's flight timestamps are meaningless on the
+    router's timeline until an offset is known. Each ping/pong exchange
+    yields one sample (NTP's four-timestamp exchange collapsed to three:
+    the worker turns the pong around immediately, so its receive and
+    transmit stamps coincide):
+
+    * ``t_tx``  — router clock when the ping left
+    * ``t_peer`` — worker clock when the pong was stamped
+    * ``t_rx``  — router clock when the pong landed
+
+    ``offset = t_peer − (t_tx + rtt/2)`` under the symmetric-path
+    assumption; the error is bounded by ``rtt/2`` regardless of asymmetry,
+    so :meth:`estimate` returns the MINIMUM-RTT sample over a sliding
+    window (Cristian's algorithm / NTP clock-filter shape: the fastest
+    exchange had the least queueing and the tightest bound) and reports
+    ``uncertainty_s = rtt/2`` alongside it. Fleet Chrome traces re-base
+    worker timestamps by the offset and stamp the bound on the lane name —
+    causality within ±uncertainty is readable, beyond it is not claimed.
+
+    Thread-safe: the ping thread adds samples, trace exporters read."""
+
+    def __init__(self, window: int = 64) -> None:
+        self._lock = threading.Lock()
+        self._samples: deque = deque(maxlen=window)  # (rtt, offset)
+        self._total = 0
+
+    def add_sample(self, t_tx: float, t_rx: float, t_peer: float) -> None:
+        """Record one ping/pong exchange (router clocks ``t_tx``/``t_rx``,
+        worker clock ``t_peer``). A negative apparent RTT (clock jitter)
+        is clamped — the sample still carries offset information."""
+        rtt = max(float(t_rx) - float(t_tx), 0.0)
+        offset = float(t_peer) - (float(t_tx) + rtt / 2.0)
+        with self._lock:
+            self._samples.append((rtt, offset))
+            self._total += 1
+
+    def estimate(self) -> Optional[dict]:
+        """Best current estimate: the min-RTT sample in the window —
+        ``{"offset_s", "rtt_s", "uncertainty_s", "samples"}`` (offset is
+        worker-clock minus router-clock), or None before any sample."""
+        with self._lock:
+            if not self._samples:
+                return None
+            rtt, offset = min(self._samples)
+            total = self._total
+        return {"offset_s": offset, "rtt_s": rtt,
+                "uncertainty_s": rtt / 2.0, "samples": total}
 
 
 # --------------------------------------------------------------------------

@@ -120,6 +120,7 @@ from sentio_tpu.runtime.service import (
 from sentio_tpu.runtime.transport import (
     DEFAULT_FRAME_TIMEOUT_S,
     DEFAULT_MAX_FRAME_BYTES,
+    ClockSync,
     FrameProtocolError,
     PipeTransport,
     SocketTransport,
@@ -159,6 +160,21 @@ _F_OK = "ok"
 _F_ERR = "err"
 _F_TOK = "tok"
 _F_END = "end"
+# fleet telemetry plane (ISSUE 16): low-priority unsolicited frames — a
+# telemetry frame ships the worker's cumulative metrics registry + duty
+# snapshot at spec.telemetry_interval_s; a pong answers a timestamped ping
+# with the worker's clock so the router's ClockSync can estimate the offset
+_F_TELEMETRY = "telemetry"
+_F_PONG = "pong"
+
+# the bounded stats subset a telemetry frame carries (full svc.stats() is
+# an RPC surface — the cadence frame only ships what the router merges:
+# phase/duty for fleet duty gauges, occupancy/pool for {replica} gauges)
+_TELEMETRY_STAT_KEYS = (
+    "phase_seconds", "duty_elapsed_s", "duty_cycle", "active_slots",
+    "queued", "queued_inbox", "free_pages", "total_pages",
+    "pool_hbm_bytes",
+)
 
 
 @dataclass(frozen=True)
@@ -177,6 +193,12 @@ class WorkerSpec:
     # cadence of unsolicited status frames (the router-side supervisor's
     # probe source); also bounds how stale a liveness read can be
     status_interval_s: float = 0.1
+    # cadence of unsolicited telemetry frames (metrics-registry snapshot +
+    # duty/phase stats + flight high-water marks). 0 DISABLES the plane
+    # entirely: no telemetry thread, no pong frames, no clock stamps on
+    # pings — the wire protocol is byte-identical to the pre-telemetry
+    # baseline (the TELEMETRY_INTERVAL_S=0 parity contract)
+    telemetry_interval_s: float = 1.0
     # ---- socket transport (REPLICA_MODE=socket / REPLICA_WORKERS) ----
     # shared secret for the versioned registration handshake; the registry
     # rejects hellos that fail the constant-time compare
@@ -393,6 +415,44 @@ class _WorkerServer:
                 continue
             self._send(0, _F_STATUS, status)
 
+    def _telemetry_loop(self) -> None:
+        """Ship the fleet-telemetry frame at ``spec.telemetry_interval_s``:
+        the worker's CUMULATIVE metrics registry (the router differences
+        consecutive snapshots into deltas — cumulative-on-the-wire makes a
+        dropped frame lossless, the next one carries everything), the
+        bounded duty/occupancy stats subset, the flight ring's high-water
+        marks, and the clock stamps (pid / perf_counter / recorder origin)
+        the merge fence and trace re-basing need. Runs only when the
+        interval is > 0 — the hot path pays nothing either way (one extra
+        unsolicited frame per second rides the same transport send lock
+        status frames already take)."""
+        from sentio_tpu.infra.flight import get_flight_recorder
+        from sentio_tpu.infra.metrics import get_metrics
+
+        interval = max(self.spec.telemetry_interval_s, 0.05)
+        recorder = get_flight_recorder()
+        while not self._stop.wait(interval):
+            svc = self.svc
+            if svc is None:
+                continue
+            try:
+                stats = svc.stats()
+            except Exception:  # noqa: BLE001 — stats mid-teardown
+                stats = {}
+            try:
+                payload = {
+                    "series": get_metrics().export_worker_series(),
+                    "stats": {k: stats[k] for k in _TELEMETRY_STAT_KEYS
+                              if k in stats},
+                    "flight": recorder.highwater(),
+                    "pid": os.getpid(),
+                    "origin_s": recorder.origin(),
+                    "t_worker": time.perf_counter(),
+                }
+            except Exception:  # noqa: BLE001 — telemetry is best-effort
+                continue
+            self._send(0, _F_TELEMETRY, payload)
+
     def _handle(self, req_id: int, method: str, kwargs: dict) -> None:
         svc = self.svc
         try:
@@ -431,6 +491,31 @@ class _WorkerServer:
                            self._shadow_ids(svc.extract_inbox()))
             elif method == "duty_cycle":
                 self._send(req_id, _F_OK, svc.duty_cycle())
+            elif method == "fetch_flight":
+                # on-demand flight shipping: the detailed per-request tick/
+                # phase/verify data moves ONLY when asked (the 1 Hz frame
+                # carries counters; /debug/flight and `sentio trace --fleet`
+                # pay one RPC each) — the hot path never ships a tick
+                from sentio_tpu.infra.flight import get_flight_recorder
+
+                recorder = get_flight_recorder()
+                payload = {
+                    "pid": os.getpid(),
+                    "origin_s": recorder.origin(),
+                    "t_worker": time.perf_counter(),
+                }
+                if kwargs.get("t_tx") is not None:
+                    # echo the router's transmit stamp: the reply doubles
+                    # as a clock sample (pipe mode has no ping loop, so
+                    # this is its only offset source)
+                    payload["t_tx"] = kwargs["t_tx"]
+                rid = kwargs.get("request_id")
+                if rid is not None:
+                    payload["record"] = recorder.get(rid)
+                else:
+                    payload["ticks"] = recorder.timeline(kwargs.get("last"))
+                    payload["records"] = recorder.records()
+                self._send(req_id, _F_OK, payload)
             elif method == "reset_duty_cycle":
                 svc.reset_duty_cycle()
                 self._send(req_id, _F_OK, None)
@@ -528,6 +613,9 @@ class _WorkerServer:
         status = threading.Thread(target=self._status_loop,
                                   name="worker-status", daemon=True)
         status.start()
+        if self.spec.telemetry_interval_s > 0:
+            threading.Thread(target=self._telemetry_loop,
+                             name="worker-telemetry", daemon=True).start()
         # router-silence watch (socket links only): a half-open partition
         # can leave this side's reads idle forever while its writes still
         # land — no error will ever arrive, so silence IS the signal
@@ -565,7 +653,24 @@ class _WorkerServer:
                 self.outcome = "shutdown"
                 break
             if method == "__ping__":
-                continue  # router liveness probe: receiving it IS the point
+                # router liveness probe: receiving it IS the point. A ping
+                # carrying a transmit stamp (telemetry plane on) gets a
+                # pong with this side's clock — the router's ClockSync
+                # turns the exchange into an offset/RTT sample. Bare pings
+                # (telemetry off, or an older router) stay answerless:
+                # byte-identical to the pre-telemetry protocol.
+                t_tx = (kwargs.get("t_tx")
+                        if isinstance(kwargs, dict) else None)
+                if t_tx is not None:
+                    from sentio_tpu.infra.flight import get_flight_recorder
+
+                    self._send(0, _F_PONG, {
+                        "t_tx": t_tx,
+                        "t_worker": time.perf_counter(),
+                        "origin_s": get_flight_recorder().origin(),
+                        "pid": os.getpid(),
+                    })
+                continue
             if method == "stream_cancel":
                 with self._cancel_lock:
                     self._cancelled.add(int(kwargs["stream_id"]))
@@ -840,6 +945,16 @@ class ProcessReplica:
         self._status: dict = {}
         self._status_ts = 0.0
         self._last_stats: dict = {}
+        # fleet telemetry plane: last ACCEPTED telemetry frame (cached for
+        # stats overlays), its arrival stamp (the telemetry-age source),
+        # the worker flight recorder's perf_counter origin (trace
+        # re-basing), and the NTP-style offset estimator the ping loop
+        # feeds. Plain attribute writes from the dispatcher thread —
+        # GIL-atomic snapshots, same discipline as _status.
+        self._telemetry: dict = {}
+        self._telemetry_ts = 0.0
+        self._worker_origin_s: Optional[float] = None
+        self._clock = ClockSync()
         self.epoch = 0  # incarnation epoch of THIS connection (socket)
         self._proc = None
         self._transport = None
@@ -973,13 +1088,20 @@ class ProcessReplica:
         )
 
     def _ping_loop(self) -> None:
+        # with the telemetry plane on, pings carry a transmit stamp and the
+        # worker pongs with its clock — each round trip is one ClockSync
+        # offset sample. Telemetry off keeps the bare {} payload: the wire
+        # stays byte-identical to the pre-telemetry protocol.
+        stamp = self.spec.telemetry_interval_s > 0
         while True:
             time.sleep(self.ping_interval_s)
             with self._mutex:
                 if self._dead or self._closed:
                     return
             try:
-                self._send_frame((0, "__ping__", {}))
+                self._send_frame((0, "__ping__",
+                                  {"t_tx": time.perf_counter()}
+                                  if stamp else {}))
             except (TransportError, OSError):
                 self._on_death(
                     "worker link broken on ping (broken write)",
@@ -1040,6 +1162,12 @@ class ProcessReplica:
                 # plain attribute writes: GIL-atomic snapshot for probes
                 self._status = payload
                 self._status_ts = time.perf_counter()
+                continue
+            if kind == _F_TELEMETRY:
+                self._ingest_telemetry(payload, epoch)
+                continue
+            if kind == _F_PONG:
+                self._ingest_pong(payload)
                 continue
             call = None
             with self._mutex:
@@ -1656,10 +1784,137 @@ class ProcessReplica:
         try:
             self._last_stats = self._call("stats", {}, timeout_s=10.0)
         except Exception:  # noqa: BLE001 — dead replica: last known stats
-            return {**self._last_stats, **self._transport_stats(),
-                    "replica": self.replica_id, "worker_dead": 1}
+            out = {**self._last_stats, **self._transport_stats(),
+                   "replica": self.replica_id, "worker_dead": 1}
+            # a dead/partitioned worker's last telemetry frame still holds
+            # its cumulative phase ledger — fleet duty math keeps counting
+            # the seconds it actually burned instead of zeroing them
+            cached = (self._telemetry.get("stats")
+                      if self._telemetry else None) or {}
+            for key in ("phase_seconds", "duty_elapsed_s", "duty_cycle"):
+                if key not in out and key in cached:
+                    out[key] = cached[key]
+            return out
         self._last_stats.update(self._transport_stats())
+        self._last_stats.update(self._clock_stats())
         return self._last_stats
+
+    # ------------------------------------------------ fleet telemetry plane
+
+    def _ingest_telemetry(self, payload: dict, epoch: int) -> None:
+        """Dispatcher-thread sink for unsolicited telemetry frames: merge
+        the worker's cumulative series snapshot into the router collector
+        (epoch-fenced there — a healed worker's pre-partition buffer must
+        not double-count), then cache the frame for stats overlays and
+        zero the telemetry-age clock."""
+        from sentio_tpu.infra.metrics import get_metrics
+
+        metrics = get_metrics()
+        try:
+            res = metrics.merge_worker_series(
+                self.replica_id, payload.get("series") or {},
+                epoch=epoch, pid=payload.get("pid"))
+        except Exception:  # noqa: BLE001 — telemetry must not kill dispatch
+            logger.debug("replica %d telemetry merge failed",
+                         self.replica_id, exc_info=True)
+            return
+        if not res.get("accepted"):
+            return
+        self._telemetry = payload
+        self._telemetry_ts = time.perf_counter()
+        origin = payload.get("origin_s")
+        if origin is not None:
+            self._worker_origin_s = float(origin)
+        try:
+            metrics.record_telemetry_age(self.replica_id, 0.0)
+            stats = payload.get("stats") or {}
+            for key in ("pool_hbm_bytes", "free_pages", "active_slots",
+                        "queued"):
+                if stats.get(key) is not None:
+                    metrics.set_replica_stat(self.replica_id, key,
+                                             float(stats[key]))
+        except Exception:  # noqa: BLE001 — gauges are best-effort
+            pass
+
+    def _ingest_pong(self, payload: dict) -> None:
+        """Pong for a timestamped ping: one NTP-style clock sample.
+        ``offset = t_worker − (t_tx + rtt/2)`` inside ClockSync; the
+        worker's flight origin rides along for trace re-basing."""
+        try:
+            self._clock.add_sample(float(payload["t_tx"]),
+                                   time.perf_counter(),
+                                   float(payload["t_worker"]))
+            origin = payload.get("origin_s")
+            if origin is not None:
+                self._worker_origin_s = float(origin)
+        except (KeyError, TypeError, ValueError):
+            pass
+
+    def clock_sync(self) -> Optional[dict]:
+        """Current clock-offset estimate (min-RTT sample) or None before
+        the first pong/fetch round trip."""
+        return self._clock.estimate()
+
+    def telemetry_age(self) -> Optional[float]:
+        """Seconds since the last ACCEPTED telemetry frame, or None if the
+        worker never shipped one (telemetry off, or pre-first-frame)."""
+        if self._telemetry_ts <= 0:
+            return None
+        return time.perf_counter() - self._telemetry_ts
+
+    def _clock_stats(self) -> dict:
+        out: dict = {}
+        age = self.telemetry_age()
+        if age is not None:
+            out["telemetry_age_s"] = round(age, 3)
+        est = self._clock.estimate()
+        if est is not None:
+            out["clock_offset_s"] = round(est["offset_s"], 6)
+            out["clock_uncertainty_s"] = round(est["uncertainty_s"], 6)
+        return out
+
+    def fetch_flight(self, request_id: Optional[str] = None,
+                     last: Optional[int] = None,
+                     timeout_s: float = 5.0) -> dict:
+        """Pull flight data from the worker on demand: one request's
+        record (``request_id``) or the whole tick window + record table.
+        The reply echoes our transmit stamp, so every fetch doubles as a
+        clock sample — pipe mode (no ping loop) gets its alignment here.
+        Raises the replica's typed death error when the worker is gone."""
+        reply = self._call(
+            "fetch_flight",
+            {"request_id": request_id, "last": last,
+             "t_tx": time.perf_counter()},
+            timeout_s=timeout_s)
+        t_rx = time.perf_counter()
+        try:
+            if reply.get("t_tx") is not None:
+                self._clock.add_sample(float(reply["t_tx"]), t_rx,
+                                       float(reply["t_worker"]))
+            if reply.get("origin_s") is not None:
+                self._worker_origin_s = float(reply["origin_s"])
+        except (TypeError, ValueError, KeyError):
+            pass
+        reply["replica"] = self.replica_id
+        reply["epoch"] = self.epoch
+        reply["clock"] = self._clock.estimate()
+        return reply
+
+    def flight_shift_s(self, router_origin_s: float) -> tuple:
+        """``(shift_s, uncertainty_s)`` mapping this worker's flight
+        timeline onto the router's: ``t_router = t_worker_timeline +
+        shift``. Both recorders stamp relative to their own perf_counter
+        origin, so the shift is ``worker_origin − offset − router_origin``
+        (offset = worker clock minus router clock). Same-host Linux
+        processes share CLOCK_MONOTONIC, so offset ≈ 0 and the shift is
+        dominated by the origin difference. Uncertainty is None until a
+        clock sample exists (shift then assumes offset 0)."""
+        if self._worker_origin_s is None:
+            return 0.0, None
+        est = self._clock.estimate()
+        offset = est["offset_s"] if est else 0.0
+        shift = self._worker_origin_s - offset - router_origin_s
+        return shift, (est["uncertainty_s"] if est else None)
 
     # ------------------------------------------------ quarantine / handoff
 

@@ -785,12 +785,12 @@ def _publish_serving_gauges(container: DependencyContainer):
     # replica-labeled gauge says WHICH replica is hot (occupancy/queue/pool
     # per replica — the signals that justify or indict the router)
     for replica_stats in stats.get("replicas", ()):  # ReplicaSet only
-        rid = replica_stats.get("replica", 0)
+        replica = replica_stats.get("replica", 0)
         for key in ("active_slots", "queued", "queued_inbox", "free_pages",
                     "prefix_cache_pages", "prefix_hit_token_ratio",
                     "pool_hbm_bytes", "ttft_p50_ms", "completed", "shed"):
             if key in replica_stats:
-                m.set_replica_stat(rid, key, float(replica_stats[key]))
+                m.set_replica_stat(replica, key, float(replica_stats[key]))
     return stats
 
 
@@ -817,36 +817,111 @@ async def metrics_performance(request: web.Request) -> web.Response:
     )
 
 
+def _stitch_flight_record(container: DependencyContainer, request_id: str,
+                          record: dict) -> dict:
+    """Splice worker-side flight truth into a router flight record.
+
+    Thread-replica modes share one flight recorder, so the router record
+    already carries the engine section and tick window — stamp
+    ``engine_window: "local"`` and return. In process/socket mode the
+    engine lives in worker processes: issue ``fetch_flight`` to every
+    worker replica (the owner is whichever holds the record), re-base its
+    tick timestamps onto the router's perf_counter timeline via the
+    ClockSync shift, and merge the engine section in
+    (``engine_window: "stitched"``). Workers that are dead or partitioned
+    are reported EXPLICITLY in ``replicas_unavailable`` — a half-answer
+    must never be silently indistinguishable from a full one. Runs
+    blocking RPCs; call from a worker thread."""
+    from sentio_tpu.infra.flight import get_flight_recorder
+
+    service = container.peek("generation_service")
+    members = list(getattr(service, "_services", None)
+                   or ([service] if service is not None else []))
+    fetchable = [svc for svc in members
+                 if callable(getattr(svc, "fetch_flight", None))]
+    if not fetchable:
+        record["engine_window"] = "local"
+        return record
+    router_origin = get_flight_recorder().origin()
+    unavailable: list[dict] = []
+    stitched = False
+    for svc in fetchable:
+        try:
+            reply = svc.fetch_flight(request_id=request_id)
+        except Exception as exc:  # noqa: BLE001 — typed death, timeout, ...
+            unavailable.append({
+                "replica": getattr(svc, "replica_id", None),
+                "error": type(exc).__name__,
+            })
+            continue
+        wrec = reply.get("record")
+        if not wrec:
+            continue  # this worker never served the request
+        shift, bound = svc.flight_shift_s(router_origin)
+        engine = dict(wrec.get("engine") or {})
+        if engine.get("t_submit_s") is not None:
+            engine["t_submit_s"] = round(
+                float(engine["t_submit_s"]) + shift, 6)
+        merged_engine = dict(record.get("engine") or {})
+        merged_engine.update(engine)
+        record["engine"] = merged_engine
+        ticks = []
+        for tick in wrec.get("ticks") or []:
+            shifted = dict(tick)
+            if "t_s" in shifted:
+                shifted["t_s"] = round(float(shifted["t_s"]) + shift, 6)
+            ticks.append(shifted)
+        if ticks:
+            record["ticks"] = ticks
+        if wrec.get("ticks_truncated"):
+            record["ticks_truncated"] = True
+        record["engine_window"] = "stitched"
+        record["engine_replica"] = reply.get("replica")
+        record["engine_epoch"] = reply.get("epoch")
+        if bound is not None:
+            record["clock_uncertainty_s"] = round(bound, 6)
+        stitched = True
+        break
+    if not stitched:
+        # process/socket mode but no worker produced the record: the
+        # router-only view is all there is — say so, loudly
+        record["engine_window"] = "remote"
+    if unavailable:
+        record["replicas_unavailable"] = unavailable
+    return record
+
+
 async def debug_flight(request: web.Request) -> web.Response:
     """One completed (or in-flight) request's flight record: graph node
     timings joined with the engine-tick window its decode rode (occupancy,
     queue depth, prefill/decode splits, page-pool levels) plus TTFT/TPOT.
-    ``?format=chrome`` returns the record's window as a Chrome/Perfetto
-    trace instead (open the JSON in ui.perfetto.dev): the tick slices with
-    their phase decomposition, the request span, and the verify verdict on
-    one timeline. Auth-gated when auth is enabled — /debug is NOT in the
-    open-paths list, unlike /metrics — because records quote request shape
-    and timing."""
+    In process/socket replica mode the engine tick window lives in the
+    worker process — it is fetched on demand and clock-rebased into the
+    router record (``engine_window`` says which view you got: ``local`` /
+    ``stitched`` / ``remote``, with unreachable workers listed in
+    ``replicas_unavailable``). ``?format=chrome`` returns the (stitched)
+    record's window as a Chrome/Perfetto trace instead (open the JSON in
+    ui.perfetto.dev): the tick slices with their phase decomposition, the
+    request span, and the verify verdict on one timeline. Auth-gated when
+    auth is enabled — /debug is NOT in the open-paths list, unlike
+    /metrics — because records quote request shape and timing."""
     from sentio_tpu.infra.flight import get_flight_recorder
 
+    container: DependencyContainer = request.app["container"]
     request_id = request.match_info["request_id"]
-    if request.query.get("format") == "chrome":
-        from sentio_tpu.infra.chrome_trace import flight_to_chrome
-
-        trace = flight_to_chrome(request_id=request_id)
-        if trace is None:
-            raise web.HTTPNotFound(
-                text=json.dumps(
-                    {"error": f"no flight record for {request_id!r}"}),
-                content_type="application/json",
-            )
-        return web.json_response(trace)
     record = get_flight_recorder().get(request_id)
     if record is None:
         raise web.HTTPNotFound(
             text=json.dumps({"error": f"no flight record for {request_id!r}"}),
             content_type="application/json",
         )
+    record = await asyncio.to_thread(
+        _stitch_flight_record, container, request_id, record)
+    if request.query.get("format") == "chrome":
+        from sentio_tpu.infra.chrome_trace import build_chrome_trace
+
+        return web.json_response(build_chrome_trace(
+            record.pop("ticks", []), [record]))
     return web.json_response(record)
 
 

@@ -446,7 +446,8 @@ class DependencyContainer:
                             service_kwargs={**service_kwargs,
                                             "replica_id": i},
                             warm_prefix_text=warm_head,
-                        ), **({} if replica_mode != "socket" else dict(
+                        ), telemetry_interval_s=serve.telemetry_interval_s,
+                           **({} if replica_mode != "socket" else dict(
                             auth_token=auth_token,
                             reconnect=True,
                             max_frame_bytes=serve.socket_frame_max_bytes,

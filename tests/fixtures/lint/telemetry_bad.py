@@ -1,0 +1,47 @@
+"""Seeded telemetry-unbounded-labels violations (tests/test_lint.py pins
+the exact findings): record_*/merge call sites whose label values derive
+from request-scoped identifiers — the per-traffic series-cardinality
+explosion the bounded-label discipline exists to prevent. Line numbers
+matter to the test; edit with care."""
+
+
+def leaky_tenant_counter(metrics, tenant):
+    metrics.record_shed(tenant)  # FINDING: tenant name as a label value
+
+
+def leaky_request_gauge(metrics, state):
+    rid = state["request_id"]
+    metrics.set_replica_stat(0, rid, 1.0)  # FINDING: request id key
+
+
+def leaky_merge(metrics, payload):
+    metrics.merge_worker_series(  # FINDING: prompt-derived series dict
+        0, {"counters": {payload.prompt: 1.0}})
+
+
+def leaky_fstring(metrics, req):
+    metrics.record_compiles(f"user:{req.user_id}")  # FINDING: f-string label
+
+
+def bounded_reason(metrics):
+    metrics.record_shed("queue_full")  # clean: typed enum value
+
+
+def bounded_tenant_pair(metrics, tenant):
+    # clean: exempt by design — the tenant gauge set is capped by
+    # TenantFairQueue.MAX_TRACKED eviction
+    metrics.record_tenant_admitted(tenant)
+    metrics.record_tenant_shed(tenant, "fairness")
+
+
+def bounded_flight_tick(recorder, request_id):
+    # clean: flight record_tick is a deque, not a label space
+    recorder.record_tick(event="handoff", request_id=request_id)
+
+
+def suppressed_site(metrics, tenant_id):
+    metrics.record_events(tenant_id)  # lint: allow(telemetry-unbounded-labels)
+
+
+def not_telemetry(registry, request_id):
+    registry.note_request(request_id)  # clean: not a record_*/merge call

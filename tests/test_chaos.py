@@ -1099,6 +1099,15 @@ class TestResumableStreams:
         kw = dict(build_timeout_s=300.0, transport_mode="socket",
                   registry=registry, partition_timeout_s=1.0,
                   ping_interval_s=0.2, heal_grace_s=15.0)
+        # fresh collector for the drill: the zero-double-count assertion
+        # below is an EQUALITY against the worker's cumulative registry,
+        # which needs merge baselines that start at zero
+        from sentio_tpu.infra.metrics import (MetricsCollector, get_metrics,
+                                              set_metrics)
+
+        old_collector = get_metrics()
+        metrics = MetricsCollector()
+        set_metrics(metrics)
         p0, p1 = _build_parallel(lambda i: ProcessReplica(
             spec, tok, replica_id=i, **kw))
         old_pid, old_epoch = p0.pid, p0.epoch
@@ -1144,6 +1153,15 @@ class TestResumableStreams:
         watcher = threading.Thread(target=watch_detection, daemon=True)
         watcher.start()
         try:
+            # a PRE-partition telemetry frame must merge at the victim's
+            # original epoch — the fence assertions after heal need a
+            # baseline that the stale buffer could plausibly double-count
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and \
+                    metrics.worker_telemetry_epoch(0) != old_epoch:
+                time.sleep(0.05)
+            assert metrics.worker_telemetry_epoch(0) == old_epoch, (
+                "no pre-partition telemetry frame merged")
             stats_out: dict = {}
             it = rs.generate_stream(self.PROMPT, max_new_tokens=16,
                                     temperature=0.0, timeout_s=120,
@@ -1206,6 +1224,46 @@ class TestResumableStreams:
                 time.sleep(0.05)
             assert registry.stale_frames(0) > 0, (
                 "pre-partition frames were not stale-dropped")
+            # ISSUE 16: telemetry continuity across the heal. The healed
+            # incarnation's frames merge (the fence advances to its epoch)
+            # and the age gauge snaps back from its partition climb
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and \
+                    metrics.worker_telemetry_epoch(0) != healed.epoch:
+                time.sleep(0.05)
+            assert metrics.worker_telemetry_epoch(0) == healed.epoch, (
+                "healed worker's telemetry never merged")
+            assert healed.telemetry_age() is not None
+            age = metrics.memory.gauges.get("worker_telemetry_age('0',)")
+            assert age is not None and age < 10.0, (
+                f"telemetry age gauge never recovered: {age}")
+            # ZERO double count: the worker process survived the heal, so
+            # its cumulative registry is one monotone series — the router's
+            # merged total must EQUAL the last accepted cumulative. Any
+            # pre-partition frame slipping past the fence would telescope
+            # the deltas to MORE than the cumulative. (Retry around the
+            # 1 Hz cadence: a frame landing between the two reads moves
+            # both sides.)
+            for _ in range(20):
+                snap = (healed._telemetry or {}).get("series") or {}
+                counts = snap.get("histo_count") or {}
+                phase_keys = [k for k in counts
+                              if k.startswith("tick_phase(")]
+                totals_match = bool(phase_keys)
+                for key in phase_keys:
+                    phase = key[len("tick_phase('"):-len("',)")]
+                    merged = metrics.memory.counters.get(
+                        f"worker_tick_phase_ticks{('0', phase)}", 0.0)
+                    if merged != counts[key]:
+                        totals_match = False
+                        break
+                if totals_match and \
+                        (healed._telemetry or {}).get("series") is snap:
+                    break
+                time.sleep(0.3)
+            assert totals_match, (
+                "router totals drifted from the worker's cumulative "
+                "registry — pre-partition telemetry double-counted")
             # the healed set serves routed traffic
             ok = rs.generate("post partition routed sanity",
                              max_new_tokens=3, temperature=0.0,
@@ -1216,6 +1274,7 @@ class TestResumableStreams:
             faults.reset()
             rs.close()
             registry.close()
+            set_metrics(old_collector)
         deadline = time.monotonic() + 30
         while time.monotonic() < deadline and multiprocessing.active_children():
             time.sleep(0.05)

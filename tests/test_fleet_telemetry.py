@@ -1,0 +1,645 @@
+"""Fleet telemetry plane (ISSUE 16): cumulative-on-the-wire worker series
+merged into router fleet families under ``{replica}`` labels, the
+(pid, epoch) merge fence (HEAL keeps baselines, RESPAWN resets them, a
+stale epoch's buffered frame is DROPPED — never double-counted), the
+NTP-style ClockSync estimator, fleet Chrome-trace re-basing, the
+``/debug/flight`` stitch helper, and the ``TELEMETRY_INTERVAL_S=0``
+byte-parity contract on a ``_WorkerServer`` over a fake transport.
+
+Everything here is process-free: real collectors, real server threads,
+fake transports — the spawned-worker integration rides tests/test_worker.py
+and the chaos drill in tests/test_chaos.py.
+"""
+
+import os
+import queue
+import threading
+import time
+
+import pytest
+
+from sentio_tpu.infra.chrome_trace import _FLEET_PID_BASE, build_fleet_trace
+from sentio_tpu.infra.flight import FlightRecorder
+from sentio_tpu.infra.metrics import (
+    MAX_WORKER_SERIES_PER_REPLICA,
+    MetricsCollector,
+    set_metrics,
+)
+from sentio_tpu.infra.phases import TICK_PHASES, sum_phase_totals
+from sentio_tpu.runtime.transport import ClockSync, TransportError
+from sentio_tpu.runtime.worker import (
+    _F_PONG,
+    _F_READY,
+    _F_STATUS,
+    _F_TELEMETRY,
+    _TELEMETRY_STAT_KEYS,
+    ProcessReplica,
+    WorkerSpec,
+    _WorkerServer,
+)
+
+
+def _ctr(mc: MetricsCollector, name: str, *labels) -> float:
+    return mc.memory.counters.get(f"{name}{tuple(labels)}", 0.0)
+
+
+def _series(ticks: float, device_wait_s: float, device_wait_n: float) -> dict:
+    """A hand-built ``export_worker_series`` snapshot: one plain counter +
+    one tick-phase histogram series, both CUMULATIVE."""
+    key = "tick_phase('device_wait',)"
+    return {
+        "counters": {"ticks()": ticks},
+        "histo_sum": {key: device_wait_s},
+        "histo_count": {key: device_wait_n},
+    }
+
+
+class TestMergeWorkerSeries:
+    def test_cumulative_frames_difference_into_deltas(self):
+        rc = MetricsCollector()
+        res = rc.merge_worker_series(0, _series(5.0, 1.0, 4.0),
+                                     epoch=1, pid=111)
+        assert res["accepted"] and res["merged"] == 2
+        assert _ctr(rc, "worker_events", "0", "ticks") == 5.0
+        assert _ctr(rc, "worker_tick_phase_seconds", "0", "device_wait") == 1.0
+        assert _ctr(rc, "worker_tick_phase_ticks", "0", "device_wait") == 4.0
+        # the next frame carries the GROWN cumulative; only the delta lands
+        rc.merge_worker_series(0, _series(8.0, 1.5, 6.0), epoch=1, pid=111)
+        assert _ctr(rc, "worker_events", "0", "ticks") == 8.0
+        assert _ctr(rc, "worker_tick_phase_seconds", "0",
+                    "device_wait") == pytest.approx(1.5)
+        assert _ctr(rc, "worker_tick_phase_ticks", "0", "device_wait") == 6.0
+
+    def test_dropped_frame_is_lossless(self):
+        # cumulative-on-the-wire: skipping an intermediate frame changes
+        # nothing — the next frame carries everything
+        rc = MetricsCollector()
+        rc.merge_worker_series(0, _series(5.0, 1.0, 4.0), epoch=1, pid=111)
+        # frame with ticks=8 lost in transit; ticks=13 arrives
+        rc.merge_worker_series(0, _series(13.0, 2.0, 9.0), epoch=1, pid=111)
+        assert _ctr(rc, "worker_events", "0", "ticks") == 13.0
+        assert _ctr(rc, "worker_tick_phase_ticks", "0", "device_wait") == 9.0
+
+    def test_stale_epoch_frame_dropped_whole(self):
+        rc = MetricsCollector()
+        rc.merge_worker_series(0, _series(5.0, 1.0, 4.0), epoch=2, pid=111)
+        # a healed worker's pre-partition buffer drains late: epoch 1
+        res = rc.merge_worker_series(0, _series(9.0, 3.0, 8.0),
+                                     epoch=1, pid=111)
+        assert not res["accepted"] and res["merged"] == 0
+        assert _ctr(rc, "worker_events", "0", "ticks") == 5.0
+        assert _ctr(rc, "worker_telemetry_dropped", "0", "stale_epoch") == 1.0
+        assert rc.worker_telemetry_epoch(0) == 2
+
+    def test_heal_same_pid_keeps_baselines_no_double_count(self):
+        rc = MetricsCollector()
+        rc.merge_worker_series(0, _series(5.0, 1.0, 4.0), epoch=1, pid=111)
+        # HEAL: same process, higher epoch — its registry never reset, so
+        # the merged total must equal the last cumulative, not 5 + 8
+        rc.merge_worker_series(0, _series(8.0, 1.5, 6.0), epoch=3, pid=111)
+        assert _ctr(rc, "worker_events", "0", "ticks") == 8.0
+        assert rc.worker_telemetry_epoch(0) == 3
+
+    def test_respawn_pid_change_resets_baselines(self):
+        rc = MetricsCollector()
+        rc.merge_worker_series(0, _series(10.0, 2.0, 7.0), epoch=1, pid=111)
+        # RESPAWN: fresh process restarts its registry from zero — its
+        # first cumulative IS the first delta
+        rc.merge_worker_series(0, _series(4.0, 0.5, 2.0), epoch=2, pid=222)
+        assert _ctr(rc, "worker_events", "0", "ticks") == 14.0
+        assert _ctr(rc, "worker_tick_phase_ticks", "0", "device_wait") == 9.0
+
+    def test_regressing_cumulative_clamps_to_zero(self):
+        rc = MetricsCollector()
+        rc.merge_worker_series(0, _series(10.0, 2.0, 7.0), epoch=1, pid=111)
+        rc.merge_worker_series(0, _series(3.0, 2.0, 7.0), epoch=1, pid=111)
+        assert _ctr(rc, "worker_events", "0", "ticks") == 10.0
+        # the regressed value becomes the new baseline; growth resumes
+        rc.merge_worker_series(0, _series(5.0, 2.0, 7.0), epoch=1, pid=111)
+        assert _ctr(rc, "worker_events", "0", "ticks") == 12.0
+
+    def test_cardinality_guard_refuses_new_series_past_cap(self):
+        rc = MetricsCollector()
+        cap = 2 * MAX_WORKER_SERIES_PER_REPLICA
+        flood = {"counters": {f"k{i}()": 1.0 for i in range(cap + 5)}}
+        res = rc.merge_worker_series(0, flood, epoch=1, pid=111)
+        assert res["accepted"] and res["merged"] == cap
+        assert _ctr(rc, "worker_telemetry_dropped", "0", "cardinality") == 5.0
+        # KNOWN series keep merging under the cap — only new ones refused
+        grown = {"counters": {"k0()": 3.0}}
+        rc.merge_worker_series(0, grown, epoch=1, pid=111)
+        assert _ctr(rc, "worker_events", "0", "k0") == 3.0
+
+    def test_malformed_key_dropped_not_fatal(self):
+        rc = MetricsCollector()
+        res = rc.merge_worker_series(
+            0, {"counters": {"bad(((": 9.0, "ticks()": 2.0}},
+            epoch=1, pid=111)
+        assert res["accepted"] and res["merged"] == 1
+        assert _ctr(rc, "worker_events", "0", "ticks") == 2.0
+        assert _ctr(rc, "worker_telemetry_dropped", "0", "malformed") == 1.0
+
+    def test_known_label_structures_keep_their_labels(self):
+        # verify/xla_compiles have bounded label sets — they keep label
+        # structure instead of flattening into the one `series` label
+        rc = MetricsCollector()
+        rc.merge_worker_series(0, {"counters": {
+            "verify('sync', 'pass')": 3.0,
+            "xla_compiles('decode',)": 2.0,
+        }}, epoch=1, pid=111)
+        assert _ctr(rc, "worker_verify", "0", "sync", "pass") == 3.0
+        assert _ctr(rc, "worker_compiles", "0", "decode") == 2.0
+
+    def test_telemetry_age_gauge(self):
+        rc = MetricsCollector()
+        rc.record_telemetry_age(1, 12.5)
+        assert rc.memory.gauges["worker_telemetry_age('1',)"] == 12.5
+        rc.record_telemetry_age(1, 0.0)
+        assert rc.memory.gauges["worker_telemetry_age('1',)"] == 0.0
+
+
+# the frozen /metrics manifest (satellite 3): the fleet families a
+# process/socket-mode router must expose once worker telemetry merges —
+# renaming any of these breaks dashboards and the monitoring.yaml rules
+FLEET_SERIES_MANIFEST = (
+    "sentio_tpu_worker_tick_phase_seconds_total",
+    "sentio_tpu_worker_tick_phase_ticks_total",
+    "sentio_tpu_worker_verify_total",
+    "sentio_tpu_worker_compiles_total",
+    "sentio_tpu_worker_telemetry_age_seconds",
+    "sentio_tpu_replica_stat",
+)
+
+
+class TestSeriesManifestParity:
+    @pytest.fixture()
+    def pair(self):
+        """(worker-side collector, router collector): the worker records
+        through the SAME record_* API thread mode uses, the router merges
+        its exported snapshot — parity by construction."""
+        pytest.importorskip("prometheus_client")
+        wc = MetricsCollector()
+        wc.record_tick_phases({p: 0.001 for p in TICK_PHASES})
+        wc.record_verify("sync", "pass")
+        wc.record_compiles("decode")
+        rc = MetricsCollector()
+        res = rc.merge_worker_series(0, wc.export_worker_series(),
+                                     epoch=1, pid=42)
+        assert res["accepted"]
+        rc.record_telemetry_age(0, 0.0)
+        rc.set_replica_stat(0, "pool_hbm_bytes", 2048.0)
+        return wc, rc
+
+    def test_fleet_manifest_present_with_replica_label(self, pair):
+        _, rc = pair
+        text = rc.export_prometheus().decode()
+        for family in FLEET_SERIES_MANIFEST:
+            lines = [ln for ln in text.splitlines()
+                     if ln.startswith(family + "{")]
+            assert lines, f"{family} missing from /metrics"
+            assert any('replica="0"' in ln for ln in lines), (
+                f"{family} lost its replica label:\n" + "\n".join(lines))
+        # every tick phase appears — the phase label set is the full
+        # bounded TICK_PHASES vocabulary, same as thread mode's histogram
+        for phase in TICK_PHASES:
+            assert f'phase="{phase}"' in text
+
+    def test_pool_bytes_rides_the_same_gauge_as_thread_mode(self, pair):
+        # thread mode publishes pool occupancy via set_replica_stat; the
+        # telemetry ingest calls the SAME method — the exported sample is
+        # byte-identical across replica modes
+        _, rc = pair
+        tc = MetricsCollector()
+        tc.set_replica_stat(0, "pool_hbm_bytes", 2048.0)
+        want = [ln for ln in tc.export_prometheus().decode().splitlines()
+                if ln.startswith("sentio_tpu_replica_stat{")]
+        got = [ln for ln in rc.export_prometheus().decode().splitlines()
+               if ln.startswith("sentio_tpu_replica_stat{")]
+        assert want and want == got
+
+    def test_worker_side_names_unchanged(self, pair):
+        # the worker's own registry keeps the native (un-prefixed) names —
+        # the fleet view is a ROUTER rename, not a worker one
+        wc, _ = pair
+        text = wc.export_prometheus().decode()
+        assert "sentio_tpu_tick_phase_seconds" in text
+        assert "sentio_tpu_verify_total" in text
+        assert "sentio_tpu_xla_compiles_total" in text
+
+
+class TestClockSync:
+    def test_empty_estimator_returns_none(self):
+        assert ClockSync().estimate() is None
+
+    def test_offset_and_rtt_from_one_exchange(self):
+        cs = ClockSync()
+        cs.add_sample(10.0, 10.010, 100.0)
+        est = cs.estimate()
+        assert est["rtt_s"] == pytest.approx(0.010)
+        assert est["offset_s"] == pytest.approx(100.0 - 10.005)
+        assert est["uncertainty_s"] == pytest.approx(0.005)
+        assert est["samples"] == 1
+
+    def test_min_rtt_sample_wins(self):
+        # Cristian's algorithm: the fastest exchange has the tightest bound
+        cs = ClockSync()
+        cs.add_sample(10.0, 10.010, 100.0)
+        cs.add_sample(20.0, 20.002, 110.0)
+        est = cs.estimate()
+        assert est["rtt_s"] == pytest.approx(0.002)
+        assert est["offset_s"] == pytest.approx(110.0 - 20.001)
+        assert est["uncertainty_s"] == pytest.approx(0.001)
+        assert est["samples"] == 2
+
+    def test_negative_rtt_clamped(self):
+        cs = ClockSync()
+        cs.add_sample(5.0, 4.9, 50.0)  # clock jitter: t_rx < t_tx
+        est = cs.estimate()
+        assert est["rtt_s"] == 0.0 and est["uncertainty_s"] == 0.0
+        assert est["offset_s"] == pytest.approx(45.0)
+
+    def test_window_evicts_old_samples(self):
+        cs = ClockSync(window=2)
+        cs.add_sample(1.0, 1.001, 10.0)   # best rtt, but will be evicted
+        cs.add_sample(2.0, 2.020, 20.0)
+        cs.add_sample(3.0, 3.010, 30.0)
+        est = cs.estimate()
+        assert est["rtt_s"] == pytest.approx(0.010)
+        assert est["samples"] == 3
+
+
+class TestPhaseAndFlightHelpers:
+    def test_sum_phase_totals_folds_rows(self):
+        rows = [
+            {"phase_seconds": {"device_wait": 1.0, "other": 0.5},
+             "duty_elapsed_s": 2.0},
+            {"phase_seconds": {"device_wait": 0.25}, "duty_elapsed_s": 1.0},
+            {"worker_dead": 1},  # dead worker's fallback row: contributes 0
+        ]
+        totals, elapsed = sum_phase_totals(rows)
+        assert totals == {"device_wait": 1.25, "other": 0.5}
+        assert elapsed == pytest.approx(3.0)
+
+    def test_flight_origin_and_highwater(self):
+        rec = FlightRecorder(max_ticks=4, max_requests=2)
+        assert isinstance(rec.origin(), float)
+        for i in range(6):
+            rec.record_tick(tick=i, pump_ms=1.0)
+        rec.start_request("a")
+        rec.start_request("b")
+        rec.start_request("c")  # evicts oldest finished/active per policy
+        hw = rec.highwater()
+        assert hw["ticks_recorded"] == 6
+        assert hw["ticks_retained"] == 4  # ring bounded
+        assert hw["requests_retained"] <= 2
+        # the cadence frame ships ONLY these bounded marks
+        assert set(hw) == {"ticks_recorded", "ticks_retained",
+                           "requests_retained", "requests_dropped"}
+
+
+class TestFleetTrace:
+    def _workers(self, uncertainty=0.0005):
+        worker_tick = {"tick": 7, "t_s": 2.0, "pump_ms": 10.0,
+                       "phase_ms": {"other": 10.0}, "replica": 0}
+        worker_record = {
+            "request_id": "w1", "t_start_s": 1.5, "latency_ms": 100.0,
+            "engine": {"replica_id": 0, "t_submit_s": 1.6, "tokens": 4},
+        }
+        return [{"replica": 1, "epoch": 2, "shift_s": 3.0,
+                 "uncertainty_s": uncertainty,
+                 "ticks": [worker_tick], "records": [worker_record]}]
+
+    def test_worker_lane_pid_name_and_rebase(self):
+        router_tick = {"tick": 1, "t_s": 1.0, "pump_ms": 4.0, "replica": 0}
+        trace = build_fleet_trace(self._workers(),
+                                  router_ticks=[router_tick])
+        events = trace["traceEvents"]
+        pid = _FLEET_PID_BASE * 2 + 2  # replica 1, epoch 2
+        names = {e["pid"]: e["args"]["name"] for e in events
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert names[pid] == "worker 1 epoch 2 (clock ±0.5ms)"
+        assert names[0] == "replica 0"  # router lane untouched
+        ticks = {e["name"]: e for e in events if e.get("ph") == "X"}
+        # worker tick re-based: ends at t_s + shift, starts pump_ms earlier
+        assert ticks["tick 7"]["pid"] == pid
+        assert ticks["tick 7"]["ts"] == pytest.approx((5.0 - 0.010) * 1e6)
+        assert ticks["tick 1"]["ts"] == pytest.approx((1.0 - 0.004) * 1e6)
+        # worker request span shifted onto the router timeline too
+        req = ticks["request w1"]
+        assert req["pid"] == pid
+        assert req["ts"] == pytest.approx(4.5 * 1e6)
+
+    def test_unaligned_clock_is_stated_not_guessed(self):
+        trace = build_fleet_trace(self._workers(uncertainty=None))
+        names = [e["args"]["name"] for e in trace["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"]
+        assert "worker 1 epoch 2 (clock unaligned)" in names
+
+    def test_incarnations_get_separate_lanes(self):
+        tick = {"tick": 1, "t_s": 1.0, "pump_ms": 1.0, "replica": 0}
+        workers = [
+            {"replica": 0, "epoch": 1, "shift_s": 0.0,
+             "uncertainty_s": 0.0, "ticks": [dict(tick)], "records": []},
+            {"replica": 0, "epoch": 2, "shift_s": 0.0,
+             "uncertainty_s": 0.0, "ticks": [dict(tick)], "records": []},
+        ]
+        trace = build_fleet_trace(workers)
+        pids = {e["pid"] for e in trace["traceEvents"]}
+        assert _FLEET_PID_BASE + 1 in pids and _FLEET_PID_BASE + 2 in pids
+
+
+class TestReplicaClockIngest:
+    def _bare(self) -> ProcessReplica:
+        pr = object.__new__(ProcessReplica)
+        pr.replica_id = 0
+        pr.epoch = 1
+        pr._telemetry = {}
+        pr._telemetry_ts = 0.0
+        pr._worker_origin_s = None
+        pr._clock = ClockSync()
+        return pr
+
+    def test_flight_shift_math(self):
+        pr = self._bare()
+        assert pr.flight_shift_s(5.0) == (0.0, None)  # origin unknown
+        pr._worker_origin_s = 100.0
+        shift, bound = pr.flight_shift_s(5.0)
+        assert shift == pytest.approx(95.0) and bound is None  # offset≈0
+        pr._clock.add_sample(10.0, 10.002, 52.001)  # offset = 42.0 exactly
+        shift, bound = pr.flight_shift_s(5.0)
+        assert shift == pytest.approx(100.0 - 42.0 - 5.0)
+        assert bound == pytest.approx(0.001)
+
+    def test_ingest_pong_feeds_estimator(self):
+        pr = self._bare()
+        t0 = time.perf_counter()
+        pr._ingest_pong({"t_tx": t0 - 0.002, "t_worker": t0,
+                         "origin_s": 7.5})
+        assert pr._worker_origin_s == 7.5
+        assert pr.clock_sync() is not None
+        pr._ingest_pong({})  # malformed pong: ignored, not fatal
+        assert pr.clock_sync()["samples"] == 1
+
+    def test_ingest_telemetry_merges_and_fences(self):
+        pr = self._bare()
+        fresh = MetricsCollector()
+        set_metrics(fresh)
+        try:
+            payload = {"series": _series(5.0, 1.0, 4.0), "pid": 111,
+                       "origin_s": 7.5,
+                       "stats": {"pool_hbm_bytes": 2048.0, "free_pages": 60}}
+            pr._ingest_telemetry(payload, epoch=2)
+            assert _ctr(fresh, "worker_events", "0", "ticks") == 5.0
+            assert pr.telemetry_age() is not None
+            assert pr.telemetry_age() < 5.0
+            assert pr._worker_origin_s == 7.5
+            assert fresh.memory.gauges["worker_telemetry_age('0',)"] == 0.0
+            assert fresh.memory.gauges[
+                "replica_0_pool_hbm_bytes()"] == 2048.0
+            # a stale-epoch frame neither merges nor refreshes the cache
+            ts_before = pr._telemetry_ts
+            pr._ingest_telemetry(
+                {"series": _series(9.0, 2.0, 8.0), "pid": 111}, epoch=1)
+            assert _ctr(fresh, "worker_events", "0", "ticks") == 5.0
+            assert pr._telemetry_ts == ts_before
+            assert _ctr(fresh, "worker_telemetry_dropped", "0",
+                        "stale_epoch") == 1.0
+        finally:
+            set_metrics(None)
+
+
+class _FakeTransport:
+    """In-process stand-in for a pipe/socket transport: ``send`` collects
+    frames, ``recv`` drains a queue (``(frame, epoch)`` tuples), a sentinel
+    raises ``TransportError`` like a router hangup would."""
+
+    _CLOSE = object()
+
+    def __init__(self):
+        self.sent: list = []
+        self._q: queue.Queue = queue.Queue()
+
+    def send(self, frame) -> None:
+        self.sent.append(frame)
+
+    def recv(self, timeout_s=None):
+        try:
+            item = self._q.get(timeout=timeout_s)
+        except queue.Empty:
+            return None
+        if item is self._CLOSE:
+            raise TransportError("router hung up")
+        return item, 0
+
+    def push(self, frame) -> None:
+        self._q.put((0, *frame) if len(frame) == 2 else frame)
+
+    def kinds(self) -> list:
+        return [f[1] for f in list(self.sent)]
+
+
+class _StubEngine:
+    page_size = 8
+    max_slots = 2
+
+
+class _StubService:
+    engine = _StubEngine()
+    max_queue = 4
+    default_timeout_s = 1.0
+    default_deadline_s = 1.0
+    retry_budget = 0
+    tick_stall_budget_s = 0.0
+    broken = False
+    closed = False
+    tick_failure_count = 0
+    pump_leaked_count = 0
+
+    def heartbeat_age(self):
+        return 0.0
+
+    def backlog(self):
+        return 0
+
+    def projected_wait(self):
+        return 0.0
+
+    def duty_cycle(self):
+        return {"host": 0.0, "device": 0.0, "idle": 1.0}
+
+    def stats(self):
+        return {"phase_seconds": {"other": 0.1}, "duty_elapsed_s": 0.2,
+                "duty_cycle": self.duty_cycle(), "queued": 0,
+                "internal_debug_blob": object()}  # NOT a telemetry key
+
+
+def _run_server(telemetry_interval_s: float, status_interval_s: float = 30.0):
+    spec = WorkerSpec(factory_kwargs={},
+                      status_interval_s=status_interval_s,
+                      telemetry_interval_s=telemetry_interval_s)
+    transport = _FakeTransport()
+    server = _WorkerServer(transport, spec, svc=_StubService())
+    thread = threading.Thread(target=server.run, daemon=True)
+    thread.start()
+    deadline = time.perf_counter() + 5.0
+    while _F_READY not in transport.kinds():
+        assert time.perf_counter() < deadline, "worker never sent ready"
+        time.sleep(0.01)
+    return server, transport, thread
+
+
+def _shutdown(transport: _FakeTransport, thread: threading.Thread) -> None:
+    transport.push((0, "__shutdown__", {}))
+    thread.join(timeout=5.0)
+    assert not thread.is_alive()
+
+
+class TestWorkerServerTelemetryPlane:
+    def test_interval_zero_is_byte_identical(self):
+        """TELEMETRY_INTERVAL_S=0 parity: no telemetry thread, no pong for
+        a bare ping — the wire carries exactly the pre-telemetry frames."""
+        server, transport, thread = _run_server(telemetry_interval_s=0.0)
+        try:
+            transport.push((0, "__ping__", {}))  # bare: telemetry off
+            time.sleep(0.5)
+            kinds = transport.kinds()
+            assert _F_TELEMETRY not in kinds
+            assert _F_PONG not in kinds
+            assert [k for k in kinds if k not in (_F_STATUS,)] == [_F_READY]
+            assert not any(t.name == "worker-telemetry"
+                           for t in threading.enumerate())
+        finally:
+            _shutdown(transport, thread)
+        assert server.outcome == "shutdown"
+
+    def test_interval_on_ships_frames_and_pongs(self):
+        server, transport, thread = _run_server(telemetry_interval_s=0.05)
+        try:
+            deadline = time.perf_counter() + 5.0
+            while _F_TELEMETRY not in transport.kinds():
+                assert time.perf_counter() < deadline, "no telemetry frame"
+                time.sleep(0.01)
+            frame = next(f for f in list(transport.sent)
+                         if f[1] == _F_TELEMETRY)
+            req_id, _, payload = frame
+            assert req_id == 0  # unsolicited
+            assert set(payload["series"]) == {"counters", "histo_count",
+                                              "histo_sum"}
+            # stats ship ONLY the bounded subset — never arbitrary keys
+            assert set(payload["stats"]) <= set(_TELEMETRY_STAT_KEYS)
+            assert payload["stats"]["phase_seconds"] == {"other": 0.1}
+            assert set(payload["flight"]) == {
+                "ticks_recorded", "ticks_retained", "requests_retained",
+                "requests_dropped"}
+            assert payload["pid"] == os.getpid()
+            assert isinstance(payload["origin_s"], float)
+            assert isinstance(payload["t_worker"], float)
+            # a stamped ping gets a pong echoing the transmit stamp
+            transport.push((0, "__ping__", {"t_tx": 123.25}))
+            while _F_PONG not in transport.kinds():
+                assert time.perf_counter() < deadline, "no pong"
+                time.sleep(0.01)
+            pong = next(f for f in list(transport.sent) if f[1] == _F_PONG)
+            assert pong[2]["t_tx"] == 123.25
+            assert pong[2]["pid"] == os.getpid()
+            assert isinstance(pong[2]["t_worker"], float)
+        finally:
+            _shutdown(transport, thread)
+
+    def test_link_loss_ends_incarnation(self):
+        server, transport, thread = _run_server(telemetry_interval_s=0.0)
+        transport._q.put(_FakeTransport._CLOSE)
+        thread.join(timeout=5.0)
+        assert not thread.is_alive()
+        assert server.outcome == "link_lost"
+
+
+class TestStitchFlightRecord:
+    def _router_record(self) -> dict:
+        return {"request_id": "r1", "t_start_s": 10.0, "latency_ms": 50.0,
+                "engine": {"queue_depth": 1}}
+
+    def _worker_record(self) -> dict:
+        return {
+            "request_id": "r1",
+            "engine": {"t_submit_s": 1.0, "tokens": 5, "replica_id": 0},
+            "ticks": [{"tick": 3, "t_s": 2.0, "pump_ms": 4.0,
+                       "phase_ms": {"device_wait": 3.0, "other": 1.0}}],
+            "ticks_truncated": True,
+        }
+
+    class _Svc:
+        def __init__(self, record=None, fail=False, replica_id=0,
+                     shift=(3.0, 0.0005)):
+            self.replica_id = replica_id
+            self.epoch = 2
+            self._record = record
+            self._fail = fail
+            self._shift = shift
+
+        def fetch_flight(self, request_id=None, last=None, timeout_s=5.0):
+            if self._fail:
+                raise RuntimeError("worker gone")
+            return {"record": self._record, "replica": self.replica_id,
+                    "epoch": self.epoch}
+
+        def flight_shift_s(self, router_origin_s):
+            return self._shift
+
+    class _Container:
+        def __init__(self, service):
+            self._service = service
+
+        def peek(self, name):
+            return self._service
+
+    def _stitch(self, services, record):
+        pytest.importorskip("aiohttp")
+        from sentio_tpu.serve.app import _stitch_flight_record
+
+        class _ReplicaSet:
+            _services = services
+
+        return _stitch_flight_record(self._Container(_ReplicaSet()),
+                                     "r1", record)
+
+    def test_thread_mode_is_local(self):
+        pytest.importorskip("aiohttp")
+        from sentio_tpu.serve.app import _stitch_flight_record
+
+        record = self._router_record()
+        out = _stitch_flight_record(self._Container(object()), "r1", record)
+        assert out["engine_window"] == "local"
+        assert "replicas_unavailable" not in out
+
+    def test_stitched_record_rebases_and_conserves(self):
+        out = self._stitch([self._Svc(record=self._worker_record())],
+                           self._router_record())
+        assert out["engine_window"] == "stitched"
+        assert out["engine_replica"] == 0 and out["engine_epoch"] == 2
+        # worker truth merged IN, router-only fields kept
+        assert out["engine"]["tokens"] == 5
+        assert out["engine"]["queue_depth"] == 1
+        assert out["engine"]["t_submit_s"] == pytest.approx(4.0)  # +shift
+        assert out["ticks"][0]["t_s"] == pytest.approx(5.0)
+        assert out["ticks_truncated"] is True
+        assert out["clock_uncertainty_s"] == pytest.approx(0.0005)
+        # per-tick phase conservation survives the re-base (tier-1 gate:
+        # the shift moves timestamps, never durations)
+        for tick in out["ticks"]:
+            assert sum(tick["phase_ms"].values()) == pytest.approx(
+                tick["pump_ms"], rel=0.05, abs=0.5)
+
+    def test_dead_worker_named_not_silent(self):
+        out = self._stitch(
+            [self._Svc(fail=True, replica_id=0),
+             self._Svc(record=self._worker_record(), replica_id=1)],
+            self._router_record())
+        assert out["engine_window"] == "stitched"
+        assert out["replicas_unavailable"] == [
+            {"replica": 0, "error": "RuntimeError"}]
+
+    def test_no_owner_is_remote(self):
+        out = self._stitch([self._Svc(record=None)], self._router_record())
+        assert out["engine_window"] == "remote"
+        assert "ticks" not in out

@@ -129,6 +129,24 @@ class TestSeededFixtures:
         assert [(f.rule, f.line) for f in got] == [("baseexception-swallow", 7)]
         # the cleanup-and-reraise handler produced nothing
 
+    def test_telemetry_fixture_exact_findings(self):
+        """Request-derived metric label values (the series-cardinality
+        explosion the fleet telemetry merge would ship from every worker):
+        the tenant-labeled shed counter, the request-id gauge key, the
+        prompt-keyed merge dict, and the user-id f-string all fire; the
+        typed-enum reason, the capped tenant-fairness pair, the
+        deque-bounded flight record_tick, the allow-marked site, and the
+        non-telemetry call produce nothing."""
+        got = _findings("telemetry_bad.py")
+        assert [(f.rule, f.line) for f in got] == [
+            ("telemetry-unbounded-labels", 9),
+            ("telemetry-unbounded-labels", 14),
+            ("telemetry-unbounded-labels", 18),
+            ("telemetry-unbounded-labels", 23),
+        ]
+        assert "cardinality" in got[0].message
+        assert "'rid'" in got[1].message
+
     def test_clean_fixture_is_clean(self):
         assert _findings("clean.py") == []
 

@@ -176,6 +176,61 @@ class TestSocketParity:
                            temperature=0.0, timeout_s=120)
         assert ok.finish_reason in ("stop", "length")
 
+    def test_fleet_telemetry_merges_with_replica_labels(self, socket_worker):
+        """ISSUE 16 acceptance (socket half): a worker-served request's
+        engine truth is reachable from the router — unsolicited telemetry
+        frames merge into the router collector under ``{replica}`` labels
+        at the worker's incarnation epoch, the ping loop's pongs feed the
+        ClockSync estimator, and ``fetch_flight`` returns the request's
+        record with per-tick phase conservation intact."""
+        from sentio_tpu.infra.metrics import (MetricsCollector, get_metrics,
+                                              set_metrics)
+
+        sock, registry = socket_worker
+        old_collector = get_metrics()
+        fresh = MetricsCollector()
+        set_metrics(fresh)
+        try:
+            r = sock.generate("socket telemetry probe prompt",
+                              max_new_tokens=4, temperature=0.0,
+                              timeout_s=120, request_id="tel-sock-1")
+            assert r.finish_reason in ("stop", "length")
+            # the 1 Hz frame lands and merges at THIS incarnation's epoch
+            # (the pipe-parity worker also ships as replica 0 at epoch 0;
+            # the fence keeps the highest epoch's truth)
+            deadline = time.monotonic() + 15
+            while (time.monotonic() < deadline
+                   and fresh.worker_telemetry_epoch(0) != sock.epoch):
+                time.sleep(0.05)
+            assert fresh.worker_telemetry_epoch(0) == sock.epoch
+            assert fresh.memory.gauges["worker_telemetry_age('0',)"] == 0.0
+            text = fresh.export_prometheus().decode()
+            for family in ("sentio_tpu_worker_tick_phase_seconds_total",
+                           "sentio_tpu_worker_tick_phase_ticks_total"):
+                lines = [ln for ln in text.splitlines()
+                         if ln.startswith(family + "{")]
+                assert lines and all('replica="0"' in ln for ln in lines), \
+                    f"{family} missing its replica label on /metrics"
+        finally:
+            set_metrics(old_collector)
+        # pongs (stamped pings every 0.2s) → same-host offset near zero
+        est = sock.clock_sync()
+        assert est is not None and est["samples"] >= 1
+        assert abs(est["offset_s"]) < 0.5
+        reply = sock.fetch_flight(request_id="tel-sock-1")
+        assert reply["epoch"] == sock.epoch
+        rec = reply["record"]
+        assert rec is not None and rec["request_id"] == "tel-sock-1"
+        assert rec["engine"].get("t_submit_s") is not None
+        assert rec["ticks"], "engine tick window must cross the socket"
+        for tick in rec["ticks"]:
+            if tick.get("phase_ms") and tick.get("pump_ms") is not None:
+                assert sum(tick["phase_ms"].values()) == pytest.approx(
+                    tick["pump_ms"], rel=0.05, abs=0.5)
+        stats = sock.stats()
+        assert stats.get("telemetry_age_s") is not None
+        assert "clock_offset_s" in stats and "clock_uncertainty_s" in stats
+
     def test_sigkill_typed_then_reregisters_at_higher_epoch(
             self, socket_worker):
         """LAST (kills the module worker) — ISSUE 15 acceptance: a real
@@ -338,6 +393,63 @@ class TestProcessParity:
         with pytest.raises(ReplicaUnavailable):
             pr.generate("after drain-close", max_new_tokens=2, timeout_s=10)
         assert pid not in [p.pid for p in multiprocessing.active_children()]
+
+    def test_fetch_flight_and_stitch_over_the_pipe(self, worker):
+        """ISSUE 16 acceptance (pipe half): the worker's flight record —
+        engine section + tick window with conserved phases — comes back
+        on demand over the PIPE transport (no ping loop there: the
+        fetch's echoed transmit stamp is the clock source), and the
+        ``/debug/flight`` stitch helper splices it into a router record as
+        ``engine_window: "stitched"``."""
+        rid = "tel-pipe-1"
+        r = worker.generate("pipe flight stitch probe prompt",
+                            max_new_tokens=4, temperature=0.0,
+                            timeout_s=120, request_id=rid)
+        assert r.finish_reason in ("stop", "length")
+        reply = worker.fetch_flight(request_id=rid)
+        rec = reply["record"]
+        assert rec is not None and rec["request_id"] == rid
+        assert rec["engine"].get("t_submit_s") is not None
+        assert rec["ticks"], "engine tick window must cross the pipe"
+        for tick in rec["ticks"]:
+            if tick.get("phase_ms") and tick.get("pump_ms") is not None:
+                assert sum(tick["phase_ms"].values()) == pytest.approx(
+                    tick["pump_ms"], rel=0.05, abs=0.5)
+        # the echoed t_tx made the fetch double as a clock sample
+        assert reply["clock"] is not None
+        assert worker.clock_sync()["samples"] >= 1
+        # full-window fetch (sentio trace --fleet's shape)
+        full = worker.fetch_flight()
+        assert full["ticks"] and isinstance(full["records"], list)
+        # end-to-end stitch: real RPC, real clock shift, real record
+        pytest.importorskip("aiohttp")
+        from sentio_tpu.infra.flight import get_flight_recorder
+        from sentio_tpu.serve.app import _stitch_flight_record
+
+        shift, bound = worker.flight_shift_s(
+            get_flight_recorder().origin())
+        assert bound is not None
+
+        class _Members:
+            _services = [worker]
+
+        class _Container:
+            @staticmethod
+            def peek(name):
+                return _Members()
+
+        router_record = {"request_id": rid, "t_start_s": 1.0,
+                         "engine": {"queue_depth": 0}}
+        out = _stitch_flight_record(_Container(), rid, router_record)
+        assert out["engine_window"] == "stitched"
+        assert out["engine_replica"] == 0
+        assert out["engine"]["queue_depth"] == 0  # router fields kept
+        assert out["engine"].get("t_submit_s") is not None
+        assert out["ticks"] and "replicas_unavailable" not in out
+        for tick in out["ticks"]:
+            if tick.get("phase_ms") and tick.get("pump_ms") is not None:
+                assert sum(tick["phase_ms"].values()) == pytest.approx(
+                    tick["pump_ms"], rel=0.05, abs=0.5)
 
     def test_sigkill_fails_inflight_typed_then_respawns(self, worker):
         """LAST (kills the module worker): a real SIGKILL mid-request fails
