@@ -1,0 +1,294 @@
+"""Static lock-order graph + deadlock gate (whole-program).
+
+Builds a digraph over lock identities — ``ClassName.attr`` for
+``with self.<attr>:`` acquisitions, ``module.name`` for module-level
+locks — with an edge A→B wherever B is acquired while A is held:
+
+* **lexical nesting** — ``with self._a: ... with self._b:`` (and the
+  in-order items of ``with self._a, self._b:``);
+* **lock-held contracts** — a method whose ``def`` carries
+  ``# lock-held: <lock>`` treats that lock as held for its whole body;
+* **one level of call propagation** — inside ``with self._a:``, a call
+  that resolves through the :mod:`.threads` call graph to a function
+  that itself acquires ``_b`` contributes A→B. One level only: deeper
+  chains are covered transitively by each callee's own edges, because
+  every function's acquisitions are analyzed in its own right.
+
+Any cycle in the digraph is a ``lock-order-inversion`` finding — two
+threads walking the cycle from different entry edges can deadlock. Each
+edge that closes a cycle is reported at its acquisition site with the
+return path spelled out. A lexical self-edge (re-acquiring the lock you
+lexically hold) is reported too: on a plain ``threading.Lock`` that is
+not an ordering hazard but an immediate single-thread deadlock.
+
+Lock identity is by *name*, not object: every instance of a class shares
+one node per lock attribute. That is exactly the granularity the
+ordering discipline needs (order between two instances' ``._mutex`` is
+as undefined as between two different locks) at the cost of not
+distinguishing deliberate instance hierarchies — none exist in this
+tree, and one would deserve a rename anyway.
+
+``sentio lint --lock-graph`` dumps the graph (nodes, edges with sites,
+cycles) as JSON for offline inspection.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from sentio_tpu.analysis.findings import Finding
+from sentio_tpu.analysis.threads import FuncInfo, Program
+
+__all__ = ["check_lock_order", "build_lock_graph", "LockGraph"]
+
+RULE = "lock-order-inversion"
+
+
+@dataclass
+class LockEdge:
+    src_lock: str
+    dst_lock: str
+    path: str
+    line: int
+    via: str               # "nested" | "call"
+    same_instance: bool    # both locks on the same object (self/self)
+    func: str
+
+
+@dataclass
+class LockGraph:
+    locks: set[str] = field(default_factory=set)
+    edges: list[LockEdge] = field(default_factory=list)
+    adj: dict[str, set[str]] = field(default_factory=dict)
+
+    def add(self, edge: LockEdge) -> None:
+        self.locks.add(edge.src_lock)
+        self.locks.add(edge.dst_lock)
+        self.edges.append(edge)
+        self.adj.setdefault(edge.src_lock, set()).add(edge.dst_lock)
+
+    def reaches(self, start: str, goal: str) -> Optional[list[str]]:
+        """Shortest lock path start→…→goal, or None."""
+        if start == goal:
+            return [start]
+        parent: dict[str, str] = {}
+        queue = [start]
+        seen = {start}
+        while queue:
+            cur = queue.pop(0)
+            for nxt in sorted(self.adj.get(cur, ())):
+                if nxt in seen:
+                    continue
+                parent[nxt] = cur
+                if nxt == goal:
+                    path = [goal]
+                    while path[-1] != start:
+                        path.append(parent[path[-1]])
+                    return list(reversed(path))
+                seen.add(nxt)
+                queue.append(nxt)
+        return None
+
+    def cycles(self) -> list[list[str]]:
+        """One representative cycle per inversion edge (deduped)."""
+        out: list[list[str]] = []
+        seen: set[tuple[str, ...]] = set()
+        for edge in self.edges:
+            back = self.reaches(edge.dst_lock, edge.src_lock)
+            if back is None:
+                continue
+            cycle = back  # dst ... src; closing edge src->dst implied
+            canon = tuple(sorted(cycle))
+            if canon not in seen:
+                seen.add(canon)
+                out.append(cycle)
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "locks": sorted(self.locks),
+            "edges": [
+                {
+                    "from": e.src_lock, "to": e.dst_lock, "path": e.path,
+                    "line": e.line, "via": e.via, "func": e.func,
+                    "same_instance": e.same_instance,
+                }
+                for e in sorted(self.edges, key=lambda e: (
+                    e.src_lock, e.dst_lock, e.path, e.line))
+            ],
+            "cycles": self.cycles(),
+        }
+
+
+# ---------------------------------------------------------------- building
+
+
+def _item_locks(node: ast.With, info: FuncInfo,
+                prog: Program) -> list[tuple[str, bool, int]]:
+    """(lock id, same_instance, line) for each bare lock item, in
+    acquisition order."""
+    out = []
+    for item in node.items:
+        expr = item.context_expr
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and info.class_name):
+            out.append((f"{info.class_name}.{expr.attr}", True, expr.lineno))
+        elif isinstance(expr, ast.Name):
+            locks = prog.module_locks.get(info.module, {})
+            if expr.id in locks:
+                out.append((locks[expr.id], True, expr.lineno))
+    return out
+
+
+def _held_at_entry(info: FuncInfo) -> list[str]:
+    """Locks the whole body may assume held, from # lock-held: markers
+    (qualified by the enclosing class; the `_locked` suffix convention
+    names no specific lock so it cannot seed an ordering edge)."""
+    fn = info.node
+    held = []
+    first_body_line = fn.body[0].lineno if getattr(fn, "body", None) else fn.lineno
+    for line in range(fn.lineno, first_body_line + 1):
+        marker = info.src.lock_held_marker(line)
+        if marker:
+            held.append(f"{info.class_name}.{marker}"
+                        if info.class_name else marker)
+    return held
+
+
+def _acquired_locks(info: FuncInfo, prog: Program) -> list[tuple[str, int]]:
+    """Every lock this function acquires anywhere in its immediate body."""
+    out = []
+    for w in info.withs:
+        for lock, _same, line in _item_locks(w, info, prog):
+            out.append((lock, line))
+    return out
+
+
+def build_lock_graph(prog: Program) -> LockGraph:
+    graph = LockGraph()
+    for info in prog.functions.values():
+        _function_edges(prog, info, graph)
+    return graph
+
+
+def _function_edges(prog: Program, info: FuncInfo, graph: LockGraph) -> None:
+    base_held = _held_at_entry(info)
+
+    def note(held: list[str], lock: str, line: int, via: str,
+             same_instance: bool) -> None:
+        for h in held:
+            if h == lock and via == "call":
+                # a call-propagated same-name edge usually crosses
+                # instances (rs helper taking another replica's lock of
+                # the same class) — object identity is not static, so
+                # only the lexical re-acquisition is reported as a
+                # self-deadlock
+                continue
+            graph.add(LockEdge(
+                src_lock=h, dst_lock=lock, path=info.src.rel, line=line,
+                via=via, same_instance=same_instance, func=info.key[1],
+            ))
+
+    def visit(node: ast.AST, held: list[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # separate function: runs on its own thread/time
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                visit(item, held)
+            inner = list(held)
+            for lock, same, line in _item_locks(node, info, prog):
+                note(inner, lock, line, "nested", same)
+                inner = inner + [lock]
+            for stmt in node.body:
+                visit(stmt, inner)
+            return
+        if isinstance(node, ast.Call) and held:
+            callee = _resolve(prog, info, node.func)
+            if callee is not None:
+                ci = prog.functions[callee]
+                for lock, line in _acquired_locks(ci, prog):
+                    same = (
+                        isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                        and ci.class_name == info.class_name
+                    )
+                    note(held, lock, node.lineno, "call", same)
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for child in ast.iter_child_nodes(info.node):
+        visit(child, base_held)
+
+
+def _resolve(prog: Program, info: FuncInfo, fn: ast.expr):
+    # lightweight per-call-site resolution: self/cls methods, lexical
+    # names, and the unique-name method index. Import-table edges matter
+    # little for lock ordering (locks live on classes) and the full
+    # resolver needs the build-time tables the Program no longer holds.
+    from sentio_tpu.analysis import threads as _t
+
+    if isinstance(fn, ast.Name):
+        return info.visible.get(fn.id)
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        if fn.value.id in ("self", "cls") and info.class_name:
+            return _t._method_on_class(prog, info.module, info.class_name,
+                                       fn.attr)
+        if fn.value.id in prog.classes:
+            return _t._method_on_class(prog, info.module, fn.value.id,
+                                       fn.attr)
+    if isinstance(fn, ast.Attribute) and not fn.attr.startswith("__") \
+            and fn.attr not in _t._GENERIC_METHODS:
+        owners = prog.method_index.get(fn.attr, [])
+        if len(owners) == 1:
+            return owners[0]
+    return None
+
+
+# ----------------------------------------------------------------- the rule
+
+
+def check_lock_order(prog: Program) -> list[Finding]:
+    graph = build_lock_graph(prog)
+    findings: list[Finding] = []
+    reported: set[tuple[str, str]] = set()
+    src_by_rel = {s.rel: s for _t, s in prog.files}
+
+    for edge in sorted(graph.edges,
+                       key=lambda e: (e.path, e.line, e.src_lock, e.dst_lock)):
+        if (edge.src_lock, edge.dst_lock) in reported:
+            continue
+        if edge.src_lock == edge.dst_lock:
+            if edge.same_instance and edge.via == "nested":
+                reported.add((edge.src_lock, edge.dst_lock))
+                src = src_by_rel.get(edge.path)
+                f = src and src.finding(
+                    RULE, edge.line,
+                    f"{edge.func} re-acquires {edge.src_lock} while "
+                    f"lexically holding it — immediate deadlock on a "
+                    f"non-reentrant lock",
+                )
+                if f:
+                    findings.append(f)
+            continue
+        back = graph.reaches(edge.dst_lock, edge.src_lock)
+        if back is None:
+            continue
+        reported.add((edge.src_lock, edge.dst_lock))
+        src = src_by_rel.get(edge.path)
+        if src is None:
+            continue
+        f = src.finding(
+            RULE, edge.line,
+            f"{edge.func} acquires {edge.dst_lock} while holding "
+            f"{edge.src_lock}, but the reverse order "
+            f"{' -> '.join(back)} also exists — two threads entering "
+            f"from opposite edges deadlock; pick one global order",
+        )
+        if f is not None:
+            findings.append(f)
+    return findings

@@ -26,11 +26,13 @@ from sentio_tpu.analysis.findings import (
 from sentio_tpu.analysis.blocking import check_blocking
 from sentio_tpu.analysis.forkcheck import check_fork
 from sentio_tpu.analysis.hygiene import check_hygiene
+from sentio_tpu.analysis.lockorder import build_lock_graph, check_lock_order
 from sentio_tpu.analysis.locks import check_locks
 from sentio_tpu.analysis.phasing import check_phase_timer
 from sentio_tpu.analysis.retrace import check_retrace
 from sentio_tpu.analysis.sockcheck import check_sockets
 from sentio_tpu.analysis.telemetry import check_telemetry
+from sentio_tpu.analysis.threads import build_program, check_thread_model
 
 __all__ = ["lint_paths", "run_gate", "main", "DEFAULT_BASELINE"]
 
@@ -40,6 +42,27 @@ DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
 
 RULES = (check_retrace, check_locks, check_hygiene, check_blocking,
          check_phase_timer, check_fork, check_sockets, check_telemetry)
+
+# whole-program rules: run once over every parsed file together, so the
+# thread-role call graph and the lock-order digraph see cross-module paths
+PROGRAM_RULES = (check_thread_model, check_lock_order)
+
+#: every finding id the analyzer can emit (--json reports this so gate
+#: consumers know which rules ran; syntax-error is the parse fallback)
+RULE_IDS = (
+    "retrace-unbounded-static", "retrace-traced-branch",
+    "retrace-traced-cast", "retrace-host-state",
+    "lock-discipline",
+    "wall-clock-duration", "baseexception-swallow",
+    "join-no-timeout", "supervisor-blocking-wait",
+    "phase-timer-under-lock",
+    "no-fork",
+    "socket-no-timeout",
+    "telemetry-unbounded-labels",
+    "thread-role", "cross-thread-race",
+    "lock-order-inversion",
+    "syntax-error",
+)
 
 
 def _iter_py_files(path: Path):
@@ -59,30 +82,56 @@ def _rel(path: Path) -> str:
         return path.as_posix()
 
 
-def lint_file(path: Path) -> list[Finding]:
+def _parse_file(path: Path) -> tuple[Optional[ast.Module], SourceFile,
+                                     list[Finding]]:
     text = path.read_text(encoding="utf-8", errors="replace")
     src = SourceFile(path=path, rel=_rel(path), text=text)
     try:
-        tree = ast.parse(text)
+        return ast.parse(text), src, []
     except SyntaxError as exc:
-        return [Finding(
+        return None, src, [Finding(
             rule="syntax-error", path=src.rel,
             line=exc.lineno or 1,
             message=f"file does not parse: {exc.msg}",
             context=src.line_text(exc.lineno or 1).strip(),
         )]
-    findings: list[Finding] = []
-    for rule in RULES:
-        findings.extend(rule(tree, src))
+
+
+def lint_file(path: Path) -> list[Finding]:
+    """Per-file rules only (whole-program rules need ``lint_paths``)."""
+    tree, src, findings = _parse_file(path)
+    if tree is not None:
+        for rule in RULES:
+            findings.extend(rule(tree, src))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 
 
-def lint_paths(paths: Sequence[str | Path]) -> list[Finding]:
+def parse_paths(paths: Sequence[str | Path]) -> tuple[
+        list[tuple[ast.Module, SourceFile]], list[Finding]]:
+    """Parse every .py under ``paths`` once; returns (files, parse errors)."""
+    files: list[tuple[ast.Module, SourceFile]] = []
     findings: list[Finding] = []
     for raw in paths:
-        for f in _iter_py_files(Path(raw)):
-            findings.extend(lint_file(f))
+        for p in _iter_py_files(Path(raw)):
+            tree, src, errs = _parse_file(p)
+            findings.extend(errs)
+            if tree is not None:
+                files.append((tree, src))
+    return files, findings
+
+
+def lint_paths(paths: Sequence[str | Path]) -> list[Finding]:
+    """All rules: per-file rules on each file, then the whole-program
+    rules (thread-role/race, lock order) over every file together."""
+    files, findings = parse_paths(paths)
+    for tree, src in files:
+        for rule in RULES:
+            findings.extend(rule(tree, src))
+    program = build_program(files)
+    for prule in PROGRAM_RULES:
+        findings.extend(prule(program))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 
 
@@ -133,7 +182,19 @@ def main(argv: Optional[list[str]] = None) -> int:
                              "(prunes stale entries)")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="machine-readable output")
+    parser.add_argument("--lock-graph", action="store_true",
+                        dest="lock_graph",
+                        help="dump the static lock-order digraph (nodes, "
+                             "acquisition edges with sites, cycles) as "
+                             "JSON and exit")
     args = parser.parse_args(argv)
+
+    if args.lock_graph:
+        files, _errs = parse_paths(args.paths or [PACKAGE_ROOT])
+        graph = build_lock_graph(build_program(files))
+        payload = graph.to_json()
+        print(json.dumps(payload, indent=1))
+        return 0 if not payload["cycles"] else 1
 
     result = run_gate(args.paths or None, baseline_path=args.baseline)
 
@@ -153,6 +214,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args.as_json:
         print(json.dumps({
             "ok": result.ok,
+            "rules": list(RULE_IDS),
             "new": [dict(f.to_json(), line=f.line) for f in result.new],
             "baselined": [dict(f.to_json(), line=f.line)
                           for f in result.matched],

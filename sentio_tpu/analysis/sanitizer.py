@@ -21,10 +21,26 @@ checks, all free when disabled:
   refcount equals the number of active slots whose pinned chain crosses
   it). A leaked or double-owned page fails THE TICK THAT LEAKED IT, not a
   pool-exhaustion three workloads later.
+* **lock order** — every :class:`OwnedLock` acquisition is checked against
+  a global acquired-while-holding edge set; the first blocking acquire
+  that reverses an already-observed edge raises *before* taking the lock,
+  so the inversion is reported on the run that merely COULD have
+  deadlocked, not the run that did. Reentrant blocking acquire of the
+  same (non-reentrant) lock raises for the same reason.
+* **locksets** — :func:`guard_locksets` is a class decorator that reads
+  the class's own ``# guarded-by:`` annotations (via the static
+  checker's parser) and enforces them dynamically, Eraser-style: each
+  annotated attribute carries a candidate lockset, intersected with the
+  thread's held locks at every write once a second thread has touched
+  it; an empty intersection raises. This is the dynamic complement of
+  the lexical ``lock-discipline`` rule — it sees through ``_locked``
+  suffixes and ``# lock-held:`` markers, because it checks what the
+  thread actually holds.
 """
 
 from __future__ import annotations
 
+import functools
 import os
 import threading
 from typing import Optional
@@ -39,6 +55,8 @@ __all__ = [
     "engine_guard",
     "bind_engine_owner",
     "check_engine_invariants",
+    "guard_locksets",
+    "held_lock_names",
 ]
 
 
@@ -51,13 +69,93 @@ def enabled() -> bool:
     return os.environ.get("SENTIO_SANITIZE", "") == "1"
 
 
+# ----------------------------------------------------- runtime lock order
+
+# Per-thread stack of (lock name, lock id) currently held, maintained by
+# OwnedLock. The stack is what makes both dynamic checks possible: the
+# order checker reads it to learn what is held while acquiring, the
+# lockset checker reads it to learn what is held while writing.
+_held = threading.local()
+
+# Acquired-while-holding edges observed so far, process-global and keyed by
+# lock NAME (make_lock names are class-qualified, so two instances of one
+# class share an edge — same aliasing the static lock graph uses). Value is
+# a human-readable note of who established the edge, for the error message.
+_order_edges: dict = {}
+_order_guard = threading.Lock()  # plain lock: must not feed its own stack
+
+
+def _held_stack() -> list:
+    stack = getattr(_held, "stack", None)
+    if stack is None:
+        stack = _held.stack = []
+    return stack
+
+
+def held_lock_names() -> frozenset:
+    """Names of every :class:`OwnedLock` the calling thread holds."""
+    return frozenset(name for name, _ in _held_stack())
+
+
+def _reset_lock_order() -> None:
+    """Test hook: forget every observed acquisition edge."""
+    with _order_guard:
+        _order_edges.clear()
+
+
+def _note_acquire(name: str, obj: object) -> None:
+    """Pre-acquire check for a blocking acquire: raises on reentrancy or on
+    the first observed order inversion. Runs BEFORE the underlying acquire,
+    so a raise leaves nothing newly held."""
+    stack = _held_stack()
+    cur = threading.current_thread()
+    for held_name, held_id in stack:
+        if held_id == id(obj):
+            raise SanitizerError(
+                f"self-deadlock: thread {cur.name!r} blocking on "
+                f"{name!r} while already holding it (non-reentrant lock)"
+            )
+    if not stack:
+        return
+    with _order_guard:
+        for held_name, _hid in stack:
+            if held_name == name:
+                continue  # distinct instances of one class: no order info
+            if (name, held_name) in _order_edges:
+                raise SanitizerError(
+                    f"lock-order inversion: thread {cur.name!r} acquiring "
+                    f"{name!r} while holding {held_name!r}, but the reverse "
+                    f"order was already observed "
+                    f"({_order_edges[(name, held_name)]}) — two threads "
+                    f"entering from opposite edges deadlock; pick one "
+                    f"global order"
+                )
+            _order_edges.setdefault(
+                (held_name, name),
+                f"{held_name} -> {name} by thread {cur.name!r}",
+            )
+
+
+def _push_held(name: str, obj: object) -> None:
+    _held_stack().append((name, id(obj)))
+
+
+def _pop_held(obj: object) -> None:
+    stack = _held_stack()
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i][1] == id(obj):
+            del stack[i]
+            return
+
+
 # ------------------------------------------------------------ lock ownership
 
 
 class OwnedLock:
     """``threading.Lock`` recording its owning thread, so lock-held helpers
     can assert the caller actually holds it. Not reentrant (neither is the
-    lock it wraps)."""
+    lock it wraps). Every acquisition feeds the per-thread held stack and
+    the global order-edge set (see the lock-order section above)."""
 
     def __init__(self, name: str = "lock") -> None:
         self.name = name
@@ -65,13 +163,17 @@ class OwnedLock:
         self._owner: Optional[threading.Thread] = None
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if blocking:
+            _note_acquire(self.name, self)
         got = self._lock.acquire(blocking, timeout)
         if got:
             self._owner = threading.current_thread()
+            _push_held(self.name, self)
         return got
 
     def release(self) -> None:
         self._owner = None
+        _pop_held(self)
         self._lock.release()
 
     def __enter__(self) -> "OwnedLock":
@@ -126,6 +228,11 @@ class ThreadGuard:
         cur = threading.current_thread()
         owner = self._owner
         if owner is None or owner is cur:
+            # baselined cross-thread-race: the guard's own owner field is
+            # deliberately lock-free — it exists to DETECT cross-thread
+            # entry, and a mutex here would serialize every engine call the
+            # sanitizer observes; a torn owner read merely reports the race
+            # it was about to report anyway
             self._owner = cur
             return
         if not owner.is_alive():
@@ -153,6 +260,141 @@ def bind_engine_owner(engine) -> None:
     guard = getattr(engine, "_san", None)
     if guard is not None:
         guard.bind()
+
+
+# --------------------------------------------------------- lockset checker
+
+
+class _LocksetState:
+    """Per-instance lockset tracking for one guard_locksets instance.
+
+    ``spec`` maps attr -> declared lock attr name (from the class's own
+    ``# guarded-by:`` annotations). ``records`` maps attr ->
+    ``[last_writer_thread, candidate_lockset_or_None]``; ``None`` marks the
+    exclusive phase (only one thread has ever written the attr — Eraser's
+    initialization grace period, which also absorbs single-threaded use)."""
+
+    __slots__ = ("spec", "records")
+
+    def __init__(self, spec: dict) -> None:
+        self.spec = spec
+        self.records: dict = {}
+
+
+# class -> attr->lock spec parsed from its source (lazily; None = no spec)
+_lockset_specs: dict = {}
+
+
+def _lockset_spec(cls) -> dict:
+    spec = _lockset_specs.get(cls)
+    if spec is None:
+        import ast
+        import inspect
+        import textwrap
+        from pathlib import Path
+
+        from sentio_tpu.analysis.findings import SourceFile
+        from sentio_tpu.analysis.locks import collect_guarded
+
+        try:
+            text = textwrap.dedent(inspect.getsource(cls))
+            tree = ast.parse(text)
+        except (OSError, TypeError, SyntaxError):
+            spec = {}
+        else:
+            src = SourceFile(path=Path("<runtime>"), rel="<runtime>", text=text)
+            gc = collect_guarded(tree, src).get(cls.__name__)
+            # mutex-guarded attrs only: THREAD_LOCKS ownership is enforced
+            # by ThreadGuard, not locksets
+            spec = dict(gc.guarded) if gc else {}
+        _lockset_specs[cls] = spec
+    return spec
+
+
+def _lockset_write(obj, state: _LocksetState, attr: str) -> None:
+    cur = threading.current_thread()
+    rec = state.records.get(attr)
+    if rec is None:
+        state.records[attr] = [cur, None]
+        return
+    if rec[1] is None and rec[0] is cur:
+        return  # still exclusive
+    held = held_lock_names()
+    cand = held if rec[1] is None else rec[1] & held
+    rec[0] = cur
+    rec[1] = cand
+    if not cand:
+        raise SanitizerError(
+            f"lockset violation: {type(obj).__name__}.{attr} "
+            f"(guarded-by: {state.spec[attr]}) written by thread "
+            f"{cur.name!r} and its candidate lockset is now empty — "
+            f"no single lock protects every write; this write holds "
+            f"{sorted(held) or 'nothing'}"
+        )
+
+
+def _install_lockset_setattr(cls) -> None:
+    if "_san_setattr_installed" in cls.__dict__:
+        return
+    orig = cls.__setattr__
+
+    def __setattr__(self, name, value):
+        state = self.__dict__.get("_san_lockset_state")
+        if state is not None and name in state.spec:
+            _lockset_write(self, state, name)
+        orig(self, name, value)
+
+    cls.__setattr__ = __setattr__
+    cls._san_setattr_installed = True
+
+
+def _arm_locksets(obj, cls) -> None:
+    spec = _lockset_spec(cls)
+    if not spec:
+        return
+    # only attrs whose declared lock is an OwnedLock on this instance are
+    # observable (a plain Lock never feeds the held stack, so checking
+    # against it would be all false positives)
+    usable = {
+        attr: lock for attr, lock in spec.items()
+        if isinstance(getattr(obj, lock, None), OwnedLock)
+    }
+    if not usable:
+        return
+    _install_lockset_setattr(cls)
+    obj.__dict__["_san_lockset_state"] = _LocksetState(usable)
+
+
+def guard_locksets(cls):
+    """Class decorator: enforce the class's own ``# guarded-by:``
+    annotations dynamically, Eraser-style (Savage et al., TOSP 1997).
+
+    Free when ``SENTIO_SANITIZE`` is unset: the env is read at instance
+    construction, and an unarmed instance pays nothing — ``__setattr__``
+    is only replaced on the class once some instance arms, and even then
+    the fast path is one dict probe.
+
+    Armed, every rebind of an annotated attribute runs the lockset state
+    machine: the first writing thread owns the attr exclusively; the
+    moment a second thread writes, the candidate lockset becomes the
+    locks that thread holds, and every later write (from any thread)
+    intersects it with the writer's held set. Empty intersection raises
+    :class:`SanitizerError` — there is provably no single lock protecting
+    the attribute, whatever the annotation claims. Writes during
+    ``__init__`` predate arming and are exempt, mirroring the static
+    rule. Granularity is attribute REBIND (``self.x = ...``,
+    ``self.x += ...``); in-place mutation of a guarded container is the
+    static rule's job."""
+    orig_init = cls.__init__
+
+    @functools.wraps(orig_init)
+    def __init__(self, *args, **kwargs):
+        orig_init(self, *args, **kwargs)
+        if enabled():
+            _arm_locksets(self, cls)
+
+    cls.__init__ = __init__
+    return cls
 
 
 # ------------------------------------------------------- engine invariants

@@ -278,6 +278,8 @@ def _cmd_lint(args: argparse.Namespace) -> int:
         forwarded.append("--update-baseline")
     if args.json:
         forwarded.append("--json")
+    if args.lock_graph:
+        forwarded.append("--lock-graph")
     return lint_main(forwarded)
 
 
@@ -459,6 +461,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="re-record the baseline from current findings")
     p_lint.add_argument("--json", action="store_true",
                         help="machine-readable output")
+    p_lint.add_argument("--lock-graph", action="store_true",
+                        dest="lock_graph",
+                        help="dump the static lock-order digraph as JSON "
+                             "(exit 1 if it has cycles)")
     p_lint.set_defaults(fn=_cmd_lint)
 
     p_audit = sub.add_parser(

@@ -122,6 +122,10 @@ class MockModelServer:
         def run() -> None:
             loop = asyncio.new_event_loop()
             asyncio.set_event_loop(loop)
+            # baselined cross-thread-race (here and .port below): written
+            # once by the server thread BEFORE _started.set(); the caller
+            # only reads after _started.wait() — the Event is the
+            # happens-before edge, no lock needed
             self._loop = loop
             runner = web.AppRunner(app)
             loop.run_until_complete(runner.setup())

@@ -103,7 +103,8 @@ def run_queries(
                     question, gold_id = pending.pop(0)
                 one(question, gold_id)
 
-        threads = [threading.Thread(target=worker) for _ in range(concurrent)]
+        threads = [threading.Thread(target=worker, name=f"eval-worker-{i}")
+                   for i in range(concurrent)]
         for t in threads:
             t.start()
         for t in threads:

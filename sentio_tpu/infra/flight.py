@@ -34,7 +34,7 @@ import time
 from collections import OrderedDict, deque
 from typing import Any, Optional
 
-from sentio_tpu.analysis.sanitizer import assert_held, make_lock
+from sentio_tpu.analysis.sanitizer import assert_held, guard_locksets, make_lock
 
 __all__ = ["FlightRecorder", "get_flight_recorder", "set_flight_recorder"]
 
@@ -43,6 +43,7 @@ __all__ = ["FlightRecorder", "get_flight_recorder", "set_flight_recorder"]
 MAX_TICKS_PER_RECORD = 256
 
 
+@guard_locksets
 class FlightRecorder:
     """Bounded, thread-safe flight store. All methods are cheap dict/deque
     operations under one lock; safe to call from the HTTP event loop, graph

@@ -14,7 +14,7 @@ import time
 from contextlib import contextmanager
 from typing import Any, Optional
 
-from sentio_tpu.analysis.sanitizer import make_lock
+from sentio_tpu.analysis.sanitizer import guard_locksets, make_lock
 
 try:
     from prometheus_client import (
@@ -55,6 +55,7 @@ def _parse_series_key(key: str):
     return key[:cut], tuple(str(item) for item in labels)
 
 
+@guard_locksets
 class InMemoryMetrics:
     """Fallback store mirroring the counter/histogram API shape."""
 
@@ -109,6 +110,7 @@ class InMemoryMetrics:
             return {"counters": dict(self.counters), "histograms": histos, "gauges": dict(self.gauges)}
 
 
+@guard_locksets
 class MetricsCollector:
     """One instance per process. With prometheus_client present, metrics
     register in an isolated registry (no default-registry collisions in

@@ -353,7 +353,8 @@ class TpuEmbedder(BaseEmbedder):
                 except Exception as exc:  # best-effort, but never silent
                     logger.warning("embed_device background cache fill failed: %s", exc)
 
-            threading.Thread(target=fill_cache, daemon=True).start()
+            threading.Thread(target=fill_cache, name="embedder-cache-fill",
+                             daemon=True).start()
         return out
 
 

@@ -191,7 +191,7 @@ class ThreadBatcher(Generic[T, R]):
         self.timeout_s = timeout_s
         self.name = name
         self.stats = BatcherStats()
-        self._queue: deque[_SyncPending[T, R]] = deque()
+        self._queue: deque[_SyncPending[T, R]] = deque()  # guarded-by: _cond
         self._cond = threading.Condition()
         self._worker: Optional[threading.Thread] = None
         self._closed = False
@@ -229,7 +229,7 @@ class ThreadBatcher(Generic[T, R]):
 
     def _ensure_worker(self) -> None:  # _cond held
         if self._worker is None or not self._worker.is_alive():
-            self._worker = threading.Thread(
+            self._worker = threading.Thread(  # thread-role: batcher
                 target=self._run, name=self.name, daemon=True
             )
             self._worker.start()

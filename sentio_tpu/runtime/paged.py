@@ -1193,6 +1193,11 @@ class ContinuousBatchingEngine:
             tokenizer=self.tokenizer,
             max_slots=self.max_slots,
             page_size=self.page_size,
+            # baselined cross-thread-race: a config-constant read of an
+            # engine-thread-owned object from the rebuild/supervisor roles —
+            # spawn_fresh only runs after the wedged engine is QUARANTINED
+            # (its pump abandoned), an ownership handoff the static model
+            # cannot see but the runtime ThreadGuard enforces
             num_pages=self.allocator.num_pages,
             max_pages_per_seq=self.max_pages_per_seq,
             use_pallas=self._attn_impl is not None,

@@ -140,7 +140,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Iterator, Optional, Sequence
 
-from sentio_tpu.analysis.sanitizer import assert_held, make_lock
+from sentio_tpu.analysis.sanitizer import assert_held, guard_locksets, make_lock
 from sentio_tpu.infra import faults
 from sentio_tpu.infra.exceptions import (
     ReplicaUnavailable,
@@ -223,6 +223,7 @@ class _TenantState:
     tokens: int = 0           # actual tokens consumed (prompt + generated)
 
 
+@guard_locksets
 class TenantFairQueue:
     """Weighted fair admission across tenants over a shared queue capacity.
 
@@ -466,6 +467,7 @@ class TenantFairQueue:
             }
 
 
+@guard_locksets
 class WorkerRegistry:
     """Router-side registry of SOCKET replica workers: who is connected,
     at which **incarnation epoch**, and which frames are too old to trust.
@@ -737,6 +739,7 @@ class WorkerRegistry:
                 transport.close()
 
 
+@guard_locksets
 class ReplicaSet:
     """Front-end over N independent paged-decode replicas: WFQ admission →
     radix-affinity / least-loaded routing → delegate to the chosen
@@ -1790,6 +1793,10 @@ class ReplicaSet:
             # the set's summed pump_leaked never silently shrinks
             leaked = old.pump_leaked_count
             with self._mutex:
+                # baselined cross-thread-race: the ONLY _services mutation,
+                # and it holds _mutex; the list is deliberately un-annotated
+                # because readers take lock-free GIL-atomic snapshots
+                # (router hot path — see the header comment on _route)
                 self._services[idx] = fresh
                 self._pump_leaked_carryover += leaked
                 health = self._health[idx]

@@ -53,6 +53,7 @@ from typing import Iterator, Optional
 from sentio_tpu.analysis.sanitizer import (
     assert_held,
     bind_engine_owner,
+    guard_locksets,
     make_lock,
 )
 from sentio_tpu.infra.exceptions import (
@@ -193,6 +194,7 @@ class _Ticket:
         return "stream" if self.stream_q is not None else "paged"
 
 
+@guard_locksets
 class PagedGenerationService:
     """Thread-safe submit/wait facade + pump thread over the paged engine."""
 
@@ -1024,9 +1026,9 @@ class PagedGenerationService:
                 target=self.generate, args=("b" * n_short,),
                 kwargs={"max_new_tokens": max_new_tokens,
                         "temperature": 0.0, "deadline_s": 0},
-                daemon=True,
+                name=f"paged-warmup-{k}", daemon=True,
             )
-            for _ in range(burst_n)
+            for k in range(burst_n)
         ]
         for t in threads:
             t.start()

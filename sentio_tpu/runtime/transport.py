@@ -375,8 +375,8 @@ class ClockSync:
 
     def __init__(self, window: int = 64) -> None:
         self._lock = threading.Lock()
-        self._samples: deque = deque(maxlen=window)  # (rtt, offset)
-        self._total = 0
+        self._samples: deque = deque(maxlen=window)  # guarded-by: _lock
+        self._total = 0  # guarded-by: _lock
 
     def add_sample(self, t_tx: float, t_rx: float, t_peer: float) -> None:
         """Record one ping/pong exchange (router clocks ``t_tx``/``t_rx``,

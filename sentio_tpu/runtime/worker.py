@@ -380,7 +380,7 @@ class _WorkerServer:
         # (transport died / router silent), or "fatal" (factory failed)
         self.outcome = ""
         # stream cancellation flags by req_id (checked between token frames)
-        self._cancelled: set[int] = set()
+        self._cancelled: set[int] = set()  # guarded-by: _cancel_lock
         self._cancel_lock = threading.Lock()
 
     def _send(self, req_id: int, kind: str, payload: Any) -> None:
@@ -1824,6 +1824,11 @@ class ProcessReplica:
         self._telemetry_ts = time.perf_counter()
         origin = payload.get("origin_s")
         if origin is not None:
+            # baselined cross-thread-race: dispatcher (telemetry/pong) and
+            # caller (fetch_flight) both stamp this; it is a last-write-wins
+            # float consumed only for trace re-basing, where the freshest
+            # origin is always acceptable and a torn update is impossible
+            # (attribute stores are GIL-atomic)
             self._worker_origin_s = float(origin)
         try:
             metrics.record_telemetry_age(self.replica_id, 0.0)

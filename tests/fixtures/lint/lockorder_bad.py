@@ -1,0 +1,44 @@
+"""Seeded lock-order inversions — analyzer test fixture, never imported."""
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._c = threading.Lock()
+        self._d = threading.Lock()
+        self._e = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:  # VIOLATION lock-order-inversion (a->b, cycle with reverse)
+                pass
+
+    def reverse(self):
+        with self._b:
+            with self._a:  # VIOLATION lock-order-inversion (b->a closes the cycle)
+                pass
+
+    def fan_in(self):
+        with self._c:
+            self._grab_a()  # VIOLATION lock-order-inversion (call edge c->a)
+
+    def _grab_a(self):
+        with self._a:
+            self._touch_c()  # VIOLATION lock-order-inversion (call edge a->c)
+
+    def _touch_c(self):
+        with self._c:
+            pass
+
+    def relock(self):
+        with self._a:
+            with self._a:  # VIOLATION lock-order-inversion (self-deadlock)
+                pass
+
+    def consistent(self):
+        # one global order, never reversed: produces edges but no finding
+        with self._d:
+            with self._e:
+                pass

@@ -1,0 +1,38 @@
+"""Seeded thread-role / cross-thread-race violations — analyzer test
+fixture, never imported."""
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.depth = 0
+        self.safe = 0  # guarded-by: _lock
+        self.ticks = 0  # guarded-by: engine-thread
+
+    def start(self):
+        threading.Thread(
+            target=self._pump_loop, name="paged-decode-pump"
+        ).start()
+        threading.Thread(
+            target=self._scrape_loop, name="worker-telemetry"
+        ).start()
+        threading.Thread(target=self._orphan_loop).start()  # VIOLATION thread-role
+        threading.Thread(
+            target=self._orphan_loop, name="mystery-helper"  # VIOLATION thread-role
+        ).start()
+
+    def _pump_loop(self):
+        self.depth += 1  # VIOLATION cross-thread-race (anchor: first write)
+        self.ticks += 1  # owner role writing its own state: no finding
+        with self._lock:
+            self.safe += 1  # annotated: the lock checker owns this attr
+
+    def _scrape_loop(self):
+        self.depth -= 1
+        self.ticks += 1  # VIOLATION cross-thread-race (foreign role)
+        with self._lock:
+            self.safe -= 1
+
+    def _orphan_loop(self):
+        pass

@@ -13,7 +13,9 @@ import threading
 
 class BadWatchdog:
     def __init__(self):
-        self._thread = threading.Thread(target=lambda: None)
+        self._thread = threading.Thread(
+            target=lambda: None, name="replica-supervisor"
+        )
         self._work = queue.Queue()
         self._wake = threading.Event()
 

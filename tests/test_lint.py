@@ -64,9 +64,9 @@ class TestSeededFixtures:
         the timeout-carrying and str.join/dict.get calls produce nothing."""
         got = _findings("watchdog_bad.py")
         assert [(f.rule, f.line) for f in got] == [
-            ("join-no-timeout", 21),
-            ("supervisor-blocking-wait", 25),
-            ("supervisor-blocking-wait", 26),
+            ("join-no-timeout", 23),
+            ("supervisor-blocking-wait", 27),
+            ("supervisor-blocking-wait", 28),
         ]
         assert "timeout" in got[0].message
         assert "watchdog" in got[1].message
@@ -147,6 +147,43 @@ class TestSeededFixtures:
         assert "cardinality" in got[0].message
         assert "'rid'" in got[1].message
 
+    def test_races_fixture_exact_findings(self):
+        """Thread-role model + cross-thread race rule: the unnamed spawn
+        and the unregistered name both fire; an unannotated attr written
+        from two roles fires once at its first write; a thread-owned attr
+        accessed from a foreign role fires at the foreign access. The
+        registered spawns, the owner-role write, and the mutex-annotated
+        attr produce nothing."""
+        got = _findings("races_bad.py")
+        assert [(f.rule, f.line) for f in got] == [
+            ("thread-role", 20),
+            ("thread-role", 21),
+            ("cross-thread-race", 26),
+            ("cross-thread-race", 33),
+        ]
+        assert "without name=" in got[0].message
+        assert "mystery-helper" in got[1].message
+        assert "pump, telemetry" in got[2].message
+        assert "engine-thread" in got[3].message
+        assert "telemetry" in got[3].message
+
+    def test_lockorder_fixture_exact_findings(self):
+        """Lock-order graph: the lexical a->b/b->a inversion fires on both
+        closing edges, the one-level call-propagated c->a/a->c inversion
+        fires on both call sites, and the lexical re-acquisition fires as
+        a self-deadlock. The consistently-ordered pair produces nothing."""
+        got = _findings("lockorder_bad.py")
+        assert [(f.rule, f.line) for f in got] == [
+            ("lock-order-inversion", 15),
+            ("lock-order-inversion", 20),
+            ("lock-order-inversion", 25),
+            ("lock-order-inversion", 29),
+            ("lock-order-inversion", 37),
+        ]
+        assert "pick one global order" in got[0].message
+        assert "Router._c" in got[2].message  # call-propagated edge
+        assert "re-acquires" in got[4].message  # lexical self-deadlock
+
     def test_clean_fixture_is_clean(self):
         assert _findings("clean.py") == []
 
@@ -215,6 +252,40 @@ class TestRepoGate:
             + "\n".join(str(e) for e in result.stale)
         )
 
+    def test_repo_lock_graph_acyclic(self):
+        """The real tree's static lock-order digraph must stay a DAG — a
+        cycle is a deadlock two threads can walk into from opposite ends,
+        and the committed baseline deliberately holds no inversion
+        entries."""
+        from sentio_tpu.analysis.lockorder import build_lock_graph
+        from sentio_tpu.analysis.runner import PACKAGE_ROOT, parse_paths
+        from sentio_tpu.analysis.threads import build_program
+
+        files, errs = parse_paths([PACKAGE_ROOT])
+        assert errs == []
+        graph = build_lock_graph(build_program(files))
+        assert graph.cycles() == []
+        # the graph is not vacuously empty: the serving tier's known
+        # cross-class acquisitions are present
+        assert graph.locks, "lock graph lost every node"
+        edges = {(e.src_lock, e.dst_lock) for e in graph.edges}
+        assert ("PagedGenerationService._mutex", "FlightRecorder._lock") in edges
+
+    def test_full_tree_lint_wall_time(self):
+        """Perf guard: the whole-program pass (call graph + role BFS +
+        lock digraph over every package file, on top of the 8 per-file
+        rules) must stay interactive — it runs in CI on every commit and
+        inside `sentio check`. Budget is ~5x the measured cost so only a
+        complexity regression (quadratic resolver, unbounded BFS) trips
+        it, not machine noise."""
+        import time
+
+        t0 = time.perf_counter()
+        result = run_gate()
+        elapsed = time.perf_counter() - t0
+        assert result.findings is not None
+        assert elapsed < 15.0, f"full-tree lint took {elapsed:.1f}s"
+
     def test_guarded_annotations_present(self):
         """The lock checker only has power if the annotations exist: the
         serving/telemetry classes must declare their guarded state."""
@@ -280,3 +351,31 @@ class TestCli:
         payload = json.loads(capsys.readouterr().out)
         assert payload["ok"] is False
         assert payload["new"][0]["rule"] == "baseexception-swallow"
+        # the schema names every rule that ran, including the
+        # whole-program concurrency rules
+        assert "thread-role" in payload["rules"]
+        assert "cross-thread-race" in payload["rules"]
+        assert "lock-order-inversion" in payload["rules"]
+
+    def test_cli_lock_graph_fixture_cycles(self, capsys):
+        import json
+
+        from sentio_tpu.cli import main
+
+        rc = main(["lint", "--lock-graph",
+                   str(FIXTURES / "lockorder_bad.py")])
+        assert rc == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["cycles"]
+        assert "Router._a" in payload["locks"]
+        vias = {e["via"] for e in payload["edges"]}
+        assert vias == {"nested", "call"}
+
+    def test_cli_lock_graph_repo_acyclic(self, capsys):
+        import json
+
+        from sentio_tpu.cli import main
+
+        assert main(["lint", "--lock-graph"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["cycles"] == []

@@ -1,10 +1,14 @@
 """Runtime sanitizer (SENTIO_SANITIZE=1) — the dynamic half of sentio lint.
 
-Verifies the three checks the sanitizer adds: lock ownership recording on
-annotated locks, the single-driver-thread contract on engine entry points
-(a cross-thread engine call raises), and per-tick engine invariants (an
+Verifies the five checks the sanitizer provides: lock ownership recording
+on annotated locks, the single-driver-thread contract on engine entry
+points (a cross-thread engine call raises), per-tick engine invariants (an
 injected page leak and an injected radix refcount leak are both caught on
-the next tick, not at pool exhaustion later).
+the next tick, not at pool exhaustion later), runtime lock-order tracking
+(the first acquisition reversing an observed order raises before taking
+the lock), and Eraser-style lockset enforcement on ``guard_locksets``
+classes (a second thread writing a guarded attribute without the lock
+empties the candidate lockset and raises).
 """
 
 import threading
@@ -14,9 +18,12 @@ import pytest
 from sentio_tpu.analysis.sanitizer import (
     OwnedLock,
     SanitizerError,
+    _reset_lock_order,
     assert_held,
     check_engine_invariants,
     enabled,
+    guard_locksets,
+    held_lock_names,
     make_lock,
 )
 
@@ -295,6 +302,158 @@ class TestQuantPoolRepr:
         eng.pool.k = {"q": q, "s": s}
         with pytest.raises(SanitizerError, match="unquantized"):
             check_engine_invariants(eng)
+
+
+class TestLockOrderRuntime:
+    """Per-thread acquisition stacks + the global order-edge set: the
+    dynamic twin of the static ``lock-order-inversion`` rule."""
+
+    def test_inversion_raises_and_leaves_nothing_held(self):
+        _reset_lock_order()
+        a, b = make_lock("tsan-A"), make_lock("tsan-B")
+        with a:
+            with b:
+                pass  # establishes A -> B
+        with b:
+            with pytest.raises(SanitizerError, match="inversion"):
+                with a:
+                    pass
+            # the check runs BEFORE the underlying acquire: the raise
+            # left the reversed lock untaken, so nothing is wedged
+            assert not a.locked()
+        assert held_lock_names() == frozenset()
+
+    def test_inversion_caught_across_threads(self):
+        _reset_lock_order()
+        a, b = make_lock("tsan-X"), make_lock("tsan-Y")
+
+        def establishes():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=establishes, name="edge-setter")
+        t.start()
+        t.join()
+        # the edge set is process-global: THIS thread's reversal trips it
+        with b:
+            with pytest.raises(SanitizerError, match="pick one global order"):
+                with a:
+                    pass
+
+    def test_consistent_order_never_raises(self):
+        _reset_lock_order()
+        a, b = make_lock("tsan-C"), make_lock("tsan-D")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert held_lock_names() == frozenset()
+
+    def test_reentrant_blocking_acquire_raises(self):
+        lock = make_lock("tsan-E")
+        with lock:
+            with pytest.raises(SanitizerError, match="self-deadlock"):
+                lock.acquire()
+
+    def test_same_name_nesting_is_not_an_inversion(self):
+        _reset_lock_order()
+        # two instances sharing one class-qualified name: order between
+        # them is an instance hierarchy, which name-granular edges cannot
+        # judge — both nestings must pass (mirrors the static rule)
+        a1, a2 = make_lock("tsan-F"), make_lock("tsan-F")
+        with a1:
+            with a2:
+                pass
+        with a2:
+            with a1:
+                pass
+
+
+@guard_locksets
+class _Seeded:
+    """Lockset-checker fixture: one annotated counter, one locked and one
+    unlocked write path."""
+
+    def __init__(self):
+        self._mu = make_lock("_Seeded._mu")
+        self._count = 0  # guarded-by: _mu
+
+    def locked_bump(self):
+        with self._mu:
+            self._count += 1
+
+    def unlocked_bump(self):
+        self._count += 1
+
+
+class TestLocksets:
+    def test_cross_thread_unlocked_mutation_raises(self):
+        s = _Seeded()
+        s.unlocked_bump()  # first thread: exclusive phase, anything goes
+        caught: list = []
+
+        def second_thread():
+            try:
+                s.unlocked_bump()
+            except SanitizerError as exc:
+                caught.append(exc)
+
+        t = threading.Thread(target=second_thread, name="racer")
+        t.start()
+        t.join()
+        assert caught, "second-thread unlocked write must empty the lockset"
+        assert "_Seeded._count" in str(caught[0])
+        assert "_mu" in str(caught[0])
+
+    def test_lockset_empties_on_late_unlocked_write(self):
+        # disciplined shared phase first (candidates = {_mu}), then the
+        # owning thread itself regresses to an unlocked write: the
+        # intersection with its empty held set raises — the checker is
+        # not a second-thread-only tripwire
+        s = _Seeded()
+        t = threading.Thread(target=s.locked_bump, name="sharer")
+        t.start()
+        t.join()
+        s.locked_bump()
+        with pytest.raises(SanitizerError, match="candidate lockset"):
+            s.unlocked_bump()
+
+    def test_locked_discipline_never_raises(self):
+        s = _Seeded()
+        threads = [
+            threading.Thread(target=s.locked_bump, name=f"bumper-{i}")
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        s.locked_bump()
+        assert s._count == 5
+
+    def test_disabled_construction_is_unarmed(self, monkeypatch):
+        monkeypatch.delenv("SENTIO_SANITIZE")
+        s = _Seeded()
+        assert "_san_lockset_state" not in s.__dict__
+        # unlocked cross-thread writes go unnoticed: genuinely opt-in
+        t = threading.Thread(target=s.unlocked_bump)
+        s.unlocked_bump()
+        t.start()
+        t.join()
+        assert s._count == 2
+
+    def test_serving_classes_are_armed(self):
+        """The chaos-drill-facing classes carry the decorator and parse
+        their own annotations into a non-empty spec."""
+        from sentio_tpu.infra.flight import FlightRecorder
+        from sentio_tpu.infra.metrics import InMemoryMetrics
+
+        fr = FlightRecorder()
+        assert "_san_lockset_state" in fr.__dict__
+        assert "_tick_seq" in fr.__dict__["_san_lockset_state"].spec
+        m = InMemoryMetrics()
+        assert "counters" in m.__dict__["_san_lockset_state"].spec
 
 
 class TestServiceUnderSanitizer:
