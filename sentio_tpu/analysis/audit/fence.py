@@ -105,7 +105,7 @@ def note_compile(family: str, signature: str, n: int = 1,
         from sentio_tpu.infra.metrics import get_metrics
 
         get_metrics().record_compiles(family, n)
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — compile-counter telemetry must never break a fence tick
         pass
     if armed:
         raise CompileFenceError(family, signature)

@@ -127,11 +127,24 @@ def load_baseline(path: str | Path) -> list[dict]:
     return data
 
 
-def save_baseline(path: str | Path, findings: Iterable[Finding]) -> None:
-    entries = sorted(
-        (f.to_json() for f in findings),
-        key=lambda e: (e["path"], e["rule"], e["context"]),
-    )
+def save_baseline(path: str | Path, findings: Iterable[Finding],
+                  keep_why_from: Iterable[dict] = ()) -> None:
+    """Rewrite the baseline. ``keep_why_from`` (usually the PREVIOUS
+    baseline) carries per-entry ``"why"`` justifications forward so
+    ``--update-baseline`` never strips a written triage."""
+    why_by_key = {
+        (e.get("rule"), e.get("path"), e.get("context", "")): e["why"]
+        for e in keep_why_from
+        if e.get("why")
+    }
+    entries = []
+    for f in findings:
+        e = f.to_json()
+        why = why_by_key.get(f.key)
+        if why:
+            e["why"] = why
+        entries.append(e)
+    entries.sort(key=lambda e: (e["path"], e["rule"], e["context"]))
     Path(path).write_text(json.dumps(entries, indent=1) + "\n")
 
 

@@ -125,7 +125,7 @@ def _annotation_is_config(fn: ast.FunctionDef, name: str) -> bool:
         if a.arg == name and a.annotation is not None:
             try:
                 text = ast.unparse(a.annotation)
-            except Exception:  # pragma: no cover - unparse is total on 3.9+
+            except Exception:  # noqa: BLE001 — unparse failure degrades to not-a-config-arg  # pragma: no cover
                 return False
             return any(tok in text for tok in CONFIG_ANNOTATIONS)
     return False

@@ -24,6 +24,11 @@ from sentio_tpu.analysis.findings import (
     save_baseline,
 )
 from sentio_tpu.analysis.blocking import check_blocking
+from sentio_tpu.analysis.failures import (
+    FAILURE_RULE_IDS,
+    build_failure_graph,
+    check_failures,
+)
 from sentio_tpu.analysis.forkcheck import check_fork
 from sentio_tpu.analysis.hygiene import check_hygiene
 from sentio_tpu.analysis.lockorder import build_lock_graph, check_lock_order
@@ -44,8 +49,9 @@ RULES = (check_retrace, check_locks, check_hygiene, check_blocking,
          check_phase_timer, check_fork, check_sockets, check_telemetry)
 
 # whole-program rules: run once over every parsed file together, so the
-# thread-role call graph and the lock-order digraph see cross-module paths
-PROGRAM_RULES = (check_thread_model, check_lock_order)
+# thread-role call graph, the lock-order digraph, and the exception-flow
+# escape analysis see cross-module paths
+PROGRAM_RULES = (check_thread_model, check_lock_order, check_failures)
 
 #: every finding id the analyzer can emit (--json reports this so gate
 #: consumers know which rules ran; syntax-error is the parse fallback)
@@ -61,6 +67,7 @@ RULE_IDS = (
     "telemetry-unbounded-labels",
     "thread-role", "cross-thread-race",
     "lock-order-inversion",
+) + FAILURE_RULE_IDS + (
     "syntax-error",
 )
 
@@ -157,12 +164,18 @@ class GateResult:
 def run_gate(
     paths: Optional[Sequence[str | Path]] = None,
     baseline_path: Optional[str | Path] = None,
+    only_rules: Optional[set] = None,
 ) -> GateResult:
     """Lint ``paths`` (default: the installed ``sentio_tpu`` package) and
-    diff against the committed baseline. ``ok`` iff no NEW findings."""
+    diff against the committed baseline. ``ok`` iff no NEW findings.
+    ``only_rules`` restricts BOTH the reported findings and the baseline
+    entries they diff against (``sentio lint --failures``)."""
     paths = list(paths) if paths else [PACKAGE_ROOT]
     baseline = load_baseline(baseline_path or DEFAULT_BASELINE)
     findings = lint_paths(paths)
+    if only_rules:
+        findings = [f for f in findings if f.rule in only_rules]
+        baseline = [e for e in baseline if e.get("rule") in only_rules]
     new, matched, stale = diff_baseline(findings, baseline)
     return GateResult(findings=findings, new=new, matched=matched, stale=stale)
 
@@ -187,6 +200,20 @@ def main(argv: Optional[list[str]] = None) -> int:
                         help="dump the static lock-order digraph (nodes, "
                              "acquisition edges with sites, cycles) as "
                              "JSON and exit")
+    parser.add_argument("--failures", action="store_true",
+                        help="report only the failure-surface rules "
+                             "(untyped-boundary-escape, typed rethrow, "
+                             "broad swallow, codec/frame contracts)")
+    parser.add_argument("--boundary-graph", action="store_true",
+                        dest="boundary_graph",
+                        help="dump the failure-surface graph (serving "
+                             "boundaries with reachable exception escapes, "
+                             "frame channels with emit/dispatch sets) as "
+                             "JSON and exit")
+    parser.add_argument("--sarif", metavar="PATH",
+                        help="also write the gate result as SARIF 2.1.0 "
+                             "to PATH (new findings = error, baselined = "
+                             "note)")
     args = parser.parse_args(argv)
 
     if args.lock_graph:
@@ -196,20 +223,38 @@ def main(argv: Optional[list[str]] = None) -> int:
         print(json.dumps(payload, indent=1))
         return 0 if not payload["cycles"] else 1
 
-    result = run_gate(args.paths or None, baseline_path=args.baseline)
+    if args.boundary_graph:
+        files, _errs = parse_paths(args.paths or [PACKAGE_ROOT])
+        payload = build_failure_graph(build_program(files))
+        print(json.dumps(payload, indent=1))
+        return 0
+
+    only_rules = set(FAILURE_RULE_IDS) if args.failures else None
+    result = run_gate(args.paths or None, baseline_path=args.baseline,
+                      only_rules=only_rules)
 
     if args.update_baseline:
-        if args.paths:
-            # a partial lint sees only a subset of findings; rewriting the
-            # baseline from it would silently drop every entry belonging to
-            # an unlinted file and break the next full-tree gate
-            print("--update-baseline requires a full-tree run "
-                  "(drop the explicit paths)", file=sys.stderr)
+        if args.paths or args.failures:
+            # a partial lint (subset of paths OR of rules) sees only a
+            # subset of findings; rewriting the baseline from it would
+            # silently drop every entry belonging to an unlinted file or
+            # rule and break the next full gate
+            print("--update-baseline requires a full-tree, all-rules run "
+                  "(drop the explicit paths / --failures)", file=sys.stderr)
             return 2
-        save_baseline(args.baseline, result.findings)
+        save_baseline(args.baseline, result.findings,
+                      keep_why_from=load_baseline(args.baseline))
         print(f"baseline rewritten: {len(result.findings)} entries "
               f"-> {args.baseline}", file=sys.stderr)
         return 0
+
+    if args.sarif:
+        from sentio_tpu.analysis.sarif import to_sarif
+
+        log = to_sarif(result, RULE_IDS, load_baseline(args.baseline))
+        Path(args.sarif).write_text(json.dumps(log, indent=1) + "\n")
+        print(f"sarif written: {len(result.new) + len(result.matched)} "
+              f"results -> {args.sarif}", file=sys.stderr)
 
     if args.as_json:
         print(json.dumps({

@@ -1,0 +1,67 @@
+"""SARIF 2.1.0 export for ``sentio lint`` (``--sarif out.sarif``).
+
+One run, one driver ("sentio-lint"), one result per finding. New findings
+map to SARIF level ``error`` (they fail the gate); baselined findings ship
+as ``note`` with their justification in the message so code-scanning UIs
+show the triage, not just the hit. Stale baseline entries are omitted —
+they describe findings that no longer exist.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+__all__ = ["to_sarif"]
+
+_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+           "Schemata/sarif-schema-2.1.0.json")
+
+
+def _result(finding, level: str, justification: str = "") -> dict:
+    text = finding.message
+    if justification:
+        text += f" [baselined: {justification}]"
+    return {
+        "ruleId": finding.rule,
+        "level": level,
+        "message": {"text": text},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": finding.path},
+                "region": {"startLine": max(finding.line, 1)},
+            },
+        }],
+        "partialFingerprints": {
+            # the baseline key: stable across unrelated edits above the line
+            "sentioLintKey/v1": f"{finding.rule}|{finding.path}|{finding.context}",
+        },
+    }
+
+
+def to_sarif(result, rule_ids: Iterable[str],
+             baseline_entries: Iterable[dict] = ()) -> dict:
+    """Convert a :class:`~.runner.GateResult` to a SARIF 2.1.0 log dict."""
+    why_by_key = {
+        (e.get("rule"), e.get("path"), e.get("context", "")): e.get("why", "")
+        for e in baseline_entries
+    }
+    results = [_result(f, "error") for f in result.new]
+    results += [
+        _result(f, "note", why_by_key.get(f.key, ""))
+        for f in result.matched
+    ]
+    return {
+        "$schema": _SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "sentio-lint",
+                    "informationUri":
+                        "https://github.com/chernistry/sentio",
+                    "rules": [{"id": rid} for rid in rule_ids],
+                },
+            },
+            "results": results,
+        }],
+    }

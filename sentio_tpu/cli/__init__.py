@@ -280,6 +280,12 @@ def _cmd_lint(args: argparse.Namespace) -> int:
         forwarded.append("--json")
     if args.lock_graph:
         forwarded.append("--lock-graph")
+    if args.failures:
+        forwarded.append("--failures")
+    if args.boundary_graph:
+        forwarded.append("--boundary-graph")
+    if args.sarif:
+        forwarded += ["--sarif", args.sarif]
     return lint_main(forwarded)
 
 
@@ -465,6 +471,17 @@ def main(argv: list[str] | None = None) -> int:
                         dest="lock_graph",
                         help="dump the static lock-order digraph as JSON "
                              "(exit 1 if it has cycles)")
+    p_lint.add_argument("--failures", action="store_true",
+                        help="report only the failure-surface rules "
+                             "(boundary escapes, typed rethrow, swallows, "
+                             "codec / frame contracts)")
+    p_lint.add_argument("--boundary-graph", action="store_true",
+                        dest="boundary_graph",
+                        help="dump the failure-surface graph (boundaries "
+                             "with reachable escapes, frame channels) as "
+                             "JSON")
+    p_lint.add_argument("--sarif", metavar="PATH", default="",
+                        help="also write the gate result as SARIF 2.1.0")
     p_lint.set_defaults(fn=_cmd_lint)
 
     p_audit = sub.add_parser(

@@ -25,6 +25,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Mapping, Optional, Union
 
+from sentio_tpu.infra.exceptions import GraphError
+
 logger = logging.getLogger(__name__)
 
 END = "__end__"
@@ -55,11 +57,6 @@ def wait_detached(timeout_s: float = 30.0) -> bool:
 
 NodeFn = Callable[[dict], Union[Mapping[str, Any], Awaitable[Mapping[str, Any]], None]]
 RouterFn = Callable[[dict], str]
-
-
-class GraphError(Exception):
-    """Raised for structural problems (unknown node, no entry point, cycles
-    past the step limit) — never for node-level soft failures."""
 
 
 @dataclass

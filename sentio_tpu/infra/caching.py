@@ -153,7 +153,7 @@ class SizeAwareStrategy:
             return False
         try:
             return len(repr(value)) <= self.max_bytes
-        except Exception:
+        except Exception:  # noqa: BLE001 — unreprable value just fails the size gate
             return False
 
     def ttl_for(self, key: str, value: Any) -> float:
@@ -243,7 +243,7 @@ class CacheManager:
             return value
         try:
             value = await self.l2.get(key)
-        except Exception:
+        except Exception:  # noqa: BLE001 — L2 outage degrades to L1-only, miss path
             return None
         if value is not None:  # promote
             self.l1.set(key, value)
@@ -256,7 +256,7 @@ class CacheManager:
                 await self.l2.set(
                     key, value, ttl_s if ttl_s is not None else self.strategy.ttl_for(key, value)
                 )
-            except Exception:
+            except Exception:  # noqa: BLE001 — L2 write-through is best-effort
                 pass
 
     # typed helpers (reference cache_manager.py:296-341)

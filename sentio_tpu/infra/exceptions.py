@@ -205,6 +205,15 @@ class DeviceError(SentioError):
     code = ErrorCode.DEVICE_ERROR
 
 
+class GraphError(SentioError):
+    """Structural graph failure (unknown node, no entry point, cycle past
+    the step limit) — a server-side misconfiguration, never a node-level
+    soft failure. Typed so a bad graph answers an honest, coded 500 and
+    survives the RPC exception codec if it ever crosses a wire."""
+
+    code = ErrorCode.INTERNAL_ERROR
+
+
 class ErrorHandler:
     """Central exception → (status, json body) mapping; unknown exceptions
     become opaque 500s (internals never leak to clients)."""

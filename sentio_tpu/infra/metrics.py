@@ -855,7 +855,7 @@ class MetricsCollector:
                     self.memory.set_gauge("hbm_bytes", (str(dev.id),), stats["bytes_in_use"])
                     if self._prom:
                         self._prom["hbm_bytes"].labels(str(dev.id)).set(stats["bytes_in_use"])
-        except Exception:
+        except Exception:  # noqa: BLE001 — device-memory scrape is best-effort telemetry
             pass
 
     # --------------------------------------------------------------- helpers

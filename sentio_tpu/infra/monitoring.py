@@ -60,7 +60,7 @@ class PerformanceMonitor:
             for cb in self._callbacks:
                 try:
                     cb(alert)
-                except Exception:
+                except Exception:  # noqa: BLE001 — an alert callback must not break recording
                     pass
 
     def series(self, metric: str) -> list[tuple[float, float]]:
@@ -115,7 +115,7 @@ class PerformanceMonitor:
                     out[f"hbm_percent_dev{dev.id}"] = (
                         100.0 * stats["bytes_in_use"] / stats["bytes_limit"]
                     )
-        except Exception:
+        except Exception:  # noqa: BLE001 — HBM scrape is best-effort telemetry
             pass
         for metric, value in out.items():
             self.record(metric, value)

@@ -113,7 +113,7 @@ class RedisL2Cache:
         if self._writer is not None:
             try:
                 self._writer.close()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — closing an already-dead socket
                 pass
         self._reader = self._writer = None
 
@@ -179,7 +179,7 @@ class RedisL2Cache:
     async def ping(self) -> bool:
         try:
             return await self._command("PING") == "PONG"
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — any failure means "not reachable"
             return False
 
     async def close(self) -> None:

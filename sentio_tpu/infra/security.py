@@ -52,7 +52,7 @@ class LogSanitizer(logging.Filter):
             if sanitized != message:
                 record.msg = sanitized
                 record.args = ()
-        except Exception:
+        except Exception:  # noqa: BLE001 — log sanitizing must never break logging itself
             pass
         return True
 

@@ -121,7 +121,7 @@ class TracingManager:
 
             annotation = jax.profiler.StepTraceAnnotation(name, step_num=step)
             annotation.__enter__()
-        except Exception:
+        except Exception:  # noqa: BLE001 — profiler unavailable: span-only fallback below
             annotation = None  # profiler unavailable: span-only fallback
         try:
             with self.span(f"tpu.{name}", step=step):
@@ -152,14 +152,14 @@ class TracingManager:
             import jax
 
             jax.profiler.stop_trace()
-        except Exception:
+        except Exception:  # noqa: BLE001 — profiler may not be running
             pass
 
     def shutdown(self) -> None:
         if self._provider is not None:
             try:
                 self._provider.shutdown()
-            except Exception:
+            except Exception:  # noqa: BLE001 — provider shutdown is best-effort
                 pass
 
 

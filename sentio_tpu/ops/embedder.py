@@ -149,7 +149,7 @@ class BaseEmbedder:
         try:
             vec = self.embed("warm up probe")
             return vec.shape == (self.dimension,)
-        except Exception:
+        except Exception:  # noqa: BLE001 — any probe failure means "unhealthy"
             return False
 
     def get_stats(self) -> dict:

@@ -123,7 +123,7 @@ def _read_yaml(raw: str) -> str:
         import yaml
 
         docs = list(yaml.safe_load_all(raw))
-    except Exception:
+    except Exception:  # noqa: BLE001 — yaml missing or invalid: treat as plain text
         return raw
 
     def walk(node) -> Iterable[str]:

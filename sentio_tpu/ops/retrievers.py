@@ -158,7 +158,7 @@ class HybridRetriever(BaseRetriever):
         for scorer in self.scorers:
             try:
                 s = scorer.score(query, docs)
-            except Exception:
+            except Exception:  # noqa: BLE001 — a broken plugin never kills retrieval
                 continue  # a broken plugin never kills retrieval
             mixed = mixed + scorer.weight * np.asarray(s, np.float32)
             total_w += scorer.weight

@@ -500,6 +500,6 @@ class GeneratorEngine:
                     "bytes_in_use": m.get("bytes_in_use"),
                     "bytes_limit": m.get("bytes_limit"),
                 }
-        except Exception:
+        except Exception:  # noqa: BLE001 — device stats are best-effort diagnostics
             pass
         return stats

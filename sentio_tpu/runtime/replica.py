@@ -600,6 +600,7 @@ class WorkerRegistry:
                 name="worker-registry-handshake", daemon=True,
             ).start()
 
+    # frame-emit: handshake-to-dialer via=socket
     def _handshake(self, conn) -> None:
         from sentio_tpu.runtime.transport import (
             SocketTransport,
@@ -667,6 +668,7 @@ class WorkerRegistry:
             old_transport.close()
         q.put(entries[-1])
 
+    # frame-emit: handshake-to-dialer via=socket
     def _reject(self, transport, ackable, reason: str) -> None:
         with self._mutex:
             self._rejections += 1

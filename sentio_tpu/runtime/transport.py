@@ -404,7 +404,8 @@ class ClockSync:
 # --------------------------------------------------------------------------
 # handshake
 
-def send_hello(transport: SocketTransport, token: str, slot: int,
+# frame-emit: handshake-to-accepter via=socket
+def send_hello(transport: SocketTransport, token: str, slot: int,  # frame-dispatch: handshake-to-dialer via=socket
                pid: int, epoch: Optional[int] = None,
                timeout_s: float = 10.0) -> dict:
     """Connecting side: identify + authenticate, await the ack.
@@ -435,7 +436,8 @@ def send_hello(transport: SocketTransport, token: str, slot: int,
     return payload
 
 
-def expect_hello(transport: SocketTransport, token: str,
+# frame-emit: handshake-to-dialer via=socket
+def expect_hello(transport: SocketTransport, token: str,  # frame-dispatch: handshake-to-accepter via=socket
                  timeout_s: float = 10.0) -> dict:
     """Accepting side: read + validate the peer's hello. Raises
     :class:`FrameProtocolError` (after sending a reject frame, best-effort)

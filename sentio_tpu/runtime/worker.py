@@ -358,7 +358,7 @@ def _decode_exc(data: dict) -> BaseException:
 # --------------------------------------------------------------------------
 # worker side
 
-class _WorkerServer:
+class _WorkerServer:  # frame-emit: worker-to-router
     """Runs inside the child process: one recv loop dispatching RPC frames
     to handler threads, a status thread pushing liveness. Framing and
     send-side locking live in the transport (runtime/transport.py) — the
@@ -453,6 +453,7 @@ class _WorkerServer:
                 continue
             self._send(0, _F_TELEMETRY, payload)
 
+    # frame-dispatch: router-to-worker via=pipe,socket
     def _handle(self, req_id: int, method: str, kwargs: dict) -> None:
         svc = self.svc
         try:
@@ -586,6 +587,7 @@ class _WorkerServer:
 
     # ----------------------------------------------------------------- main
 
+    # frame-dispatch: router-to-worker via=pipe,socket
     def run(self) -> str:
         """Serve this connection until shutdown / link loss. Returns the
         outcome (also latched on ``self.outcome``); the SERVICE is left
@@ -648,7 +650,14 @@ class _WorkerServer:
                 continue
             frame, _epoch = got
             last_rx = time.perf_counter()
-            req_id, method, kwargs = frame
+            try:
+                req_id, method, kwargs = frame
+            except (TypeError, ValueError):
+                # a malformed frame is a peer bug, not a reason to die
+                # with a bare unpack traceback: answer typed and move on
+                self._send(0, _F_ERR, _encode_exc(FrameProtocolError(
+                    f"malformed request frame: {frame!r}")))
+                continue
             if method == "__shutdown__":
                 self.outcome = "shutdown"
                 break
@@ -767,6 +776,7 @@ def worker_main_socket(addr, spec: WorkerSpec, slot: int) -> None:
     os._exit(0)
 
 
+# frame-emit: handshake-to-dialer via=socket
 def worker_serve(
     bind_host: str,
     bind_port: int,
@@ -874,7 +884,7 @@ class _EngineFacade:
         return None
 
 
-class ProcessReplica:
+class ProcessReplica:  # frame-emit: router-to-worker
     """Router-process shim over one worker process; presents the
     ``PagedGenerationService`` surface so ReplicaSet drives it unchanged.
 
@@ -1111,6 +1121,7 @@ class ProcessReplica:
 
     # ------------------------------------------------------------- plumbing
 
+    # frame-dispatch: worker-to-router via=pipe,socket
     def _wait_ready(self, call: "_PendingCall", timeout_s: float) -> dict:
         try:
             kind, payload = call.q.get(timeout=timeout_s)
@@ -1131,6 +1142,7 @@ class ProcessReplica:
             )
         return payload
 
+    # frame-dispatch: worker-to-router via=pipe,socket
     def _dispatch_loop(self) -> None:
         transport = self._transport
         while True:
@@ -1668,7 +1680,7 @@ class ProcessReplica:
         try:
             return int(self._call("peek_prefix", {"toks": list(toks)},
                                   timeout_s=0.5))
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — prefix peek is an optional admission hint
             return 0
 
     def warmup(self, max_new_tokens: int = 4) -> dict:

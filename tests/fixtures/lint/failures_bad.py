@@ -1,0 +1,76 @@
+"""Seeded failure-surface violations — analyzer test fixture, never
+imported. One violation per rule: an untyped raise reaching a serving
+boundary, a typed catch re-raised untyped, a silent broad swallow, a
+codec-incompatible SentioError subclass, and a frame kind emitted on both
+transports but dispatched on only one."""
+import threading
+
+_K_DATA = "data"
+_K_EXTRA = "extra"
+
+
+class SentioError(Exception):
+    def __init__(self, message, details=None):
+        super().__init__(message)
+        self.details = details or {}
+
+
+class BadWireError(SentioError):  # VIOLATION codec-roundtrip
+    def __init__(self, message, slot):
+        super().__init__(message)
+        self.slot = slot
+
+
+def _risky():
+    raise ValueError("boom")  # VIOLATION untyped-boundary-escape
+
+
+class Pump:
+    def start(self):
+        threading.Thread(
+            target=self._pump_loop, name="paged-decode-pump"
+        ).start()
+
+    def _pump_loop(self):
+        _risky()
+
+    def rethrow(self):
+        try:
+            _risky()
+        except SentioError as exc:
+            raise RuntimeError(str(exc))  # VIOLATION typed-error-untyped-rethrow
+
+    def swallow(self):
+        try:
+            _risky()
+        except Exception:  # VIOLATION broad-except-swallow
+            pass
+
+
+class Wire:
+    def send(self, frame):
+        del frame
+
+
+# frame-emit: fixture-wire via=pipe,socket
+def emit_frames(wire):
+    wire.send((0, _K_DATA, {}))
+    wire.send((0, _K_EXTRA, {}))  # VIOLATION frame-kind-unhandled (socket side)
+
+
+# frame-dispatch: fixture-wire via=pipe
+def receive_pipe(frame):
+    _req, kind, _payload = frame
+    if kind == _K_DATA:
+        return "data"
+    if kind == _K_EXTRA:
+        return "extra"
+    return ""
+
+
+# frame-dispatch: fixture-wire via=socket
+def receive_socket(frame):
+    _req, kind, _payload = frame
+    if kind == _K_DATA:
+        return "data"
+    return ""

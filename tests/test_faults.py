@@ -147,6 +147,19 @@ class TestDegradationLadder:
             result = rr.rerank("query", docs, top_k=3)
         assert [d.id for d in result.documents] == [d.id for d in docs[:3]]
 
+    def test_embedder_batch_fault_then_recovers(self):
+        from sentio_tpu.ops.embedder import get_embedder
+
+        embedder = get_embedder(EmbedderConfig(provider="hash", dim=32))
+        with faults.inject("embedder.batch",
+                           error=RuntimeError("embed kernel oom"),
+                           times=1) as rule:
+            with pytest.raises(RuntimeError):
+                embedder.embed_many(["hello"])
+            out = embedder.embed_many(["hello"])  # recovered
+        assert rule.fired == 1
+        assert out.shape == (1, 32)
+
     def test_generate_fault_exhausts_then_recovers(self):
         from sentio_tpu.models.llama import LlamaConfig
         from sentio_tpu.runtime.engine import GeneratorEngine

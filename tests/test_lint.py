@@ -184,6 +184,27 @@ class TestSeededFixtures:
         assert "Router._c" in got[2].message  # call-propagated edge
         assert "re-acquires" in got[4].message  # lexical self-deadlock
 
+    def test_failures_fixture_exact_findings(self):
+        """Failure-surface rules: the codec-incompatible subclass, the
+        untyped raise reaching the pump boundary, the typed catch
+        re-raised untyped, the silent broad swallow, and the frame kind
+        dispatched on only one transport each fire exactly once; the
+        two-sided frame kind and the registered spawn produce nothing."""
+        got = _findings("failures_bad.py")
+        assert [(f.rule, f.line) for f in got] == [
+            ("codec-roundtrip", 18),
+            ("untyped-boundary-escape", 25),
+            ("typed-error-untyped-rethrow", 41),
+            ("broad-except-swallow", 46),
+            ("frame-kind-unhandled", 58),
+        ]
+        assert "degrades to RuntimeError" in got[0].message
+        assert "requires extra positional arguments" in got[0].message
+        assert "Pump._pump_loop (pump thread)" in got[1].message
+        assert "retry_after_s" in got[2].message
+        assert "noqa: BLE001" in got[3].message
+        assert "socket receive path" in got[4].message
+
     def test_clean_fixture_is_clean(self):
         assert _findings("clean.py") == []
 
@@ -286,6 +307,47 @@ class TestRepoGate:
         assert result.findings is not None
         assert elapsed < 15.0, f"full-tree lint took {elapsed:.1f}s"
 
+    def test_baseline_entries_justified(self):
+        """Triage discipline: every committed baseline entry must say WHY
+        it is acceptable — an unjustified entry is a finding someone
+        snoozed, not one someone triaged."""
+        for e in load_baseline(DEFAULT_BASELINE):
+            assert e.get("why", "").strip(), (
+                f"baseline entry for {e['path']} [{e['rule']}] carries no "
+                f"'why' justification"
+            )
+
+    def test_repo_frame_channels_complete(self):
+        """The annotated frame channels over the real tree: both RPC
+        directions and both handshake directions exist, and every kind
+        either side can emit has a dispatcher branch (the gate being green
+        proves emit ⊆ dispatch; this pins the channel inventory so
+        deleting an annotation can't silently vacate the contract)."""
+        from sentio_tpu.analysis.failures import build_failure_graph
+        from sentio_tpu.analysis.runner import PACKAGE_ROOT, parse_paths
+        from sentio_tpu.analysis.threads import build_program
+
+        files, _errs = parse_paths([PACKAGE_ROOT])
+        graph = build_failure_graph(build_program(files))
+        chans = graph["channels"]
+        assert set(chans) == {
+            "worker-to-router", "router-to-worker",
+            "handshake-to-accepter", "handshake-to-dialer",
+        }
+        assert set(chans["worker-to-router"]["emits"]) == {
+            "ready", "status", "ok", "err", "tok", "end", "telemetry",
+            "pong",
+        }
+        assert "generate" in chans["router-to-worker"]["emits"]
+        assert "__shutdown__" in chans["router-to-worker"]["emits"]
+        assert list(chans["handshake-to-accepter"]["emits"]) == ["hello"]
+        # serving boundaries include the HTTP handlers and the worker RPC
+        # dispatcher; the only typed-escape left is the sanitizer's
+        # deliberate loud crash (baselined)
+        kinds = {b["kind"] for b in graph["boundaries"]}
+        assert "http handler" in kinds
+        assert "worker RPC recv loop" in kinds
+
     def test_guarded_annotations_present(self):
         """The lock checker only has power if the annotations exist: the
         serving/telemetry classes must declare their guarded state."""
@@ -379,3 +441,72 @@ class TestCli:
         assert main(["lint", "--lock-graph"]) == 0
         payload = json.loads(capsys.readouterr().out)
         assert payload["cycles"] == []
+
+    def test_cli_failures_flag_scopes_rules(self, capsys):
+        """--failures restricts the gate to the failure-surface rules: the
+        fixture's five failure findings fail it, but a fixture whose only
+        violations belong to other rules passes clean."""
+        from sentio_tpu.cli import main
+
+        assert main(["lint", "--failures",
+                     str(FIXTURES / "failures_bad.py")]) == 1
+        out = capsys.readouterr().out
+        assert "codec-roundtrip" in out
+        assert "frame-kind-unhandled" in out
+        assert main(["lint", "--failures",
+                     str(FIXTURES / "clock_bad.py")]) == 0
+
+    def test_cli_failures_refuses_update_baseline(self, capsys):
+        from sentio_tpu.cli import main
+
+        before = Path(DEFAULT_BASELINE).read_text()
+        rc = main(["lint", "--failures", "--update-baseline"])
+        assert rc == 2
+        assert Path(DEFAULT_BASELINE).read_text() == before
+
+    def test_cli_sarif_output(self, capsys, tmp_path):
+        import json
+
+        from sentio_tpu.cli import main
+
+        out_path = tmp_path / "out.sarif"
+        rc = main(["lint", str(FIXTURES / "failures_bad.py"),
+                   "--sarif", str(out_path)])
+        assert rc == 1  # gate semantics unchanged by the export
+        log = json.loads(out_path.read_text())
+        assert log["version"] == "2.1.0"
+        run = log["runs"][0]
+        assert run["tool"]["driver"]["name"] == "sentio-lint"
+        results = run["results"]
+        assert {r["level"] for r in results} == {"error"}
+        assert {r["ruleId"] for r in results} == {
+            "codec-roundtrip", "untyped-boundary-escape",
+            "typed-error-untyped-rethrow", "broad-except-swallow",
+            "frame-kind-unhandled",
+        }
+        fp = results[0]["partialFingerprints"]["sentioLintKey/v1"]
+        assert fp.count("|") == 2  # rule|path|context baseline key
+
+    def test_cli_sarif_baselined_are_notes(self, tmp_path):
+        import json
+
+        from sentio_tpu.cli import main
+
+        out_path = tmp_path / "repo.sarif"
+        assert main(["lint", "--sarif", str(out_path)]) == 0
+        results = json.loads(out_path.read_text())["runs"][0]["results"]
+        assert results, "repo baseline produced no SARIF results"
+        assert {r["level"] for r in results} == {"note"}
+        # the baselined justification travels in the message
+        assert any("[baselined:" in r["message"]["text"] for r in results)
+
+    def test_cli_boundary_graph(self, capsys):
+        import json
+
+        from sentio_tpu.cli import main
+
+        assert main(["lint", "--boundary-graph"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert "ServiceOverloaded" in payload["typed"]
+        assert "GraphError" in payload["typed"]  # typed as of this pass
+        assert len(payload["channels"]) == 4

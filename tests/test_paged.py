@@ -250,6 +250,22 @@ class TestMultiStepTick:
 
 
 class TestBatchedAdmission:
+    def test_admit_scatter_fault_point_armed(self, paged):
+        """The ``paged.admit_scatter`` chaos seam is live: a benign delay
+        rule armed at the prefill-scatter dispatch must be hit during
+        admission without disturbing the decode output."""
+        from sentio_tpu.infra import faults
+
+        faults.reset()
+        try:
+            with faults.inject("paged.admit_scatter", delay_s=0.01) as rule:
+                out = paged.run_all(["fault point probe"],
+                                    max_new_tokens=4, temperature=0.0)
+            assert rule.hits >= 1
+            assert out[0].tokens
+        finally:
+            faults.reset()
+
     def test_burst_admission_dispatch_count(self, cfg, contiguous):
         """Admitting N same-width-bucket requests must cost at most
         ceil(N / max_batch_bucket) prefill dispatches, not N."""
