@@ -1149,7 +1149,9 @@ def phase_chaos(llm_cfg, new_tokens, replica_mode=None, chaos_mode=None):
 
     Env knobs: BENCH_CHAOS_QPS (8), BENCH_CHAOS_SECONDS (30),
     BENCH_CHAOS_KILL_AT_S (5), BENCH_CHAOS_SLOTS (8),
-    BENCH_CHAOS_SEED (1234), BENCH_CHAOS_MODE (kill|stall|midstream),
+    BENCH_CHAOS_SEED (1234), BENCH_CHAOS_MODE
+    (kill|stall|midstream|elastic — ``elastic`` dispatches to
+    :func:`phase_elastic`, membership churn instead of a replica death),
     BENCH_CHAOS_STALL_BUDGET_S (2), BENCH_CHAOS_REPLICA_MODE
     (thread|process, or a comma list — the caller runs this phase once
     per listed mode from one invocation)."""
@@ -1180,6 +1182,10 @@ def phase_chaos(llm_cfg, new_tokens, replica_mode=None, chaos_mode=None):
     if replica_mode is None:
         replica_mode = os.environ.get(
             "BENCH_CHAOS_REPLICA_MODE", "thread").strip().lower()
+    if mode == "elastic":
+        # membership churn IS the fault here — no replica dies, the fleet
+        # grows/flaps/shrinks under load (dedicated harness below)
+        return phase_elastic(llm_cfg, new_tokens)
     if mode == "partition" and replica_mode != "socket":
         return {"skipped": "partition chaos needs the socket transport "
                            f"(replica_mode={replica_mode})",
@@ -1661,6 +1667,255 @@ def phase_chaos(llm_cfg, new_tokens, replica_mode=None, chaos_mode=None):
         f"incident_p95={out.get('incident_p95_ms')}ms "
         f"handed_off={out['handed_off_tickets']} "
         f"untyped={stats['untyped_errors']}{extra}")
+    return out
+
+
+def phase_elastic(llm_cfg, new_tokens):
+    """Elastic-fleet churn drill (``BENCH_CHAOS_MODE=elastic``): a steady
+    Poisson mix of generate + SSE-shaped stream traffic rides a fleet
+    whose MEMBERSHIP is the fault — a mid-run join storm grows 1→N, a
+    flap cycle joins/retires the same slot back to back, and a scale-in
+    wave retires every extra replica while streams are mid-delivery
+    (graceful drain: delivered-token streams finish or resume, queued
+    tickets hand off to survivors). A live duty-cycle autoscaler
+    (runtime/autoscaler.py) polls the whole time with aggressive
+    thresholds, so the artifact also records the closed loop's own
+    decisions racing the scripted churn.
+
+    The artifact answers: **availability** under churn (its complement is
+    the error budget membership changes consumed), **retire drain p95**
+    (the latency bill of a graceful scale-in), **handed_off_tickets**
+    (queued work moved to survivors instead of riding caller failover),
+    **autoscale decisions** by direction, and **untyped_errors** — which
+    must be ZERO: churn is a planned operation, every caller-visible
+    outcome stays typed.
+
+    Env knobs: BENCH_CHAOS_QPS (8), BENCH_CHAOS_SECONDS (30),
+    BENCH_CHAOS_SLOTS (8), BENCH_CHAOS_SEED (1234),
+    BENCH_ELASTIC_MAX_REPLICAS (3)."""
+    import random
+    import threading
+
+    from sentio_tpu.infra.exceptions import (
+        DeadlineExceededError,
+        SentioError,
+        ServiceOverloaded,
+    )
+    from sentio_tpu.infra.metrics import (
+        MetricsCollector,
+        get_metrics,
+        set_metrics,
+    )
+    from sentio_tpu.runtime.autoscaler import AutoscalePolicy, Autoscaler
+    from sentio_tpu.runtime.paged import ContinuousBatchingEngine
+    from sentio_tpu.runtime.replica import ReplicaSet
+    from sentio_tpu.runtime.service import PagedGenerationService
+
+    qps = float(os.environ.get("BENCH_CHAOS_QPS", "8"))
+    run_s = float(os.environ.get("BENCH_CHAOS_SECONDS", "30"))
+    max_slots = int(os.environ.get("BENCH_CHAOS_SLOTS", "8"))
+    seed = int(os.environ.get("BENCH_CHAOS_SEED", "1234"))
+    max_replicas = max(int(os.environ.get(
+        "BENCH_ELASTIC_MAX_REPLICAS", "3")), 2)
+    gen_tokens = min(new_tokens, 16)
+    rng = random.Random(seed)
+
+    log(f"phase ELASTIC: building 1-replica seed fleet "
+        f"(max={max_replicas}) ...")
+    engine_kw = dict(max_slots=max_slots, page_size=16, max_pages_per_seq=8,
+                     steps_per_tick=4, max_tick_steps=4, pipeline_depth=2,
+                     ignore_eos=True)
+    e0 = ContinuousBatchingEngine(model_config=llm_cfg, **engine_kw)
+
+    def new_service() -> PagedGenerationService:
+        eng = ContinuousBatchingEngine(
+            model_config=llm_cfg, params=e0.params, tokenizer=e0.tokenizer,
+            **engine_kw)
+        return PagedGenerationService(eng)
+
+    rs = ReplicaSet(
+        [PagedGenerationService(e0)],
+        probe_interval_s=0.05, quarantine_backoff_s=0.25,
+        failover_budget=2, rebuild_drain_s=5.0,
+    )
+    log("phase ELASTIC: warmup ...")
+    rs.warmup(max_new_tokens=gen_tokens)
+    set_metrics(MetricsCollector())
+
+    # the autoscaler runs LIVE through the drill with thresholds low
+    # enough that tiny-engine duty under this traffic can trip them — its
+    # decisions race the scripted churn below, which is the point
+    def launcher() -> None:
+        rs.add_replica(new_service())
+
+    scaler = Autoscaler(
+        rs,
+        AutoscalePolicy(min_replicas=1, max_replicas=max_replicas,
+                        window_s=2.0, out_busy=0.3, in_busy=0.1,
+                        out_backlog=0.3, out_cooldown_s=2.0,
+                        in_cooldown_s=3.0),
+        launcher=launcher, poll_interval_s=0.25,
+    )
+    scaler.start()
+
+    lock = threading.Lock()
+    stats = {"arrivals": 0, "ok": 0, "shed": 0, "expired": 0,
+             "typed_errors": 0, "untyped_errors": 0}
+    churn = {"storm_joins": 0, "flap_cycles": 0, "forced_retires": 0,
+             "refused": 0}
+    completions: list[float] = []
+
+    def worker(prompt: str) -> None:
+        t0 = time.perf_counter()
+        try:
+            r = rs.generate(prompt, max_new_tokens=gen_tokens,
+                            temperature=0.0, timeout_s=180)
+            with lock:
+                if r.finish_reason == "error":
+                    stats["typed_errors"] += 1
+                else:
+                    stats["ok"] += 1
+                    completions.append((time.perf_counter() - t0) * 1e3)
+        except ServiceOverloaded:
+            with lock:
+                stats["shed"] += 1
+        except DeadlineExceededError:
+            with lock:
+                stats["expired"] += 1
+        except SentioError:
+            with lock:
+                stats["typed_errors"] += 1
+        except Exception:  # noqa: BLE001 — the number that must stay zero
+            with lock:
+                stats["untyped_errors"] += 1
+
+    def stream_worker(prompt: str) -> None:
+        t0 = time.perf_counter()
+        try:
+            "".join(rs.generate_stream(prompt, max_new_tokens=gen_tokens,
+                                       temperature=0.0, timeout_s=180))
+            with lock:
+                stats["ok"] += 1
+                completions.append((time.perf_counter() - t0) * 1e3)
+        except ServiceOverloaded:
+            with lock:
+                stats["shed"] += 1
+        except DeadlineExceededError:
+            with lock:
+                stats["expired"] += 1
+        except SentioError:
+            with lock:
+                stats["typed_errors"] += 1
+        except Exception:  # noqa: BLE001 — must stay zero
+            with lock:
+                stats["untyped_errors"] += 1
+
+    def _retire(idx: int, deadline_s: float) -> bool:
+        # scripted retires race the autoscaler's own scale-ins (and each
+        # other): a slot someone else is already retiring reports
+        # retired=False, the last-serving guard raises typed — both are
+        # refusals, not failures
+        try:
+            return bool(rs.retire(idx, deadline_s=deadline_s)["retired"])
+        except SentioError:
+            with lock:
+                churn["refused"] += 1
+            return False
+
+    def _live_extras() -> list[int]:
+        summary = rs.health_summary()
+        return [r["replica"] for r in summary["replicas"]
+                if r["replica"] != 0
+                and r["state"] in ("HEALTHY", "DEGRADED")]
+
+    storm_at = run_s * 0.2
+    flap_at = run_s * 0.5
+    scale_in_at = run_s * 0.75
+    fired = {"storm": False, "flap": False, "scale_in": False}
+    threads: list[threading.Thread] = []
+    t_start = time.perf_counter()
+    seq = 0
+    while time.perf_counter() - t_start < run_s:
+        t_rel = time.perf_counter() - t_start
+        if not fired["storm"] and t_rel >= storm_at:
+            # join storm: grow to max back to back under live traffic
+            fired["storm"] = True
+            while rs.stats()["fleet"]["live_replicas"] < max_replicas:
+                rs.add_replica(new_service())
+                churn["storm_joins"] += 1
+            log(f"phase ELASTIC: join storm done at t={t_rel:.1f}s "
+                f"(live={rs.stats()['fleet']['live_replicas']})")
+        if not fired["flap"] and t_rel >= flap_at:
+            # flap: retire a joiner and immediately re-join its slot
+            fired["flap"] = True
+            extras = _live_extras()
+            if extras and _retire(extras[-1], deadline_s=5.0):
+                rs.add_replica(new_service())
+                churn["flap_cycles"] += 1
+            log(f"phase ELASTIC: flap cycle done at t={t_rel:.1f}s")
+        if not fired["scale_in"] and t_rel >= scale_in_at:
+            # scale-in wave racing mid-flight streams: graceful drain on
+            # every extra replica, survivors absorb handed-off tickets
+            fired["scale_in"] = True
+            for idx in reversed(_live_extras()):
+                if _retire(idx, deadline_s=10.0):
+                    churn["forced_retires"] += 1
+            log(f"phase ELASTIC: scale-in wave done at t={t_rel:.1f}s "
+                f"(live={rs.stats()['fleet']['live_replicas']})")
+        prompt = f"elastic churn session {seq % 8:02d} turn {seq}"
+        target = stream_worker if seq % 2 else worker
+        t = threading.Thread(target=target, args=(prompt,), daemon=True)
+        t.start()
+        threads.append(t)
+        with lock:
+            stats["arrivals"] += 1
+        seq += 1
+        time.sleep(rng.expovariate(qps))
+    for t in threads:
+        t.join(timeout=240)
+    hung = sum(t.is_alive() for t in threads)
+    scaler.close()
+    set_stats = rs.stats()
+    decisions = {
+        k: int(v) for k, v in get_metrics().memory.counters.items()
+        if k.startswith("autoscale_decisions")
+    }
+    arrivals = max(stats["arrivals"], 1)
+    out = {
+        "knobs": {"qps": qps, "run_s": run_s, "slots_per_replica": max_slots,
+                  "gen_tokens": gen_tokens, "seed": seed, "mode": "elastic",
+                  "max_replicas": max_replicas},
+        **stats,
+        "hung": hung,
+        "availability": round(stats["ok"] / arrivals, 4),
+        "churn": churn,
+        "fleet": set_stats["fleet"],
+        "handed_off_tickets": set_stats.get("handed_off", 0),
+        "autoscale": scaler.stats(),
+        "autoscale_decisions": decisions,
+        "stream_resumes": set_stats.get("stream_resumes", 0),
+        "resume_exhausted": set_stats.get("resume_exhausted", 0),
+        "pump_leaked": set_stats.get("pump_leaked", 0),
+        "health": rs.health_summary(),
+    }
+    if completions:
+        out["e2e_p95_ms"] = round(_percentile(completions, 0.95), 2)
+    rs.close()
+    # retired engines idle-exit their pumps; a pump still inside XLA at
+    # interpreter exit aborts the process
+    unwind_end = time.perf_counter() + 30
+    while time.perf_counter() < unwind_end and any(
+            t.name == "paged-decode-pump" and t.is_alive()
+            for t in threading.enumerate()):
+        time.sleep(0.05)
+    set_metrics(MetricsCollector())
+    fleet = out["fleet"]
+    log(f"phase ELASTIC: availability={out['availability']} "
+        f"joined={fleet['joined']} retired={fleet['retired']} "
+        f"drain_p95={fleet.get('retire_drain_p95_s')}s "
+        f"handed_off={out['handed_off_tickets']} "
+        f"autoscale={out['autoscale']} "
+        f"untyped={stats['untyped_errors']}")
     return out
 
 

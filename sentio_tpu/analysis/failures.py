@@ -98,6 +98,7 @@ CODEC_MODULE = "sentio_tpu/infra/exceptions.py"
 SERVING_ROLES = frozenset({
     "pump", "supervisor", "dispatcher", "rpc", "accepter", "status",
     "telemetry", "detached-verify", "drain", "rebuild", "health-probe",
+    "autoscaler",
 })
 
 #: boundaries that are not thread spawns or HTTP routes: the worker RPC

@@ -79,7 +79,9 @@ ROLE_REGISTRY: dict[str, tuple[str, ...]] = {
     "supervisor": ("replica-supervisor",),
     "dispatcher": ("replica-worker-rx-*",),
     "rpc": ("worker-rpc-*",),
-    "accepter": ("worker-registry-accept", "worker-registry-handshake"),
+    "accepter": ("worker-registry-accept", "worker-registry-handshake",
+                 "worker-serve-conn"),
+    "autoscaler": ("fleet-autoscaler",),
     "telemetry": ("worker-telemetry",),
     "status": ("worker-status",),
     "detached-verify": ("graph-detached-*",),

@@ -157,12 +157,18 @@ def _fleet_trace(container):
     router's perf_counter timeline with the ClockSync offset (the lane
     name carries the ± uncertainty bound). Returns None when no worker
     replicas exist (thread mode) — the caller falls back to the local
-    single-recorder export."""
+    single-recorder export.
+
+    DEAD and RETIRED incarnations stay on the timeline: their lanes
+    render from the router's cached last telemetry frame, with the
+    status suffixed to the lane name — churn reads as history instead
+    of a silently missing row."""
     from sentio_tpu.infra.chrome_trace import build_fleet_trace
     from sentio_tpu.infra.flight import get_flight_recorder
 
     service = container.peek("generation_service")
     members = list(getattr(service, "_services", None) or ())
+    healths = list(getattr(service, "_health", None) or ())
     fetchable = [svc for svc in members
                  if callable(getattr(svc, "fetch_flight", None))]
     if not fetchable:
@@ -170,13 +176,23 @@ def _fleet_trace(container):
     recorder = get_flight_recorder()
     router_origin = recorder.origin()
     workers = []
-    for svc in fetchable:
+    for idx, svc in enumerate(members):
+        if not callable(getattr(svc, "fetch_flight", None)):
+            continue
+        state = (getattr(healths[idx], "state", "")
+                 if idx < len(healths) else "")
+        if state in ("RETIRING", "RETIRED"):
+            workers.append(svc.cached_flight_lane(router_origin, "retired"))
+            continue
         try:
             reply = svc.fetch_flight()
-        except Exception as exc:  # noqa: BLE001 — dead worker: lane absent
+        except Exception as exc:  # noqa: BLE001 — dead worker: cached lane
             print(f"--fleet: replica {getattr(svc, 'replica_id', '?')} "
-                  f"unavailable ({type(exc).__name__}) — lane omitted",
-                  file=sys.stderr)
+                  f"unavailable ({type(exc).__name__}) — rendering lane "
+                  f"from cached telemetry", file=sys.stderr)
+            if callable(getattr(svc, "cached_flight_lane", None)):
+                workers.append(
+                    svc.cached_flight_lane(router_origin, "dead"))
             continue
         shift, bound = svc.flight_shift_s(router_origin)
         workers.append({

@@ -499,6 +499,19 @@ class ServeConfig:
     # low-priority `telemetry` frames (0 disables — the RPC hot path is
     # then byte-identical to the pre-telemetry protocol)
     telemetry_interval_s: float = 1.0
+    # elastic fleet: duty-cycle autoscaler (inert by default — the
+    # registry still accepts elastic joins/deregisters either way; these
+    # knobs only govern the policy loop that ACTS on the load signal)
+    autoscale: bool = False
+    autoscale_min_replicas: int = 1
+    autoscale_max_replicas: int = 4
+    autoscale_window_s: float = 15.0
+    autoscale_out_busy: float = 0.75
+    autoscale_in_busy: float = 0.15
+    autoscale_out_backlog: float = 0.5
+    autoscale_out_cooldown_s: float = 30.0
+    autoscale_in_cooldown_s: float = 60.0
+    autoscale_poll_interval_s: float = 1.0
 
     @classmethod
     def from_env(cls) -> "ServeConfig":
@@ -583,6 +596,24 @@ class ServeConfig:
             ),
             socket_heal_grace_s=_env_float(["SOCKET_HEAL_GRACE_S"], 5.0),
             telemetry_interval_s=_env_float(["TELEMETRY_INTERVAL_S"], 1.0),
+            autoscale=_env_bool(["AUTOSCALE"], False),
+            autoscale_min_replicas=_env_int(["AUTOSCALE_MIN_REPLICAS"], 1),
+            autoscale_max_replicas=_env_int(["AUTOSCALE_MAX_REPLICAS"], 4),
+            autoscale_window_s=_env_float(["AUTOSCALE_WINDOW_S"], 15.0),
+            autoscale_out_busy=_env_float(["AUTOSCALE_OUT_BUSY"], 0.75),
+            autoscale_in_busy=_env_float(["AUTOSCALE_IN_BUSY"], 0.15),
+            autoscale_out_backlog=_env_float(
+                ["AUTOSCALE_OUT_BACKLOG"], 0.5
+            ),
+            autoscale_out_cooldown_s=_env_float(
+                ["AUTOSCALE_OUT_COOLDOWN_S"], 30.0
+            ),
+            autoscale_in_cooldown_s=_env_float(
+                ["AUTOSCALE_IN_COOLDOWN_S"], 60.0
+            ),
+            autoscale_poll_interval_s=_env_float(
+                ["AUTOSCALE_POLL_INTERVAL_S"], 1.0
+            ),
         )
 
     def parsed_replica_workers(self) -> list[tuple[str, int]]:

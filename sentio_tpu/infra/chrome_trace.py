@@ -224,7 +224,14 @@ def build_fleet_trace(workers: list[dict], router_ticks: Optional[list] = None,
     (``worker_origin − clock_offset − router_origin`` for cross-process
     clocks; see ProcessReplica.fetch_flight) and ``uncertainty_s`` is the
     ClockSync bound, stamped on the lane name — a reader can see exactly
-    how far causality claims stretch."""
+    how far causality claims stretch.
+
+    An entry may also carry ``"status": "retired" | "dead"`` — a worker
+    incarnation that no longer answers but whose last cached telemetry
+    frame the router still holds. Its lane renders from that cached data
+    (or as an empty named lane when even that is gone) with the status
+    suffixed to the lane name, so churn reads as history instead of a
+    silently missing row."""
     all_ticks = [dict(t) for t in (router_ticks or [])]
     all_records = [dict(r) for r in (router_records or [])]
     names: dict[int, str] = {}
@@ -234,10 +241,12 @@ def build_fleet_trace(workers: list[dict], router_ticks: Optional[list] = None,
         shift = float(worker.get("shift_s", 0.0))
         pid = _FLEET_PID_BASE * (replica + 1) + epoch
         bound = worker.get("uncertainty_s")
+        status = str(worker.get("status") or "").strip().lower()
         names[pid] = (
             f"worker {replica} epoch {epoch}"
             + (f" (clock ±{float(bound) * 1e3:.1f}ms)"
                if bound is not None else " (clock unaligned)")
+            + (f" ({status})" if status else "")
         )
         for tick in worker.get("ticks") or []:
             shifted = dict(tick, replica=pid)
@@ -257,10 +266,20 @@ def build_fleet_trace(workers: list[dict], router_ticks: Optional[list] = None,
                     float(shifted["t_start_s"]) + shift, 6)
             all_records.append(shifted)
     trace = build_chrome_trace(all_ticks, all_records, label=label)
+    named: set[int] = set()
     for event in trace["traceEvents"]:
         if (event.get("ph") == "M" and event.get("name") == "process_name"
                 and event["pid"] in names):
             event["args"]["name"] = names[event["pid"]]
+            named.add(event["pid"])
+    # dead/retired incarnations whose cached frame carried no ticks or
+    # records produce no events, so build_chrome_trace never names their
+    # pid — force the metadata row so the lane still appears in the trace
+    for pid in sorted(set(names) - named):
+        trace["traceEvents"].insert(0, {
+            "name": "process_name", "ph": "M", "pid": pid,
+            "tid": 0, "args": {"name": names[pid]},
+        })
     return trace
 
 

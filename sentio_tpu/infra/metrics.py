@@ -418,6 +418,28 @@ class MetricsCollector:
                 "worker telemetry frames/series dropped at merge",
                 ["replica", "reason"], registry=r,
             ),
+            # elastic fleet: membership is now a runtime variable, so the
+            # live size is a gauge and every autoscaler decision a counter
+            # (monitoring.yaml's SentioTpuAutoscaleFlapping alerts on
+            # decision churn; ...FleetAtMaxSaturated on the gauge below)
+            "fleet_size": Gauge(
+                "sentio_tpu_fleet_live_replicas",
+                "live (non-retired) replicas currently wired into the "
+                "serving set",
+                [], registry=r,
+            ),
+            "autoscale_decisions": Counter(
+                "sentio_tpu_autoscale_decisions_total",
+                "executed autoscaler decisions by direction and the "
+                "signal that triggered them",
+                ["direction", "reason"], registry=r,
+            ),
+            "fleet_saturated": Gauge(
+                "sentio_tpu_fleet_at_max_saturated",
+                "1 while the fleet sits at AUTOSCALE_MAX_REPLICAS with "
+                "the windowed load still above the scale-out thresholds",
+                [], registry=r,
+            ),
         }
 
     # ------------------------------------------------------------- recording
@@ -638,6 +660,38 @@ class MetricsCollector:
         counter = self._prom.get("worker_reconnects")
         if counter is not None:
             counter.labels(outcome).inc()
+
+    def record_fleet_size(self, live: int) -> None:
+        """Publish the live (non-retired) replica count — re-derived by
+        ``ReplicaSet`` whenever membership changes (join/retire), so the
+        gauge steps exactly at the scale events."""
+        if not self.enabled:
+            return
+        self.memory.set_gauge("fleet_size", (), float(live))
+        gauge = self._prom.get("fleet_size")
+        if gauge is not None:
+            gauge.set(float(live))
+
+    def record_autoscale_decision(self, direction: str, reason: str) -> None:
+        """One EXECUTED autoscaler decision (``direction``: out | in;
+        ``reason``: busy | backlog | idle) — the churn series behind
+        SentioTpuAutoscaleFlapping."""
+        if not self.enabled:
+            return
+        self.memory.inc("autoscale_decisions", (direction, reason))
+        counter = self._prom.get("autoscale_decisions")
+        if counter is not None:
+            counter.labels(direction, reason).inc()
+
+    def record_fleet_saturation(self, value: float) -> None:
+        """1.0 while the fleet is pinned at max replicas AND the windowed
+        load still clears the scale-out thresholds; 0.0 otherwise."""
+        if not self.enabled:
+            return
+        self.memory.set_gauge("fleet_saturated", (), float(value))
+        gauge = self._prom.get("fleet_saturated")
+        if gauge is not None:
+            gauge.set(float(value))
 
     def record_stream_resume(self, outcome: str) -> None:
         """One mid-flight stream resume outcome (``outcome``: resumed |

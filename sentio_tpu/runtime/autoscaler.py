@@ -1,0 +1,339 @@
+"""Duty-cycle autoscaler: a load-following policy loop over the replica set.
+
+The replica tier already exposes the HPA-style saturation signal — every
+replica pushes duty-cycle fractions (host/device/idle) and backlog depth
+through its status/telemetry frames, and ``ReplicaSet.fleet_load()`` folds
+them into a single cached sample (zero RPCs at poll cadence). This module
+closes the loop:
+
+``AutoscalePolicy``
+    A pure decision kernel — ``observe()`` accumulates (busy, backlog)
+    samples over a sliding ``window_s``; ``decide()`` returns ``"out"`` /
+    ``"in"`` / ``None`` with a reason. Sustained busy fraction or backlog
+    fraction above the scale-out threshold grows the fleet; sustained
+    idle below the scale-in threshold shrinks it. Hysteresis is the gap
+    between the two thresholds (the constructor clamps ``in_busy <=
+    out_busy``), and each direction has its own cooldown — scale-in
+    additionally measures from the *last change in either direction* so
+    an out→in flap cannot happen inside ``in_cooldown_s``. Min/max
+    bounds clamp every decision. No clocks, no threads: fully
+    unit-testable with synthetic timestamps.
+
+``Autoscaler``
+    The actuator thread (role ``autoscaler``, thread name
+    ``fleet-autoscaler``): samples the set, feeds the policy, and acts —
+    scale-out through a pluggable *launcher seam* (a zero-arg callable;
+    local fleets spawn a socket worker that dials the registry with the
+    elastic-join sentinel slot ``-1``, remote fleets just register on
+    their own), scale-in by retiring the most-idle serving replica via
+    ``ReplicaSet.retire()`` (drain + handoff + token-exact stream
+    completion — see replica.py). Every decision is a flight-recorder
+    event plus ``sentio_tpu_autoscale_decisions_total{direction,reason}``.
+
+The whole subsystem is inert by default: ``serve/dependencies.py`` only
+constructs an ``Autoscaler`` when ``AUTOSCALE=1``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from sentio_tpu.analysis.sanitizer import make_lock
+from sentio_tpu.infra.metrics import get_metrics
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["AutoscalePolicy", "Autoscaler", "socket_worker_launcher"]
+
+
+class AutoscalePolicy:
+    """Pure scale-out/scale-in decision kernel (no clocks, no threads).
+
+    Callers own the clock: pass the same monotonic ``now`` to
+    ``observe()`` and ``decide()``. A decision is only actionable once
+    the sample window has real coverage (span >= 80% of ``window_s``),
+    so a single hot poll after startup or after a scale event (which
+    clears the window — old samples describe the old fleet) can never
+    trigger a flap.
+    """
+
+    def __init__(
+        self,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        window_s: float = 15.0,
+        out_busy: float = 0.75,
+        in_busy: float = 0.15,
+        out_backlog: float = 0.5,
+        out_cooldown_s: float = 30.0,
+        in_cooldown_s: float = 60.0,
+    ) -> None:
+        self.min_replicas = max(int(min_replicas), 1)
+        self.max_replicas = max(int(max_replicas), self.min_replicas)
+        self.window_s = max(float(window_s), 0.1)
+        self.out_busy = min(max(float(out_busy), 0.0), 1.0)
+        # hysteresis: the scale-in threshold can never meet or cross the
+        # scale-out threshold, whatever the env knobs say
+        self.in_busy = min(max(float(in_busy), 0.0), self.out_busy)
+        self.out_backlog = min(max(float(out_backlog), 0.0), 1.0)
+        self.out_cooldown_s = max(float(out_cooldown_s), 0.0)
+        self.in_cooldown_s = max(float(in_cooldown_s), 0.0)
+        # leaf lock: nothing is called while holding it. Tests drive the
+        # policy from the caller thread while the autoscaler thread polls.
+        self._mutex = make_lock("AutoscalePolicy._mutex")
+        self._samples: deque = deque()  # guarded-by: _mutex
+        self._last_out: Optional[float] = None  # guarded-by: _mutex
+        self._last_change: Optional[float] = None  # guarded-by: _mutex
+
+    def observe(self, now: float, busy_fraction: float,
+                backlog_fraction: float) -> None:
+        """Fold one fleet sample into the sliding window."""
+        with self._mutex:
+            self._samples.append((float(now), float(busy_fraction),
+                                  float(backlog_fraction)))
+            self._prune_locked(now)
+
+    def _prune_locked(self, now: float) -> None:
+        horizon = now - self.window_s
+        while self._samples and self._samples[0][0] < horizon:
+            self._samples.popleft()
+
+    def note_scaled(self, now: float, direction: str) -> None:
+        """Book an executed decision: start the cooldowns and clear the
+        window (samples taken against the old fleet size say nothing
+        about the new one)."""
+        with self._mutex:
+            if direction == "out":
+                self._last_out = now
+            self._last_change = now
+            self._samples.clear()
+
+    def decide(self, now: float, current_replicas: int) -> tuple:
+        """Return ``("out"|"in", reason)`` or ``(None, reason)``."""
+        with self._mutex:
+            self._prune_locked(now)
+            if len(self._samples) < 2:
+                return None, "window_warming"
+            span = self._samples[-1][0] - self._samples[0][0]
+            if span + 1e-9 < self.window_s * 0.8:
+                return None, "window_warming"
+            busy = sum(s[1] for s in self._samples) / len(self._samples)
+            backlog = sum(s[2] for s in self._samples) / len(self._samples)
+            if busy >= self.out_busy or backlog >= self.out_backlog:
+                if current_replicas >= self.max_replicas:
+                    return None, "at_max"
+                if self._last_out is not None and \
+                        now - self._last_out < self.out_cooldown_s:
+                    return None, "out_cooldown"
+                return "out", ("busy" if busy >= self.out_busy
+                               else "backlog")
+            if busy <= self.in_busy and backlog <= self.out_backlog / 4.0:
+                if current_replicas <= self.min_replicas:
+                    return None, "at_min"
+                if self._last_change is not None and \
+                        now - self._last_change < self.in_cooldown_s:
+                    return None, "in_cooldown"
+                return "in", "idle"
+            return None, "steady"
+
+    def saturated(self, now: float) -> bool:
+        """True when the windowed mean load sits at or above the
+        scale-out thresholds (used for the at-max alert gauge)."""
+        with self._mutex:
+            self._prune_locked(now)
+            if not self._samples:
+                return False
+            busy = sum(s[1] for s in self._samples) / len(self._samples)
+            backlog = sum(s[2] for s in self._samples) / len(self._samples)
+            return busy >= self.out_busy or backlog >= self.out_backlog
+
+
+def socket_worker_launcher(address, spec) -> Callable[[], None]:
+    """Launcher seam for local socket fleets: each call spawns one worker
+    process that dials the registry at ``address`` with the elastic-join
+    sentinel slot ``-1`` — the registry allocates a fresh slot, the
+    membership source wires the replica in, and the autoscaler never
+    touches the registration path itself. Remote fleets skip this seam
+    entirely and just register."""
+    def _launch() -> None:
+        import multiprocessing
+
+        from sentio_tpu.runtime.worker import worker_main_socket
+
+        ctx = multiprocessing.get_context("spawn")
+        proc = ctx.Process(  # lint: allow(no-fork) — spawn context
+            target=worker_main_socket,
+            args=(tuple(address), spec, -1),
+            name="sentio-elastic-worker",
+            daemon=True,
+        )
+        proc.start()
+        logger.info("launched elastic worker pid=%s", proc.pid)
+
+    return _launch
+
+
+class Autoscaler:
+    """Actuator thread gluing ``AutoscalePolicy`` to a ``ReplicaSet``.
+
+    One poll = one ``step()``: sample ``fleet_load()``, feed the policy,
+    and on a decision either invoke the launcher (scale-out) or retire
+    the most-idle serving replica (scale-in). ``step()`` is public so
+    drills and units can drive the loop with synthetic clocks instead of
+    waiting out real cooldowns. In-flight launches count toward the
+    max-replicas clamp until the worker actually joins (or
+    ``launch_grace_s`` expires) — a slow compile+register must not let
+    the policy re-fire past the bound. The loop thread is fully
+    exception-guarded — a failed launch or a refused retire (e.g. the
+    last-serving guard) is logged and retried at the next poll, never
+    fatal."""
+
+    def __init__(
+        self,
+        replica_set,
+        policy: AutoscalePolicy,
+        launcher: Optional[Callable[[], None]] = None,
+        poll_interval_s: float = 1.0,
+        launch_grace_s: float = 120.0,
+    ) -> None:
+        self._set = replica_set
+        self._policy = policy
+        self._launcher = launcher
+        self.poll_interval_s = max(float(poll_interval_s), 0.05)
+        self.launch_grace_s = max(float(launch_grace_s), 1.0)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # leaf lock for the decision counters: step() may run on the
+        # autoscaler thread or a drill's caller thread
+        self._mutex = make_lock("Autoscaler._mutex")
+        self._decisions = {"out": 0, "in": 0}  # guarded-by: _mutex
+        self._skipped = 0  # guarded-by: _mutex
+        self._pending_launches: list = []  # guarded-by: _mutex
+        self._last_serving: Optional[int] = None  # guarded-by: _mutex
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-autoscaler", daemon=True,
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 — the autoscaler must outlive any single bad pass
+                logger.exception("autoscale pass failed")
+
+    def step(self, now: Optional[float] = None) -> Optional[str]:
+        """One observe→decide→act pass; returns the executed direction
+        (``"out"``/``"in"``) or ``None``."""
+        now = time.monotonic() if now is None else now
+        load = self._set.fleet_load()
+        self._policy.observe(now, load["busy"], load["backlog_fraction"])
+        serving = int(load["serving"])
+        with self._mutex:
+            # a launched worker is invisible to fleet_load() until it
+            # compiles, registers, and attaches (tens of seconds) — count
+            # in-flight launches toward the bound, or the policy re-fires
+            # every cooldown and storms past max_replicas. A serving-count
+            # rise absorbs one pending entry per new replica; entries
+            # older than launch_grace_s are presumed dead and dropped so
+            # a failed launch can't pin the fleet below max forever.
+            if self._last_serving is not None and \
+                    serving > self._last_serving:
+                del self._pending_launches[:serving - self._last_serving]
+            self._last_serving = serving
+            self._pending_launches = [
+                t for t in self._pending_launches
+                if now - t < self.launch_grace_s
+            ]
+            pending = len(self._pending_launches)
+        effective = serving + pending
+        direction, reason = self._policy.decide(now, effective)
+        at_max = effective >= self._policy.max_replicas
+        try:
+            get_metrics().record_fleet_saturation(
+                1.0 if (at_max and self._policy.saturated(now)) else 0.0)
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            logger.debug("fleet saturation gauge failed", exc_info=True)
+        if direction is None:
+            return None
+        if direction == "out":
+            ok = self._scale_out(reason)
+        else:
+            ok = self._scale_in(load, reason)
+        if ok:
+            self._policy.note_scaled(now, direction)
+            with self._mutex:
+                self._decisions[direction] += 1
+                if direction == "out":
+                    self._pending_launches.append(now)
+            self._book_decision(direction, reason)
+            return direction
+        with self._mutex:
+            self._skipped += 1
+        return None
+
+    def _scale_out(self, reason: str) -> bool:
+        if self._launcher is None:
+            logger.debug("scale-out wanted (%s) but no launcher is wired",
+                         reason)
+            return False
+        try:
+            self._launcher()
+        except Exception:  # noqa: BLE001 — a failed launch must not kill the loop
+            logger.exception("elastic worker launch failed")
+            return False
+        return True
+
+    def _scale_in(self, load: dict, reason: str) -> bool:
+        per = load.get("replicas") or []
+        if not per:
+            return False
+        # most idle first; backlog breaks ties so we never drain a
+        # replica that still holds queued work while an emptier one exists
+        target = min(per, key=lambda p: (p["busy"], p["backlog"]))
+        try:
+            result = self._set.retire(target["replica"])
+        except Exception:  # noqa: BLE001 — last-serving guard / races: retry next poll
+            logger.info("scale-in of replica %s refused",
+                        target["replica"], exc_info=True)
+            return False
+        return bool(result.get("retired"))
+
+    def _book_decision(self, direction: str, reason: str) -> None:
+        logger.info("autoscale decision: %s (%s)", direction, reason)
+        try:
+            get_metrics().record_autoscale_decision(direction, reason)
+            from sentio_tpu.infra.flight import get_flight_recorder
+
+            get_flight_recorder().record_tick(
+                event="autoscale_decision", direction=direction,
+                reason=reason,
+            )
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            logger.debug("autoscale decision telemetry failed",
+                         exc_info=True)
+
+    def stats(self) -> dict:
+        with self._mutex:
+            return {
+                "scale_out": self._decisions["out"],
+                "scale_in": self._decisions["in"],
+                "skipped": self._skipped,
+                "pending_launches": len(self._pending_launches),
+            }
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            # a retire mid-pass blocks up to the drain deadline
+            t.join(timeout=timeout_s)
+        self._thread = None

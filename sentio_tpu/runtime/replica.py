@@ -168,6 +168,8 @@ __all__ = [
     "HEALTH_DEGRADED",
     "HEALTH_QUARANTINED",
     "HEALTH_REBUILDING",
+    "HEALTH_RETIRING",
+    "HEALTH_RETIRED",
     "HEALTH_STATES",
 ]
 
@@ -181,8 +183,15 @@ HEALTH_HEALTHY = "HEALTHY"
 HEALTH_DEGRADED = "DEGRADED"
 HEALTH_QUARANTINED = "QUARANTINED"
 HEALTH_REBUILDING = "REBUILDING"
+# elastic-fleet states: RETIRING drains a replica that is leaving the set
+# voluntarily (scale-in / deregister) — the router never selects it but its
+# in-flight work finishes or resumes on survivors; RETIRED is the terminal
+# parked state of a slot whose worker is gone (the slot id stays stable so
+# gauges, tried-sets, and sanitizer guard names never alias across a reuse)
+HEALTH_RETIRING = "RETIRING"
+HEALTH_RETIRED = "RETIRED"
 HEALTH_STATES = (HEALTH_HEALTHY, HEALTH_DEGRADED, HEALTH_QUARANTINED,
-                 HEALTH_REBUILDING)
+                 HEALTH_REBUILDING, HEALTH_RETIRING, HEALTH_RETIRED)
 
 
 @dataclass
@@ -273,7 +282,10 @@ class TenantFairQueue:
         self.batch_shed_fraction = min(max(float(batch_shed_fraction), 0.0), 1.0)
         self.min_quota = max(int(min_quota), 1)
         # reserved slack no single tenant's quota may consume: the landing
-        # room for a tenant the system has not seen yet
+        # room for a tenant the system has not seen yet. An explicit
+        # headroom survives capacity re-derivation (set_capacity); the
+        # default formula re-derives with the fleet.
+        self._explicit_headroom = headroom is not None
         self.headroom = (
             int(headroom) if headroom is not None
             else max(1, self.capacity // 8)
@@ -335,6 +347,19 @@ class TenantFairQueue:
         )
 
     # --------------------------------------------------------------- public
+
+    def set_capacity(self, capacity: int) -> None:
+        """Re-derive the shared queue capacity from live fleet membership
+        (elastic join / graceful retire). Quotas are computed per-admit from
+        ``capacity``/``headroom``, so held reservations need no migration: a
+        shrink only tightens FUTURE admissions, it never revokes a pending
+        one. An explicitly configured headroom is kept (re-clamped); the
+        default formula re-derives with the new capacity."""
+        with self._mutex:
+            self.capacity = max(int(capacity), 1)  # guarded-by: _mutex
+            if not self._explicit_headroom:
+                self.headroom = max(1, self.capacity // 8)  # guarded-by: _mutex
+            self.headroom = min(self.headroom, self.capacity - 1)  # guarded-by: _mutex
 
     def admit(self, tenant: str, cost_tokens: int,
               priority: str = PRIORITY_INTERACTIVE,
@@ -498,7 +523,20 @@ class WorkerRegistry:
     One listener serves every slot; worker hellos are authenticated with
     the shared token (constant-time compare) and version-checked before
     any epoch is granted. Rejections are counted into
-    ``sentio_tpu_worker_reconnects_total{outcome=rejected_*}``."""
+    ``sentio_tpu_worker_reconnects_total{outcome=rejected_*}``.
+
+    **Elastic membership** — the startup slot count is a floor, not a
+    ceiling. A hello carrying ``slot == -1`` is an ELASTIC JOIN: the
+    registry allocates a slot (reusing a released one when available, else
+    growing the set), acks the assigned slot back (``hello_ack`` carries
+    ``"slot"`` — the worker adopts it for reconnects), and publishes a
+    join event (:meth:`drain_joins`) the ReplicaSet's supervisor consumes
+    to wire a new :class:`~sentio_tpu.runtime.worker.ProcessReplica` into
+    rotation. :meth:`release_slot` returns a slot after graceful retire;
+    the slot's epoch entry SURVIVES release, so a reused slot's first
+    epoch continues the monotonic fence and pre-retire frames can never
+    read as fresh. Explicit out-of-range slots stay rejected — elastic
+    join is opt-in via the sentinel, not a blanket trust of any slot id."""
 
     def __init__(
         self,
@@ -524,6 +562,18 @@ class WorkerRegistry:
         self._stale = [0] * self.slots  # guarded-by: _mutex
         self._registrations = 0  # guarded-by: _mutex
         self._rejections = 0  # guarded-by: _mutex
+        # elastic membership book-keeping: released slot ids available for
+        # reuse, elastic-join counters, and the join-event queue the
+        # ReplicaSet supervisor drains to attach new workers. _pending only
+        # GROWS (never shrinks) so lock-free indexed reads stay valid; the
+        # per-slot queues are themselves thread-safe.
+        self._free: list[int] = []  # guarded-by: _mutex
+        self._elastic_joins = 0  # guarded-by: _mutex
+        self._released = 0  # guarded-by: _mutex
+        self._joins: _queue.Queue = _queue.Queue()
+        # deliberately NOT lock-guarded: the list only grows (appends
+        # happen under _mutex in _alloc_slot, indices never shift), so a
+        # lock-free indexed read always lands on a valid thread-safe Queue
         self._pending: list[_queue.Queue] = [
             _queue.Queue() for _ in range(self.slots)
         ]
@@ -580,6 +630,57 @@ class WorkerRegistry:
         with self._mutex:
             return self._stale[slot]
 
+    # ------------------------------------------------------------ elasticity
+
+    def _alloc_slot(self) -> int:
+        """Allocate a slot for an elastic join: reuse the lowest released
+        slot when one exists (its epoch entry was kept, so the fence
+        continues), else grow the slot set by one."""
+        with self._mutex:
+            if self._free:
+                self._free.sort()
+                slot = self._free.pop(0)
+            else:
+                slot = self.slots
+                self.slots += 1  # guarded-by: _mutex
+                self._epochs.append(0)
+                self._stale.append(0)
+                self._pending.append(_queue.Queue())
+            self._elastic_joins += 1
+        return slot
+
+    def release_slot(self, slot: int) -> None:
+        """Return a slot after a graceful retire. The epoch entry is KEPT
+        (not reset): the next worker on this slot registers at a HIGHER
+        epoch than every frame the retired incarnation ever sent, so slot
+        reuse can never un-fence stale frames. Double-release is a no-op."""
+        with self._mutex:
+            if not (0 <= slot < self.slots) or slot in self._free:
+                return
+            self._free.append(slot)
+            self._released += 1
+        # drop any registration that raced the release onto the queue: a
+        # redial of the retired incarnation must not be adopted later
+        q = self._pending[slot]
+        while True:
+            try:
+                transport, _h, _e = q.get_nowait()
+            except _queue.Empty:
+                break
+            transport.close()
+
+    def drain_joins(self) -> list[int]:
+        """Slots elastically joined since the last call (non-blocking).
+        The ReplicaSet supervisor polls this to wire new workers into
+        rotation; each slot appears once per registration event."""
+        slots: list[int] = []
+        while True:
+            try:
+                slots.append(self._joins.get_nowait())
+            except _queue.Empty:
+                break
+        return slots
+
     # ---------------------------------------------------------- registration
 
     def _accept_loop(self) -> None:
@@ -635,21 +736,46 @@ class WorkerRegistry:
             transport.close()
             return
         slot = hello.get("slot", -1)
-        if not isinstance(slot, int) or not (0 <= slot < self.slots):
+        elastic = isinstance(slot, int) and slot == -1
+        if elastic:
+            # elastic join: the worker asks for a slot instead of claiming
+            # one — allocate (reuse-or-grow) and tell it the answer in the
+            # ack so its reconnect loop redials the SAME identity
+            try:
+                faults.hit("registry.elastic_join")
+            except Exception as exc:  # noqa: BLE001 — chaos: an injected join failure must reject typed, not kill the handshake thread
+                self._reject(transport, transport,
+                             f"elastic join failed: {exc}")
+                return
+            slot = self._alloc_slot()
+        elif not isinstance(slot, int) or not (0 <= slot < self.slots):
             self._reject(transport, transport, f"unknown slot {slot!r}")
             return
+        else:
+            with self._mutex:
+                retired = slot in self._free
+            if retired:
+                # a retired incarnation redialing its released slot: a
+                # typed rejection stops its reconnect loop — adopting it
+                # would resurrect a worker the fleet already drained out
+                self._reject(transport, transport,
+                             f"slot {slot} was retired")
+                return
         epoch = self.assign_epoch(slot)
         transport.fault_scope = f"r{slot}"
         transport.epoch = epoch
         try:
-            transport.send((0, "hello_ack", {"epoch": epoch}))
+            transport.send((0, "hello_ack", {"epoch": epoch, "slot": slot}))
         except TransportError:
+            if elastic:
+                self.release_slot(slot)
             transport.close()
             return
         with self._mutex:
             self._registrations += 1
-        logger.info("worker registered for slot %d at epoch %d (pid %s)",
-                    slot, epoch, hello.get("pid"))
+        logger.info("worker registered for slot %d at epoch %d (pid %s%s)",
+                    slot, epoch, hello.get("pid"),
+                    ", elastic join" if elastic else "")
         q = self._pending[slot]
         # supersede by EPOCH, not by arrival order: two racing
         # registrations for a slot (a partitioned worker's redial vs the
@@ -667,6 +793,10 @@ class WorkerRegistry:
         for old_transport, _h, _e in entries[:-1]:
             old_transport.close()
         q.put(entries[-1])
+        if elastic:
+            # publish the join AFTER the registration is queued: the
+            # consumer's await_registration must find the transport
+            self._joins.put(slot)
 
     # frame-emit: handshake-to-dialer via=socket
     def _reject(self, transport, ackable, reason: str) -> None:
@@ -722,6 +852,10 @@ class WorkerRegistry:
                 "stale_frames": list(self._stale),
                 "registrations": self._registrations,
                 "rejections": self._rejections,
+                "slots": self.slots,
+                "free_slots": sorted(self._free),
+                "elastic_joins": self._elastic_joins,
+                "released_slots": self._released,
             }
 
     def close(self) -> None:
@@ -850,6 +984,17 @@ class ReplicaSet:
         ]  # guarded-by: _mutex
         self._failovers = 0  # guarded-by: _mutex
         self._closed = False  # guarded-by: _mutex
+        # elastic-fleet counters: runtime joins, graceful retires, and the
+        # ONLY trace a retired replica leaves behind besides its slot id
+        self._joined = 0  # guarded-by: _mutex
+        self._retired = 0  # guarded-by: _mutex
+        self._retire_drain_s: deque = deque(maxlen=256)  # guarded-by: _mutex
+        # membership source: a callable returning freshly registered
+        # services to wire into rotation (socket mode wires the registry's
+        # drain_joins here). Single-writer (set once at startup before the
+        # supervisor observes it), read by the supervisor pass.
+        self._membership_source = None
+        self._release_slot = None
         # stall-tolerance telemetry: inbox tickets moved to survivors at
         # quarantine, stall-triggered quarantines, and pump_leaked counts
         # carried over from service incarnations a rebuild replaced (the
@@ -1407,6 +1552,268 @@ class ReplicaSet:
         idx, _hit = self._route(toks, count=False)
         self._services[idx].check_admission(deadline_ts)
 
+    # ------------------------------------------------------- elastic fleet
+
+    def set_membership_source(self, source, release_slot=None) -> None:
+        """Install the callable the supervisor polls each pass for freshly
+        joined replicas (socket mode wires a closure that drains the
+        WorkerRegistry's join events and builds one ``ProcessReplica`` per
+        new slot). The source returns ``[(slot, service), ...]`` —
+        ``slot=None`` lets the set pick its own index (thread mode).
+        ``release_slot`` (optional) is called with the slot index after a
+        graceful retire closes the worker, returning the registry slot to
+        the elastic free list. Install at startup, before traffic — both
+        attributes are single-writer and read only by supervisor-side
+        passes."""
+        self._membership_source = source
+        self._release_slot = release_slot
+
+    def _rederive_capacity(self) -> None:
+        """Re-derive the WFQ summed capacity (and default headroom) from
+        live membership after a join or retire. The snapshot is taken under
+        ``_mutex``; the fair queue is updated OUTSIDE it so no ReplicaSet →
+        TenantFairQueue lock-order edge is ever created."""
+        with self._mutex:
+            caps = [
+                getattr(self._services[i], "max_queue", 0)
+                for i, h in enumerate(self._health)
+                if h.state != HEALTH_RETIRED
+            ]
+        self.tenants.set_capacity(sum(caps))
+        try:
+            live = len(caps)
+            get_metrics().record_fleet_size(live)
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            pass
+
+    def fleet_load(self) -> dict:
+        """Lightweight saturation sample for the autoscaler: serving
+        replica count, mean busy fraction (``1 - idle`` duty), and summed
+        backlog as a fraction of summed queue capacity — all from cached
+        probes (process/socket replicas answer from their pushed status
+        frames, so sampling at supervisor cadence costs zero RPCs)."""
+        with self._mutex:
+            serving = [
+                (i, self._services[i])
+                for i, h in enumerate(self._health)
+                if h.state in (HEALTH_HEALTHY, HEALTH_DEGRADED)
+            ]
+        per: list[dict] = []
+        backlog_total = 0
+        capacity_total = 0
+        for i, svc in serving:
+            try:
+                duty = svc.duty_cycle() or {}
+                idle = float(duty.get("idle", 1.0))
+                backlog = int(svc.backlog())
+            except Exception:  # noqa: BLE001 — replica mid-swap: skip one sample
+                continue
+            busy = max(0.0, min(1.0, 1.0 - idle))
+            backlog_total += backlog
+            capacity_total += int(getattr(svc, "max_queue", 0) or 0)
+            per.append({"replica": i, "busy": busy, "backlog": backlog})
+        busy_mean = (sum(p["busy"] for p in per) / len(per)) if per else 0.0
+        return {
+            "serving": len(serving),
+            "busy": busy_mean,
+            "backlog_fraction": (backlog_total / capacity_total
+                                 if capacity_total else 0.0),
+            "replicas": per,
+        }
+
+    def add_replica(self, svc, idx: Optional[int] = None) -> int:
+        """Wire a NEW replica into rotation at runtime (elastic join).
+        ``idx=None`` reuses the lowest RETIRED slot, else appends; socket
+        mode passes the registry slot so router index and wire identity
+        stay aligned. The new replica enters HEALTHY, the WFQ capacity and
+        headroom re-derive from live membership, and — under a supervising
+        set — shadow handoff arms exactly like a startup replica. Returns
+        the slot index the replica serves under."""
+        faults.hit("replica.join")
+        supervised = self._supervisor is not None
+        with self._mutex:
+            if self._closed:
+                raise ReplicaUnavailable(
+                    "replica set is closed", retry_after_s=1.0,
+                    retryable=False,
+                )
+            if idx is None:
+                idx = next((i for i, h in enumerate(self._health)
+                            if h.state == HEALTH_RETIRED), None)
+            elif idx < len(self._health) \
+                    and self._health[idx].state != HEALTH_RETIRED:
+                raise ValueError(
+                    f"slot {idx} is occupied by a "
+                    f"{self._health[idx].state} replica")
+            elif idx > len(self._health):
+                raise ValueError(
+                    f"slot {idx} would leave a gap (set holds "
+                    f"{len(self._health)} slots)")
+            elif idx == len(self._health):
+                idx = None  # plain append
+            live = [self._services[i] for i, h in enumerate(self._health)
+                    if h.state != HEALTH_RETIRED]
+            self._check_isolation(live + [svc])
+            fresh_health = _ReplicaHealth(
+                since=time.perf_counter(),
+                ticks_seen=getattr(svc, "tick_failure_count", 0) or 0,
+            )
+            if idx is None:
+                idx = len(self._services)
+                svc.replica_id = idx
+                self._services.append(svc)
+                self._health.append(fresh_health)
+            else:
+                # RETIRED slot reuse: stable index, fresh incarnation — the
+                # retired service already folded its leaked pumps into the
+                # carryover at retire time
+                svc.replica_id = idx
+                self._services[idx] = svc
+                self._health[idx] = fresh_health
+            guard = getattr(getattr(svc, "engine", None), "_san", None)
+            if guard is not None:
+                guard.name = f"ContinuousBatchingEngine[r{idx}]"
+            self._joined += 1
+        if supervised:
+            enable = getattr(svc, "enable_shadow_handoff", None)
+            if enable is not None:
+                enable()
+        self._rederive_capacity()
+        logger.info("replica %d joined the set at runtime", idx)
+        try:
+            get_metrics().record_replica_health(idx, HEALTH_HEALTHY)
+            from sentio_tpu.infra.flight import get_flight_recorder
+
+            get_flight_recorder().record_tick(
+                event="replica_joined", replica=idx,
+            )
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            logger.debug("replica join telemetry failed", exc_info=True)
+        return idx
+
+    def retire(self, idx: int, deadline_s: Optional[float] = None) -> dict:
+        """Gracefully remove replica ``idx`` (scale-in / voluntary
+        deregister): mark RETIRING (the router never selects it again),
+        hand its never-dispatched inbox tickets to survivors through the
+        quarantine handoff path (WFQ recharge — callers just wake with a
+        survivor's result), drain in-flight work within ``deadline_s``
+        (default ``rebuild_drain_s``; a delivered-token stream that the
+        deadline cuts off resumes token-exact on a survivor via the normal
+        resume path, costing the caller nothing), then close the service,
+        park the slot RETIRED, release the registry slot, and re-derive
+        WFQ capacity. Refuses to retire the last serving replica. Blocking
+        (up to the drain deadline) — callers that must not stall ride the
+        rebuild worker pool via the supervisor's deregister path."""
+        deadline = (float(deadline_s) if deadline_s is not None
+                    else self.rebuild_drain_s)
+        with self._mutex:
+            if self._closed:
+                raise ReplicaUnavailable(
+                    "replica set is closed", retry_after_s=1.0,
+                    retryable=False,
+                )
+            if not (0 <= idx < len(self._health)):
+                raise ValueError(f"no replica {idx} to retire")
+            state = self._health[idx].state
+            if state in (HEALTH_RETIRING, HEALTH_RETIRED):
+                return {"replica": idx, "state": state, "retired": False}
+            serving_others = sum(
+                1 for i, h in enumerate(self._health)
+                if i != idx and h.state in (HEALTH_HEALTHY, HEALTH_DEGRADED)
+            )
+            if serving_others == 0:
+                raise ReplicaUnavailable(
+                    f"cannot retire replica {idx}: no other serving "
+                    "replica would remain", retry_after_s=5.0,
+                    retryable=False,
+                    details={"replica": idx, "reason": "last_serving"},
+                )
+        faults.hit("replica.retire")
+        t0 = time.perf_counter()
+        self._transition(idx, HEALTH_RETIRING, "scale-in")
+        svc = self._services[idx]
+        # queued-never-dispatched tickets move to survivors NOW — waiting
+        # out the drain would add the whole deadline to their latency
+        inbox: list = []
+        try:
+            inbox = svc.extract_inbox()
+        except Exception:  # noqa: BLE001 — retire must complete regardless
+            logger.exception("replica %d retire inbox extraction failed",
+                             idx)
+        self._handoff_inbox(idx, inbox)
+        drained: dict = {}
+        try:
+            drained = svc.drain(deadline) or {}
+        except Exception:  # noqa: BLE001 — drain is best-effort on retire
+            logger.warning("replica %d retire drain failed", idx,
+                           exc_info=True)
+        if not getattr(svc, "closed", False):
+            try:
+                svc.close()
+            except Exception:  # noqa: BLE001 — close every retiree regardless
+                logger.warning("replica %d retire close failed", idx,
+                               exc_info=True)
+        leaked = getattr(svc, "pump_leaked_count", 0) or 0
+        drain_s = time.perf_counter() - t0
+        with self._mutex:
+            self._retired += 1
+            self._pump_leaked_carryover += leaked
+            self._retire_drain_s.append(drain_s)
+        self._transition(idx, HEALTH_RETIRED,
+                         f"retired after {drain_s:.2f}s drain")
+        release = self._release_slot
+        if release is not None:
+            try:
+                release(idx)
+            except Exception:  # noqa: BLE001 — slot release is best-effort
+                logger.warning("registry slot %d release failed", idx,
+                               exc_info=True)
+        self._rederive_capacity()
+        return {
+            "replica": idx,
+            "retired": True,
+            "drain_s": round(drain_s, 3),
+            "handed_off": len(inbox),
+            "drained": drained.get("drained", True),
+        }
+
+    def _attach_new_members(self) -> None:
+        """One supervisor-cadence poll of the membership source: wire every
+        freshly registered worker into rotation. A single bad joiner must
+        not block the pass (or its sibling joiners)."""
+        source = self._membership_source
+        if source is None:
+            return
+        try:
+            fresh = source() or []
+        except Exception:  # noqa: BLE001 — the supervisor must survive
+            logger.exception("membership source poll failed")
+            return
+        for slot, svc in fresh:
+            try:
+                self.add_replica(svc, idx=slot)
+            except Exception:  # noqa: BLE001 — one bad joiner, not the pass
+                logger.exception("elastic join of slot %s failed", slot)
+                try:
+                    svc.close()
+                except Exception:  # noqa: BLE001 — already on the error path
+                    logger.debug("failed joiner cleanup failed",
+                                 exc_info=True)
+
+    def _enqueue_retire(self, idx: int) -> bool:
+        """Hand one voluntary-deregister retire to the rebuild worker pool
+        (False = no pool, caller retires inline). Reuses the rebuild
+        in-flight latch so one worker slot is never queued twice."""
+        if self._rebuild_q is None:
+            return False
+        with self._mutex:
+            health = self._health[idx]
+            if health.rebuild_inflight:
+                return True  # already queued or running
+            health.rebuild_inflight = True
+        self._rebuild_q.put(("retire", idx))
+        return True
+
     # ---------------------------------------------------------- supervision
 
     def _transition(self, idx: int, state: str, reason: str = "") -> bool:
@@ -1463,7 +1870,8 @@ class ReplicaSet:
             health = self._health[idx]
             health.outcomes.append((now, False))
             state = health.state
-        if state in (HEALTH_QUARANTINED, HEALTH_REBUILDING):
+        if state in (HEALTH_QUARANTINED, HEALTH_REBUILDING,
+                     HEALTH_RETIRING, HEALTH_RETIRED):
             return
         if getattr(current, "broken", False) or getattr(current, "closed",
                                                         False):
@@ -1473,7 +1881,11 @@ class ReplicaSet:
         now = time.perf_counter()
         with self._mutex:
             health = self._health[idx]
-            if health.state in (HEALTH_QUARANTINED, HEALTH_REBUILDING):
+            if health.state in (HEALTH_QUARANTINED, HEALTH_REBUILDING,
+                                HEALTH_RETIRING, HEALTH_RETIRED):
+                # a retiring replica is already leaving gracefully — its
+                # drain/close supersedes any quarantine the breaker or a
+                # caller might race in
                 return
             health.quarantined_at = now
             health.rebuild_attempts = 0
@@ -1588,12 +2000,19 @@ class ReplicaSet:
         must not wait behind it within the pass (it still waits between
         passes — the supervisor is one thread; see ROADMAP)."""
         now = time.perf_counter()
+        # elastic joins first: a freshly registered worker should be in
+        # rotation before this pass evaluates breakers (it may be the
+        # survivor a handoff needs)
+        self._attach_new_members()
         rebuild_ready: list[int] = []
+        retire_ready: list[int] = []
         for idx in range(len(self._services)):
             svc = self._services[idx]
             with self._mutex:
                 health = self._health[idx]
                 state = health.state
+                if state in (HEALTH_RETIRING, HEALTH_RETIRED):
+                    continue
                 if state in (HEALTH_HEALTHY, HEALTH_DEGRADED):
                     # tick-failure burst: fold counter growth into the
                     # window (each increment is one failed decode tick)
@@ -1614,6 +2033,12 @@ class ReplicaSet:
                 rebuild_due = (state == HEALTH_QUARANTINED
                                and now >= health.next_rebuild_at
                                and not health.rebuild_inflight)
+            if state in (HEALTH_HEALTHY, HEALTH_DEGRADED) and \
+                    getattr(svc, "deregister_requested", None):
+                # voluntary deregister frame observed: queue a graceful
+                # retire (pool-side — the drain deadline must never stall
+                # this detection pass)
+                retire_ready.append(idx)
             if state in (HEALTH_QUARANTINED, HEALTH_REBUILDING):
                 # zero the heartbeat gauge for out-of-rotation replicas:
                 # left at its last (over-budget) value it would keep the
@@ -1699,6 +2124,27 @@ class ReplicaSet:
                 # inline so deterministic _supervise_once stepping keeps
                 # its synchronous contract
                 self._rebuild(idx)
+        for idx in retire_ready:
+            if self._stop.is_set():
+                break
+            with self._mutex:
+                serving_others = sum(
+                    1 for i, h in enumerate(self._health)
+                    if i != idx
+                    and h.state in (HEALTH_HEALTHY, HEALTH_DEGRADED))
+            if serving_others == 0:
+                # the last serving replica asked to leave: hold the retire
+                # until a sibling joins (debug — this re-evaluates every
+                # pass and must not spam operator logs)
+                logger.debug("replica %d deregister held: last serving "
+                             "replica", idx)
+                continue
+            if not self._enqueue_retire(idx):
+                try:
+                    self.retire(idx)
+                except Exception:  # noqa: BLE001 — the pass must survive
+                    logger.exception("replica %d deregister retire failed",
+                                     idx)
 
     def _enqueue_rebuild(self, idx: int) -> bool:
         """Hand one due rebuild to the worker pool (False = no pool, run
@@ -1721,11 +2167,26 @@ class ReplicaSet:
         rebuild occupies a worker, not the supervisor's breaker pass."""
         while not self._stop.is_set():
             try:
-                idx = self._rebuild_q.get(timeout=0.25)
+                item = self._rebuild_q.get(timeout=0.25)
             except _queue.Empty:
                 continue
-            if idx is None:
+            if item is None:
                 return  # shutdown sentinel
+            if isinstance(item, tuple) and item[0] == "retire":
+                # voluntary-deregister retire rides the same bounded pool:
+                # the drain deadline occupies a worker, not the supervisor
+                idx = item[1]
+                try:
+                    self.retire(idx)
+                except Exception:  # noqa: BLE001 — the pool must survive
+                    logger.exception("replica %d retire crashed on worker",
+                                     idx)
+                finally:
+                    with self._mutex:
+                        if idx < len(self._health):
+                            self._health[idx].rebuild_inflight = False
+                continue
+            idx = item
             try:
                 self._rebuild(idx)
             except Exception:  # noqa: BLE001 — the pool must survive
@@ -1872,6 +2333,10 @@ class ReplicaSet:
         (HEALTHY or DEGRADED — k8s must keep routing to a half-alive pod,
         not restart it), ``unhealthy`` only at zero serving replicas."""
         with self._mutex:
+            # RETIRED slots left the fleet on purpose: they are invisible
+            # here (a retired worker must read as "never existed") except
+            # through the retired counter; RETIRING replicas stay visible
+            # — they are draining, which an operator should see
             replicas = [
                 {
                     "replica": i,
@@ -1881,7 +2346,9 @@ class ReplicaSet:
                     **({"reason": h.last_reason} if h.last_reason else {}),
                 }
                 for i, h in enumerate(self._health)
+                if h.state != HEALTH_RETIRED
             ]
+            joined, retired = self._joined, self._retired
         serving = sum(1 for r in replicas
                       if r["state"] in (HEALTH_HEALTHY, HEALTH_DEGRADED))
         healthy = sum(1 for r in replicas if r["state"] == HEALTH_HEALTHY)
@@ -1896,6 +2363,8 @@ class ReplicaSet:
             "healthy_replicas": healthy,
             "serving_replicas": serving,
             "total_replicas": len(replicas),
+            "joined_replicas": joined,
+            "retired_replicas": retired,
             "replicas": replicas,
         }
 
@@ -1972,7 +2441,13 @@ class ReplicaSet:
         supervisor stops FIRST so a mid-drain rebuild cannot swap a fresh
         pump into a rotation that is shutting down."""
         self._stop_supervisor()
-        results: list[Optional[dict]] = [None] * len(self._services)
+        with self._mutex:
+            # RETIRED replicas already drained + closed at retire time:
+            # draining them again would only log spurious failures
+            live = [(i, self._services[i])
+                    for i, h in enumerate(self._health)
+                    if h.state != HEALTH_RETIRED]
+        results: dict[int, Optional[dict]] = {i: None for i, _svc in live}
 
         def _drain(i: int, svc: PagedGenerationService) -> None:
             try:
@@ -1983,7 +2458,7 @@ class ReplicaSet:
         threads = [
             threading.Thread(target=_drain, args=(i, svc),
                              name=f"replica-drain-{i}", daemon=True)
-            for i, svc in enumerate(self._services)
+            for i, svc in live
         ]
         for t in threads:
             t.start()
@@ -1992,9 +2467,14 @@ class ReplicaSet:
             # covers close()'s pump join, not extra drain time
             t.join(timeout=deadline_s + 15.0)
         per = []
-        for i, (svc, res) in enumerate(zip(self._services, results)):
+        for i, svc in live:
+            res = results[i]
             if res is None:
-                res = {"drained": False, "abandoned": svc.backlog()}
+                try:
+                    backlog = svc.backlog()
+                except Exception:  # noqa: BLE001 — replica mid-close
+                    backlog = 0
+                res = {"drained": False, "abandoned": backlog}
             per.append({"replica": i, **res})
         with self._mutex:
             # every replica's drain ends in close(): the set is done — later
@@ -2012,6 +2492,8 @@ class ReplicaSet:
         with self._mutex:
             self._closed = True
         for svc in self._services:
+            if getattr(svc, "closed", False):
+                continue  # retired replicas closed at retire time
             try:
                 svc.close()
             except Exception:  # noqa: BLE001 — close every replica regardless
@@ -2039,10 +2521,21 @@ class ReplicaSet:
         on this); high-water marks take the max; percentile-ish telemetry
         (ttft_p50/p95, avg occupancy) is weighted by each replica's sample
         count and labeled by construction as an approximation."""
+        with self._mutex:
+            # RETIRED slots are closed (a stats RPC against a reaped worker
+            # would fail anyway) and must read as "never existed": only
+            # live membership aggregates
+            live = [self._services[i] for i, h in enumerate(self._health)
+                    if h.state != HEALTH_RETIRED]
         per = []
         agg: dict = {}
-        for svc in self._services:
-            s = svc.stats()
+        for svc in live:
+            try:
+                s = svc.stats()
+            except Exception:  # noqa: BLE001 — a replica mid-retire/rebuild
+                logger.debug("replica %d stats unavailable",
+                             getattr(svc, "replica_id", -1), exc_info=True)
+                continue
             per.append(s)
             for key in self._SUM_KEYS:
                 if key in s:
@@ -2050,6 +2543,8 @@ class ReplicaSet:
             for key in self._MAX_KEYS:
                 if key in s:
                     agg[key] = max(agg.get(key, 0), s[key])
+        if not per:
+            per = [{}]
         ticks = agg.get("ticks", 0)
         if ticks:
             agg["avg_active_slots"] = round(
@@ -2110,6 +2605,19 @@ class ReplicaSet:
             agg["stream_resumes"] = self._stream_resumes
             agg["resume_replayed_tokens"] = self._resume_replayed_tokens
             agg["resume_exhausted"] = self._resume_exhausted
+            # elastic fleet: runtime joins/retires and the graceful-drain
+            # latency distribution scale-in decisions pay
+            drains = sorted(self._retire_drain_s)
+            agg["fleet"] = {
+                "live_replicas": len(live),
+                "joined": self._joined,
+                "retired": self._retired,
+                **({
+                    "retire_drain_p95_s": round(
+                        drains[min(int(len(drains) * 0.95),
+                                   len(drains) - 1)], 3),
+                } if drains else {}),
+            }
         agg["tenants"] = self.tenants.stats()
         agg["health"] = self.health_summary()
         return agg

@@ -166,6 +166,11 @@ _F_END = "end"
 # with the worker's clock so the router's ClockSync can estimate the offset
 _F_TELEMETRY = "telemetry"
 _F_PONG = "pong"
+# elastic fleet (ISSUE 20): a voluntary deregister — the worker asks the
+# router to retire it gracefully (drain + handoff + close + slot release).
+# Unsolicited (req_id 0); serving continues until the router-side
+# supervisor drains the replica, so no in-flight work is ever dropped.
+_F_DEREGISTER = "deregister"
 
 # the bounded stats subset a telemetry frame carries (full svc.stats() is
 # an RPC surface — the cadence frame only ships what the router merges:
@@ -533,6 +538,17 @@ class _WorkerServer:  # frame-emit: worker-to-router
                 self._send(req_id, _F_OK, None)
             elif method == "ping":
                 self._send(req_id, _F_OK, os.getpid())
+            elif method == "leave":
+                # voluntary deregister trigger (operator CLI / drills): the
+                # worker emits the unsolicited deregister frame and KEEPS
+                # SERVING — the router's supervisor owns the graceful
+                # retire (drain, handoff, close); shutting down here would
+                # drop in-flight work the retire path exists to save
+                self._send(0, _F_DEREGISTER, {
+                    "reason": kwargs.get("reason", "leave"),
+                    "pid": os.getpid(),
+                })
+                self._send(req_id, _F_OK, None)
             else:
                 raise ValueError(f"unknown worker method {method!r}")
         except BaseException as exc:  # noqa: BLE001 — everything goes typed  # lint: allow(baseexception-swallow) — converted to a typed wire frame
@@ -723,7 +739,12 @@ def worker_main_socket(addr, spec: WorkerSpec, slot: int) -> None:
     link identity does not — everything sent before the reconnect is
     fenced router-side as stale. A worker that cannot reach the router
     for ``spec.reconnect_deadline_s`` straight exits rather than orphan
-    itself."""
+    itself.
+
+    ``slot == -1`` is an ELASTIC JOIN: the registry assigns a slot and
+    acks it back; the worker adopts the assignment so every redial keeps
+    the same fleet identity instead of allocating a new slot per
+    reconnect."""
     signal.signal(signal.SIGTERM, lambda *_: os._exit(0))
     logging.basicConfig(level=logging.WARNING)
     svc = None
@@ -735,7 +756,18 @@ def worker_main_socket(addr, spec: WorkerSpec, slot: int) -> None:
                 addr, max_frame_bytes=spec.max_frame_bytes,
                 frame_timeout_s=spec.frame_timeout_s, fault_scope="worker",
             )
-            send_hello(transport, spec.auth_token, slot, os.getpid())
+            ack = send_hello(transport, spec.auth_token, slot, os.getpid())
+            acked_slot = ack.get("slot") if isinstance(ack, dict) else None
+            if isinstance(acked_slot, int) and acked_slot >= 0 \
+                    and acked_slot != slot:
+                # elastic join (slot == -1): adopt the registry's
+                # assignment so a redial re-registers the SAME identity,
+                # and re-label the yet-unbuilt service so worker-side
+                # flight/telemetry lanes carry the granted slot
+                slot = acked_slot
+                skw = spec.factory_kwargs.get("service_kwargs")
+                if isinstance(skw, dict):
+                    skw["replica_id"] = slot
         except FrameProtocolError as exc:
             # definitive rejection (token/version drift): redialing burns
             # the reconnect deadline on a config error — die loudly; the
@@ -776,6 +808,14 @@ def worker_main_socket(addr, spec: WorkerSpec, slot: int) -> None:
     os._exit(0)
 
 
+# frame-emit: worker-to-router via=socket
+def _push_final_err(transport, exc: BaseException) -> None:
+    """One unsolicited typed err frame (req_id 0) outside any RPC loop —
+    worker_serve's factory-failure and supersede notices ride the same
+    worker-to-router channel the router's dispatcher already handles."""
+    transport.send((0, _F_ERR, _encode_exc(exc)))
+
+
 # frame-emit: handshake-to-dialer via=socket
 def worker_serve(
     bind_host: str,
@@ -785,14 +825,18 @@ def worker_serve(
     bound_cb=None,
 ) -> None:
     """Advertised-worker entry (``REPLICA_WORKERS=host:port,...``): listen
-    on ``bind_host:bind_port`` and serve one ROUTER connection at a time.
-    The router dials in, authenticates (its hello carries the incarnation
-    epoch its registry assigned), and drives the same RPC protocol; when
-    the connection dies the worker goes back to accepting — the service
-    (engine, radix cache) survives across router reconnects. A router
-    ``__shutdown__`` closes the CONNECTION only: an advertised worker
-    belongs to its operator, not to whichever router last dialed it.
-    ``bound_cb`` (tests) receives the bound ``(host, port)``."""
+    on ``bind_host:bind_port`` and serve router connections. The router
+    dials in, authenticates (its hello carries the incarnation epoch its
+    registry assigned), and drives the same RPC protocol. The accept loop
+    KEEPS ACCEPTING while a connection is live: a router that restarted
+    (or lost its old socket to a half-open partition) redials and the
+    NEWEST handshake wins — the superseded connection gets a typed final
+    error frame and closes, its server exits, and the shared service
+    (engine, radix cache) carries straight over to the new link with no
+    worker restart. A router ``__shutdown__`` closes the CONNECTION only:
+    an advertised worker belongs to its operator, not to whichever router
+    last dialed it. ``bound_cb`` (tests) receives the bound
+    ``(host, port)``."""
     import socket as _socket
 
     stop = stop_event or threading.Event()
@@ -803,7 +847,21 @@ def worker_serve(
     listener.listen(4)
     if bound_cb is not None:
         bound_cb(listener.getsockname())
+    # one service shared across router connections, built ON the accept
+    # thread exactly once — two racing router dials must never build two
+    # engines. The CURRENT connection's server/transport/thread live here;
+    # only the accept loop mutates them (single writer, no lock needed).
     svc = None
+    current: dict = {"server": None, "transport": None, "thread": None}
+
+    def _serve_conn(server: _WorkerServer, transport) -> None:
+        try:
+            server.run()
+        except Exception:  # noqa: BLE001 — one connection, not the listener
+            logger.exception("router connection serving crashed")
+        finally:
+            transport.close()
+
     try:
         while not stop.is_set():
             try:
@@ -832,17 +890,53 @@ def worker_serve(
                                  "dropped")
                 transport.close()
                 continue
+            if svc is None:
+                try:
+                    factory = _resolve_factory(spec.factory)
+                    svc = factory(**spec.factory_kwargs)
+                except BaseException as exc:  # noqa: BLE001 — report, then die  # lint: allow(baseexception-swallow) — reported as a typed wire frame
+                    logger.exception("worker service factory failed")
+                    try:
+                        _push_final_err(transport, exc)
+                    except TransportError:
+                        pass
+                    transport.close()
+                    break
+            prev = current["server"]
+            if prev is not None and not prev._stop.is_set():
+                # newest connection wins: the stale link gets a typed
+                # final error, then its transport is cut — its server
+                # exits link_lost without touching the shared service
+                try:
+                    _push_final_err(current["transport"], ReplicaUnavailable(
+                        "superseded by a newer router connection",
+                        retryable=False,
+                    ))
+                except TransportError:
+                    pass  # the stale link is already dead — cutting it anyway
+                prev._stop.set()
+                current["transport"].close()
+            if current["thread"] is not None:
+                current["thread"].join(timeout=5.0)
             server = _WorkerServer(transport, spec, svc=svc)
-            outcome = server.run()
-            svc = server.svc
-            transport.close()
-            if outcome == "fatal":
-                break
+            thread = threading.Thread(
+                target=_serve_conn, args=(server, transport),
+                name="worker-serve-conn", daemon=True,
+            )
+            current.update(server=server, transport=transport,
+                           thread=thread)
+            thread.start()
     finally:
         try:
             listener.close()
         except OSError:
             pass
+        server = current["server"]
+        if server is not None:
+            server._stop.set()
+            current["transport"].close()
+            if current["thread"] is not None:
+                current["thread"].join(timeout=5.0)
         if svc is not None:
             try:
                 svc.close()
@@ -910,6 +1004,7 @@ class ProcessReplica:  # frame-emit: router-to-worker
         partition_timeout_s: float = 2.0,
         ping_interval_s: float = 0.5,
         heal_grace_s: float = 5.0,
+        adopt_registration: bool = False,
         _adopt_state: Optional[dict] = None,
     ) -> None:
         self.spec = spec
@@ -955,6 +1050,11 @@ class ProcessReplica:  # frame-emit: router-to-worker
         self._status: dict = {}
         self._status_ts = 0.0
         self._last_stats: dict = {}
+        # elastic fleet: reason string of a voluntary deregister frame
+        # (None until one arrives). Single writer — the dispatcher thread —
+        # with GIL-atomic reads from the supervisor, same discipline as
+        # _status.
+        self._deregister_reason: Optional[str] = None
         # fleet telemetry plane: last ACCEPTED telemetry frame (cached for
         # stats overlays), its arrival stamp (the telemetry-age source),
         # the worker flight recorder's perf_counter origin (trace
@@ -997,6 +1097,15 @@ class ProcessReplica:  # frame-emit: router-to-worker
             # to the build timeout — re-registration IS redialing here.
             self._transport, self.epoch = self._dial_advertised(
                 build_timeout_s)
+        elif adopt_registration:
+            # elastic join: the worker ALREADY dialed the registry (hello
+            # slot -1) and holds the granted slot — adopt the queued
+            # registration instead of spawning anything. The process is
+            # not ours to reap (it may live on another host); a broken
+            # link is a plain socket death.
+            (self._transport, _hello,
+             self.epoch) = registry.await_registration(
+                replica_id, build_timeout_s)
         else:
             # local socket spawn: the worker connects BACK to the
             # registry's listener and registers; frames then carry the
@@ -1180,6 +1289,17 @@ class ProcessReplica:  # frame-emit: router-to-worker
                 continue
             if kind == _F_PONG:
                 self._ingest_pong(payload)
+                continue
+            if kind == _F_DEREGISTER:
+                # voluntary leave: latch the request (GIL-atomic write, one
+                # writer — this dispatcher); the ReplicaSet supervisor
+                # observes `deregister_requested` and runs the graceful
+                # retire on its own cadence
+                reason = (payload or {}).get("reason", "deregister") \
+                    if isinstance(payload, dict) else "deregister"
+                self._deregister_reason = str(reason)
+                logger.info("replica %d worker requested deregistration "
+                            "(%s)", self.replica_id, reason)
                 continue
             call = None
             with self._mutex:
@@ -1759,6 +1879,19 @@ class ProcessReplica:  # frame-emit: router-to-worker
         return bool(self._status.get("closed"))
 
     @property
+    def deregister_requested(self) -> Optional[str]:
+        """Reason string of this worker's voluntary deregister frame, or
+        None. The ReplicaSet supervisor polls it to trigger a graceful
+        retire (GIL-atomic read of a single-writer attribute)."""
+        return self._deregister_reason
+
+    def request_leave(self, reason: str = "leave") -> None:
+        """Ask the worker to emit its voluntary deregister frame (drills /
+        operator scale-in through the worker): the worker keeps serving;
+        the supervisor's retire pass does the drain + handoff + close."""
+        self._call("leave", {"reason": reason}, timeout_s=10.0)
+
+    @property
     def tick_failure_count(self) -> int:
         return int(self._status.get("tick_failure_count") or 0)
 
@@ -1916,6 +2049,32 @@ class ProcessReplica:  # frame-emit: router-to-worker
         reply["epoch"] = self.epoch
         reply["clock"] = self._clock.estimate()
         return reply
+
+    def cached_flight_lane(self, router_origin_s: float,
+                           status: str) -> dict:
+        """Fleet-trace lane for THIS incarnation built from the cached
+        last telemetry frame — used when the worker is DEAD or RETIRED
+        and ``fetch_flight`` can no longer answer. The 1 Hz telemetry
+        frame ships counters rather than tick tables, so the lane is
+        usually name-only; the point is that the incarnation still
+        appears on the fleet timeline, marked ``(retired)``/``(dead)``,
+        instead of silently vanishing from history."""
+        shift, bound = self.flight_shift_s(router_origin_s)
+        flight = (self._telemetry or {}).get("flight")
+        ticks: list = []
+        records: list = []
+        if isinstance(flight, dict):
+            ticks = list(flight.get("ticks") or [])
+            records = list(flight.get("records") or [])
+        return {
+            "replica": self.replica_id,
+            "epoch": self.epoch,
+            "shift_s": shift,
+            "uncertainty_s": bound,
+            "ticks": ticks,
+            "records": records,
+            "status": status,
+        }
 
     def flight_shift_s(self, router_origin_s: float) -> tuple:
         """``(shift_s, uncertainty_s)`` mapping this worker's flight

@@ -427,32 +427,38 @@ class DependencyContainer:
                         frame_timeout_s=serve.socket_frame_timeout_s,
                     )
                     self._cache["worker_registry"] = registry
+                def make_spec(i: int) -> WorkerSpec:
+                    # shared by the startup loop, the elastic-join
+                    # membership source, and the autoscaler's launcher —
+                    # one spec recipe, three registration paths
+                    return WorkerSpec(factory_kwargs=dict(
+                        model_family=(
+                            "moe" if type(engine.model_config).__name__
+                            == "MoeConfig" else "llama"
+                        ),
+                        model_config=(
+                            None if cfg.checkpoint_path
+                            else _dc.asdict(engine.model_config)
+                        ),
+                        checkpoint_path=cfg.checkpoint_path,
+                        tokenizer_path=cfg.tokenizer_path,
+                        draft_checkpoint_path=draft_path,
+                        engine_kwargs=engine_kwargs,
+                        service_kwargs={**service_kwargs,
+                                        "replica_id": i},
+                        warm_prefix_text=warm_head,
+                    ), telemetry_interval_s=serve.telemetry_interval_s,
+                       **({} if replica_mode != "socket" else dict(
+                        auth_token=auth_token,
+                        reconnect=True,
+                        max_frame_bytes=serve.socket_frame_max_bytes,
+                        frame_timeout_s=serve.socket_frame_timeout_s,
+                    )))
+
                 services = []
                 try:
                     for i in range(n_replicas):
-                        spec = WorkerSpec(factory_kwargs=dict(
-                            model_family=(
-                                "moe" if type(engine.model_config).__name__
-                                == "MoeConfig" else "llama"
-                            ),
-                            model_config=(
-                                None if cfg.checkpoint_path
-                                else _dc.asdict(engine.model_config)
-                            ),
-                            checkpoint_path=cfg.checkpoint_path,
-                            tokenizer_path=cfg.tokenizer_path,
-                            draft_checkpoint_path=draft_path,
-                            engine_kwargs=engine_kwargs,
-                            service_kwargs={**service_kwargs,
-                                            "replica_id": i},
-                            warm_prefix_text=warm_head,
-                        ), telemetry_interval_s=serve.telemetry_interval_s,
-                           **({} if replica_mode != "socket" else dict(
-                            auth_token=auth_token,
-                            reconnect=True,
-                            max_frame_bytes=serve.socket_frame_max_bytes,
-                            frame_timeout_s=serve.socket_frame_timeout_s,
-                        )))
+                        spec = make_spec(i)
                         transport_kwargs = (
                             {} if replica_mode != "socket" else dict(
                                 transport_mode="socket",
@@ -474,7 +480,7 @@ class DependencyContainer:
                         (f", registry {registry.address}" if registry
                          else ""),
                     )
-                    return ReplicaSet(
+                    replica_set = ReplicaSet(
                         services,
                         tenant_weights=serve.parsed_tenant_weights(),
                         tenant_default_weight=serve.tenant_default_weight,
@@ -528,6 +534,58 @@ class DependencyContainer:
                             pass
                         self._cache.pop("worker_registry", None)
                     raise
+                if registry is not None:
+                    # elastic fleet: workers that hello AFTER startup with
+                    # the sentinel slot -1 land on the registry's join
+                    # queue; the supervisor drains it through this source
+                    # and wires each one into routing/WFQ/health. Active
+                    # regardless of AUTOSCALE — remote fleets scale
+                    # themselves by just registering.
+                    def _join_elastic():
+                        joined = []
+                        for slot in registry.drain_joins():
+                            svc = ProcessReplica(
+                                make_spec(slot), engine.tokenizer,
+                                replica_id=slot,
+                                transport_mode="socket",
+                                registry=registry,
+                                adopt_registration=True,
+                                partition_timeout_s=(
+                                    serve.socket_partition_timeout_s),
+                                heal_grace_s=serve.socket_heal_grace_s,
+                            )
+                            joined.append((slot, svc))
+                        return joined
+
+                    replica_set.set_membership_source(
+                        _join_elastic, release_slot=registry.release_slot)
+                if serve.autoscale:
+                    from sentio_tpu.runtime.autoscaler import (
+                        AutoscalePolicy, Autoscaler, socket_worker_launcher,
+                    )
+
+                    launcher = None
+                    if registry is not None:
+                        launcher = socket_worker_launcher(
+                            registry.address, make_spec(-1))
+                    autoscaler = Autoscaler(
+                        replica_set,
+                        AutoscalePolicy(
+                            min_replicas=serve.autoscale_min_replicas,
+                            max_replicas=serve.autoscale_max_replicas,
+                            window_s=serve.autoscale_window_s,
+                            out_busy=serve.autoscale_out_busy,
+                            in_busy=serve.autoscale_in_busy,
+                            out_backlog=serve.autoscale_out_backlog,
+                            out_cooldown_s=serve.autoscale_out_cooldown_s,
+                            in_cooldown_s=serve.autoscale_in_cooldown_s,
+                        ),
+                        launcher=launcher,
+                        poll_interval_s=serve.autoscale_poll_interval_s,
+                    )
+                    autoscaler.start()
+                    self._cache["autoscaler"] = autoscaler
+                return replica_set
 
             services = []
             for i in range(n_replicas):
@@ -740,10 +798,12 @@ class DependencyContainer:
 
     def cleanup(self) -> None:
         with self._lock:
-            # worker_registry closes AFTER the generation service: the
-            # ReplicaSet's close reaps workers whose re-registrations the
-            # listener may still be fielding
-            for name in ("generation_service", "embedder", "worker_registry"):
+            # the autoscaler stops FIRST (it must not launch or retire
+            # mid-teardown); worker_registry closes AFTER the generation
+            # service: the ReplicaSet's close reaps workers whose
+            # re-registrations the listener may still be fielding
+            for name in ("autoscaler", "generation_service", "embedder",
+                         "worker_registry"):
                 component = self._cache.get(name)
                 if component is not None and hasattr(component, "close"):
                     try:

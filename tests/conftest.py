@@ -36,6 +36,7 @@ from sentio_tpu.config import Settings, set_settings  # noqa: E402
 # introduced it, instead of as a pool-exhaustion heisenbug later.
 _SANITIZED_MODULES = {
     "test_chaos",
+    "test_elastic",
     "test_paged",
     "test_paged_sched",
     "test_paged_spec",

@@ -1,0 +1,784 @@
+"""Elastic worker fleet (ISSUE 20) — tier 1.
+
+The contract under test, on tiny engines (conftest arms SENTIO_SANITIZE=1
+for this module, so every tick self-checks):
+
+* **elastic registry** — a hello with the sentinel slot ``-1`` GROWS the
+  slot set (the ack carries the granted slot), ``release_slot`` returns it
+  to the free list, reuse continues the epoch fence, and a redial of a
+  retired slot is rejected TYPED (stopping the worker's reconnect loop);
+* **runtime join** — ``ReplicaSet.add_replica`` wires a new replica into
+  rotation under load: WFQ capacity re-derives, routing reaches it, and a
+  supervised set arms shadow handoff exactly like a startup replica;
+* **graceful scale-in** — ``retire()`` drains in-flight work so a stream
+  started before the retire finishes TOKEN-EXACT vs a no-churn greedy run,
+  hands never-dispatched inbox tickets to survivors (callers just wake
+  with a survivor's result), refuses to retire the last serving replica,
+  and parks the slot RETIRED;
+* **autoscaler** — the pure policy kernel (hysteresis, per-direction
+  cooldowns, min/max clamps, window warming) plus the closed actuator
+  loop: sustained synthetic load scales a REAL replica out through the
+  launcher seam, sustained idle retires it back — all on a synthetic
+  clock, no sleeps;
+* **churn chaos** — the membership fault points (``registry.elastic_join``,
+  ``replica.join``, ``replica.retire``) are armed here: an injected fault
+  rejects/raises typed and leaves the set serving, never half-joined; a
+  flap storm under the sanitizer keeps pages conserved and leaks nothing;
+* **worker_serve redial** — an advertised worker accepts a NEWER router
+  connection while one is live: newest wins, the superseded link gets a
+  typed final err frame, the shared service carries over.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from sentio_tpu.config import ServeConfig
+from sentio_tpu.infra import faults
+from sentio_tpu.infra.exceptions import ReplicaUnavailable
+from sentio_tpu.runtime.autoscaler import AutoscalePolicy, Autoscaler
+from sentio_tpu.runtime.paged import ContinuousBatchingEngine, PagedResult
+from sentio_tpu.runtime.replica import (
+    DEFAULT_TENANT,
+    HEALTH_HEALTHY,
+    HEALTH_RETIRED,
+    ReplicaSet,
+    WorkerRegistry,
+)
+from sentio_tpu.runtime.service import PagedGenerationService
+from sentio_tpu.runtime.transport import FrameProtocolError, dial, send_hello
+
+
+def _engine(base=None, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_pages_per_seq", 4)
+    kw.setdefault("steps_per_tick", 2)
+    if base is not None:
+        kw.setdefault("params", base.params)
+        kw.setdefault("tokenizer", base.tokenizer)
+    return ContinuousBatchingEngine(**kw)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.reset()
+
+
+def _assert_pages_conserved(rs):
+    for s in rs.stats()["replicas"]:
+        assert s["free_pages"] + s.get("prefix_cache_pages", 0) \
+            == s["total_pages"] - 1, s
+
+
+# ==========================================================================
+# AutoscalePolicy — pure decision kernel (synthetic clock, no threads)
+
+class TestAutoscalePolicy:
+    def _hot(self):
+        return AutoscalePolicy(min_replicas=1, max_replicas=4,
+                               window_s=1.0, out_cooldown_s=0.0,
+                               in_cooldown_s=0.0)
+
+    def _feed(self, p, t0, busy, backlog=0.0, n=4, dt=0.4):
+        for i in range(n):
+            p.observe(t0 + i * dt, busy, backlog)
+        return t0 + (n - 1) * dt
+
+    def test_window_warming_gates_first_decisions(self):
+        p = self._hot()
+        p.observe(10.0, 0.99, 0.0)
+        assert p.decide(10.0, 1) == (None, "window_warming")
+        # two samples but the span is < 80% of the window: still warming
+        p.observe(10.3, 0.99, 0.0)
+        assert p.decide(10.3, 1) == (None, "window_warming")
+
+    def test_scale_out_on_sustained_busy_and_on_backlog(self):
+        p = self._hot()
+        t = self._feed(p, 10.0, busy=0.9)
+        assert p.decide(t, 1) == ("out", "busy")
+        q = self._hot()
+        t = self._feed(q, 10.0, busy=0.3, backlog=0.7)
+        assert q.decide(t, 1) == ("out", "backlog")
+
+    def test_hysteresis_steady_band_and_clamp(self):
+        p = self._hot()
+        # between in_busy (0.15) and out_busy (0.75): no decision
+        t = self._feed(p, 10.0, busy=0.5)
+        assert p.decide(t, 2) == (None, "steady")
+        # the constructor clamps in_busy <= out_busy whatever the knobs say
+        weird = AutoscalePolicy(out_busy=0.4, in_busy=0.9)
+        assert weird.in_busy <= weird.out_busy
+
+    def test_min_max_clamps(self):
+        p = self._hot()
+        t = self._feed(p, 10.0, busy=0.95)
+        assert p.decide(t, p.max_replicas) == (None, "at_max")
+        assert p.saturated(t)
+        q = self._hot()
+        t = self._feed(q, 10.0, busy=0.0)
+        assert q.decide(t, q.min_replicas) == (None, "at_min")
+        assert not q.saturated(t)
+
+    def test_out_cooldown_blocks_rescale_until_it_expires(self):
+        p = AutoscalePolicy(min_replicas=1, max_replicas=8, window_s=1.0,
+                            out_cooldown_s=10.0, in_cooldown_s=0.0)
+        t = self._feed(p, 10.0, busy=0.95)
+        assert p.decide(t, 1) == ("out", "busy")
+        p.note_scaled(t, "out")
+        # note_scaled cleared the window: old-fleet samples say nothing
+        assert p.decide(t + 0.1, 2) == (None, "window_warming")
+        t2 = self._feed(p, t + 0.5, busy=0.95)
+        assert p.decide(t2, 2) == (None, "out_cooldown")
+        t3 = self._feed(p, t + 11.0, busy=0.95)
+        assert p.decide(t3, 2) == ("out", "busy")
+
+    def test_in_cooldown_measured_from_last_change_either_direction(self):
+        p = AutoscalePolicy(min_replicas=1, max_replicas=8, window_s=1.0,
+                            out_cooldown_s=0.0, in_cooldown_s=10.0)
+        # a scale-OUT starts the scale-in cooldown too: an out→in flap
+        # inside in_cooldown_s is impossible by construction
+        p.note_scaled(50.0, "out")
+        t = self._feed(p, 50.5, busy=0.0)
+        assert p.decide(t, 2) == (None, "in_cooldown")
+        t2 = self._feed(p, 61.0, busy=0.0)
+        assert p.decide(t2, 2) == ("in", "idle")
+
+    def test_inert_by_default(self):
+        cfg = ServeConfig()
+        assert cfg.autoscale is False
+        assert ServeConfig.from_env().autoscale is False
+
+
+# ==========================================================================
+# WorkerRegistry — elastic join / release / reuse over a real socket
+
+class TestElasticRegistry:
+    @pytest.fixture()
+    def registry(self):
+        reg = WorkerRegistry("elastic-token", slots=1, hello_timeout_s=5.0)
+        yield reg
+        reg.close()
+
+    def _join(self, registry, slot=-1):
+        t = dial(registry.address)
+        try:
+            ack = send_hello(t, "elastic-token", slot, os.getpid(),
+                             timeout_s=5.0)
+        except BaseException:
+            t.close()
+            raise
+        return t, ack
+
+    @staticmethod
+    def _drain_wait(registry, timeout_s=5.0):
+        """The ack lands on the dialer BEFORE the join event publishes
+        (ack first, then queue registration, then publish) — poll."""
+        deadline = time.monotonic() + timeout_s
+        joined: list = []
+        while time.monotonic() < deadline:
+            joined.extend(registry.drain_joins())
+            if joined:
+                return joined
+            time.sleep(0.01)
+        return joined
+
+    def test_elastic_hello_grows_the_slot_set(self, registry):
+        t, ack = self._join(registry)
+        try:
+            assert ack["slot"] == 1  # startup owns slot 0; the set GREW
+            assert ack["epoch"] == 1
+            assert registry.slots == 2
+            assert self._drain_wait(registry) == [1]
+            assert registry.drain_joins() == []  # one event per join
+            stats = registry.stats()
+            assert stats["elastic_joins"] == 1
+            assert stats["free_slots"] == []
+            # the registration is adoptable exactly like a startup one
+            transport, hello, epoch = registry.await_registration(1, 5.0)
+            assert epoch == 1 and int(hello["pid"]) == os.getpid()
+        finally:
+            t.close()
+
+    def test_release_then_rejoin_reuses_slot_at_higher_epoch(self, registry):
+        t1, ack1 = self._join(registry)
+        assert self._drain_wait(registry) == [ack1["slot"]]
+        registry.await_registration(ack1["slot"], 5.0)
+        t1.close()
+        registry.release_slot(ack1["slot"])
+        stats = registry.stats()
+        assert stats["released_slots"] == 1
+        assert stats["free_slots"] == [ack1["slot"]]
+        # reuse keeps the epoch fence: the next incarnation on this slot
+        # registers ABOVE every frame the retired one ever sent
+        t2, ack2 = self._join(registry)
+        try:
+            assert ack2["slot"] == ack1["slot"]
+            assert ack2["epoch"] > ack1["epoch"]
+            assert self._drain_wait(registry) == [ack1["slot"]]
+            assert registry.stats()["free_slots"] == []
+        finally:
+            t2.close()
+
+    def test_redial_of_retired_slot_rejected_typed(self, registry):
+        t1, ack1 = self._join(registry)
+        registry.await_registration(ack1["slot"], 5.0)
+        t1.close()
+        registry.release_slot(ack1["slot"])
+        # the retired incarnation's reconnect loop redials its EXPLICIT
+        # slot: the registry must refuse typed (FrameProtocolError is
+        # terminal for the dialer's backoff loop)
+        t2 = dial(registry.address)
+        try:
+            with pytest.raises(FrameProtocolError, match="was retired"):
+                send_hello(t2, "elastic-token", ack1["slot"], os.getpid(),
+                           timeout_s=5.0)
+        finally:
+            t2.close()
+
+    def test_injected_join_fault_rejects_typed_and_leaks_no_slot(
+            self, registry):
+        with faults.inject("registry.elastic_join",
+                           error=RuntimeError("chaos: join storm"), times=1):
+            t = dial(registry.address)
+            try:
+                with pytest.raises(FrameProtocolError,
+                                   match="elastic join failed"):
+                    send_hello(t, "elastic-token", -1, os.getpid(),
+                               timeout_s=5.0)
+            finally:
+                t.close()
+        # the fault fired BEFORE allocation: no slot grew, no join queued
+        assert registry.slots == 1
+        assert registry.drain_joins() == []
+        # and the registry still grants joins afterwards
+        t2, ack = self._join(registry)
+        t2.close()
+        assert ack["slot"] == 1
+
+
+# ==========================================================================
+# ReplicaSet — runtime join, graceful scale-in, churn chaos
+
+class TestElasticReplicaSet:
+    def test_grow_under_load_then_retire_stream_token_exact(self,
+                                                            monkeypatch):
+        """THE scale-in criterion: a stream in flight when its replica is
+        retired finishes token-exact vs a no-churn greedy run — the drain
+        completes delivered-token work before the slot parks RETIRED."""
+        prompt = "elastic drill prompt"
+        e0 = _engine()
+        svc0 = PagedGenerationService(e0, max_queue=8)
+        baseline = svc0.generate(prompt, max_new_tokens=6, temperature=0.0,
+                                 timeout_s=180)
+        rs = ReplicaSet([svc0], supervise=False)
+        try:
+            assert rs.tenants.capacity == 8
+            # grow 1 → 3 at runtime
+            idx1 = rs.add_replica(
+                PagedGenerationService(_engine(base=e0), max_queue=8))
+            idx2 = rs.add_replica(
+                PagedGenerationService(_engine(base=e0), max_queue=8))
+            assert (idx1, idx2) == (1, 2)
+            assert rs.tenants.capacity == 24  # WFQ re-derived
+            fleet = rs.stats()["fleet"]
+            assert fleet["live_replicas"] == 3 and fleet["joined"] == 2
+            # the joiners actually serve: spy on routing, push traffic
+            routed: list = []
+            orig_route = rs._route
+
+            def spy(toks, exclude=frozenset()):
+                idx, hit = orig_route(toks, exclude=exclude)
+                routed.append(idx)
+                return idx, hit
+
+            monkeypatch.setattr(rs, "_route", spy)
+            for i in range(6):
+                out = rs.generate(f"spread load {i}", max_new_tokens=2,
+                                  temperature=0.0, timeout_s=180)
+                assert isinstance(out, PagedResult)
+            assert set(routed) - {0}, "no joiner was ever routed to"
+            # stream through the set, then retire the SERVING replica from
+            # another thread while the consumer is mid-stream
+            routed.clear()
+            stats_out: dict = {}
+            stream = rs.generate_stream(prompt, max_new_tokens=6,
+                                        temperature=0.0, timeout_s=180,
+                                        stats_out=stats_out)
+            first = next(stream)
+            serving = routed[-1]
+            result: dict = {}
+
+            def retire():
+                result["r"] = rs.retire(serving, deadline_s=60.0)
+
+            t = threading.Thread(target=retire)
+            t.start()
+            rest = "".join(stream)
+            t.join(timeout=90)
+            assert not t.is_alive()
+            assert first + rest == baseline.text
+            assert stats_out.get("tokens") == len(baseline.tokens)
+            assert result["r"]["retired"] is True
+            assert result["r"]["drained"] is True
+            # the slot parked RETIRED, capacity re-derived, routing avoids it
+            assert rs._health[serving].state == HEALTH_RETIRED
+            assert rs.tenants.capacity == 16
+            again = rs.generate(prompt, max_new_tokens=3, temperature=0.0,
+                                timeout_s=180)
+            assert isinstance(again, PagedResult)
+            assert routed[-1] != serving
+            # a second retire of the same slot is a no-op, not an error
+            assert rs.retire(serving)["retired"] is False
+            _assert_pages_conserved(rs)
+        finally:
+            rs.close()
+
+    def test_retire_hands_off_undispatched_inbox_to_survivor(self):
+        """Scale-in must not strand queued-never-dispatched tickets behind
+        the drain deadline: retire extracts them FIRST and the blocked
+        caller wakes with a survivor's result (WFQ recharged, not
+        double-counted)."""
+        e0 = _engine()
+        svc0 = PagedGenerationService(e0)
+        svc1 = PagedGenerationService(_engine(base=e0))
+        svc0.generate("retire handoff warm zero", max_new_tokens=2,
+                      timeout_s=180)
+        svc1.generate("retire handoff warm one", max_new_tokens=2,
+                      timeout_s=180)
+        rs = ReplicaSet([svc0, svc1], supervise=False)
+        release = threading.Event()
+        t1 = t2 = None
+        try:
+            wedged: dict = {}
+
+            def call_wedged():
+                try:
+                    wedged["r"] = svc0.generate(
+                        "wedged in flight", max_new_tokens=3,
+                        temperature=0.0, timeout_s=60)
+                except Exception as exc:  # noqa: BLE001
+                    wedged["r"] = exc
+
+            with faults.inject("paged.step", stall_event=release,
+                               stall_s=30.0, times=1) as rule:
+                t1 = threading.Thread(target=call_wedged)
+                t1.start()
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline and rule.stalled == 0:
+                    time.sleep(0.005)
+                assert rule.stalled == 1
+                # a second ticket piles into the wedged inbox with the WFQ
+                # metadata the router stamps (plus its caller-side charge)
+                rs.tenants.admit(DEFAULT_TENANT, 8)
+                queued: dict = {}
+
+                def call_queued():
+                    try:
+                        queued["r"] = svc0.generate(
+                            "queued behind the wedge", max_new_tokens=3,
+                            temperature=0.0, timeout_s=60,
+                            tenant=DEFAULT_TENANT, cost_tokens=8)
+                    except Exception as exc:  # noqa: BLE001
+                        queued["r"] = exc
+
+                t2 = threading.Thread(target=call_queued)
+                t2.start()
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline and len(svc0._inbox) < 1:
+                    time.sleep(0.005)
+                # retire with a drain deadline the wedge will blow: the
+                # queued ticket must move NOW, not after the deadline
+                result = rs.retire(0, deadline_s=1.0)
+                assert result["retired"] is True
+                assert result["handed_off"] >= 1
+                assert rs.stats()["handed_off"] >= 1
+                t2.join(timeout=60)
+                assert isinstance(queued["r"], PagedResult), queued["r"]
+                assert queued["r"].finish_reason in ("stop", "length")
+                release.set()
+                t1.join(timeout=60)
+            rs.tenants.release(DEFAULT_TENANT, 8)
+        finally:
+            release.set()
+            for t in (t1, t2):
+                if t is not None:
+                    t.join(timeout=60)
+            faults.reset()
+            rs.close()
+
+    def test_retire_last_serving_replica_refused_typed(self):
+        e0 = _engine()
+        rs = ReplicaSet([PagedGenerationService(e0)], supervise=False)
+        try:
+            with pytest.raises(ReplicaUnavailable) as exc:
+                rs.retire(0)
+            assert exc.value.details.get("reason") == "last_serving"
+            assert exc.value.retryable is False
+            # the refusal left the replica serving
+            out = rs.generate("still serving", max_new_tokens=2,
+                              temperature=0.0, timeout_s=180)
+            assert isinstance(out, PagedResult)
+        finally:
+            rs.close()
+
+    def test_injected_join_fault_leaves_set_unchanged(self):
+        e0 = _engine()
+        rs = ReplicaSet([PagedGenerationService(e0)], supervise=False)
+        joiner = PagedGenerationService(_engine(base=e0))
+        try:
+            with faults.inject("replica.join",
+                               error=RuntimeError("chaos: join flap"),
+                               times=1):
+                with pytest.raises(RuntimeError, match="join flap"):
+                    rs.add_replica(joiner)
+            # never half-joined: membership, capacity and health untouched
+            assert rs.stats()["fleet"]["live_replicas"] == 1
+            assert rs.tenants.capacity == joiner.max_queue
+            # the set still serves, and the SAME joiner lands on retry
+            assert rs.add_replica(joiner) == 1
+            assert rs.stats()["fleet"]["live_replicas"] == 2
+        finally:
+            rs.close()
+
+    def test_injected_retire_fault_leaves_replica_serving(self):
+        e0 = _engine()
+        rs = ReplicaSet([PagedGenerationService(e0),
+                         PagedGenerationService(_engine(base=e0))],
+                        supervise=False)
+        try:
+            with faults.inject("replica.retire",
+                               error=RuntimeError("chaos: retire flap"),
+                               times=1):
+                with pytest.raises(RuntimeError, match="retire flap"):
+                    rs.retire(0)
+            # the fault fired before ANY transition: replica 0 never left
+            # rotation and was never drained
+            assert rs._health[0].state == HEALTH_HEALTHY
+            assert rs.stats()["fleet"]["retired"] == 0
+            out = rs.generate("retire flap survivor", max_new_tokens=2,
+                              temperature=0.0, timeout_s=180)
+            assert isinstance(out, PagedResult)
+        finally:
+            rs.close()
+
+    def test_flap_storm_conserves_pages_and_leaks_nothing(self):
+        """Churn chaos: joins and retires cycling under live traffic (the
+        sanitizer is armed for this module) — every outcome typed, page
+        pools conserved on live replicas, zero leaked pumps."""
+        e0 = _engine()
+        rs = ReplicaSet([PagedGenerationService(e0),
+                         PagedGenerationService(_engine(base=e0))],
+                        supervise=False)
+        try:
+            for cycle in range(3):
+                idx = rs.add_replica(
+                    PagedGenerationService(_engine(base=e0), max_queue=8))
+                for i in range(2):
+                    out = rs.generate(
+                        f"flap storm c{cycle} r{i}", max_new_tokens=2,
+                        temperature=0.0, timeout_s=180)
+                    assert isinstance(out, PagedResult)
+                result = rs.retire(idx, deadline_s=30.0)
+                assert result["retired"] is True
+                # the flap reuses ONE slot: joins never balloon the set
+                assert rs.stats()["fleet"]["live_replicas"] == 2
+            fleet = rs.stats()["fleet"]
+            assert fleet["joined"] == 3 and fleet["retired"] == 3
+            assert fleet["retire_drain_p95_s"] >= 0.0
+            assert rs.stats()["pump_leaked"] == 0
+            _assert_pages_conserved(rs)
+        finally:
+            rs.close()
+        # retired engines idle-exit their pumps: no orphan decode threads
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and any(
+                t.name == "paged-decode-pump" and t.is_alive()
+                for t in threading.enumerate()):
+            time.sleep(0.05)
+        assert not any(t.name == "paged-decode-pump" and t.is_alive()
+                       for t in threading.enumerate())
+
+
+# ==========================================================================
+# Autoscaler — the closed loop, on a synthetic clock
+
+class TestAutoscaler:
+    def _driven_set(self, monkeypatch, rs, drive):
+        """Wrap fleet_load: REAL membership, synthetic saturation — the
+        drill steers the policy without having to manufacture actual load
+        on tiny engines."""
+        orig = rs.fleet_load
+
+        def fake():
+            load = orig()
+            load["busy"] = drive["busy"]
+            load["backlog_fraction"] = drive["backlog"]
+            for p in load["replicas"]:
+                p["busy"] = drive["busy"]
+            return load
+
+        monkeypatch.setattr(rs, "fleet_load", fake)
+
+    def test_closed_loop_scales_out_then_back_in(self, monkeypatch):
+        """Acceptance: sustained busy duty scales a REAL replica out via
+        the launcher seam; sustained idle retires it back to min — the
+        whole loop driven through Autoscaler.step() with a synthetic
+        clock (no cooldown sleeps)."""
+        e0 = _engine()
+        svc0 = PagedGenerationService(e0, max_queue=8)
+        svc0.generate("autoscale warm", max_new_tokens=2, timeout_s=180)
+        rs = ReplicaSet([svc0], supervise=False)
+        launches: list = []
+
+        def launcher():
+            # a local launcher seam: the real socket one spawns a worker
+            # that elastically joins via the registry; the drill adds the
+            # replica synchronously so step() observes it immediately
+            idx = rs.add_replica(
+                PagedGenerationService(_engine(base=e0), max_queue=8))
+            launches.append(idx)
+
+        policy = AutoscalePolicy(min_replicas=1, max_replicas=2,
+                                 window_s=1.0, out_cooldown_s=0.0,
+                                 in_cooldown_s=0.0)
+        scaler = Autoscaler(rs, policy, launcher=launcher)
+        drive = {"busy": 0.95, "backlog": 0.8}
+        self._driven_set(monkeypatch, rs, drive)
+        try:
+            # poll cadence must outpace window pruning: samples older than
+            # window_s fall out, so the span only reaches the 80% coverage
+            # gate when steps land well inside the window
+            assert scaler.step(now=100.0) is None  # window warming
+            assert scaler.step(now=100.3) is None
+            assert scaler.step(now=100.6) is None
+            assert scaler.step(now=100.9) == "out"
+            assert launches == [1]
+            assert rs.stats()["fleet"]["live_replicas"] == 2
+            # hot at max: no further out, the saturation gauge arms instead
+            for t in (101.2, 101.5, 101.8, 102.1):
+                assert scaler.step(now=t) is None
+            # load collapses: the most-idle replica retires back to min
+            # (steps start once the hot samples have aged out of the window)
+            drive.update(busy=0.0, backlog=0.0)
+            assert scaler.step(now=103.5) is None
+            assert scaler.step(now=103.8) is None
+            assert scaler.step(now=104.1) is None
+            assert scaler.step(now=104.4) == "in"
+            assert rs.stats()["fleet"]["live_replicas"] == 1
+            assert rs.stats()["fleet"]["retired"] == 1
+            stats = scaler.stats()
+            assert stats["scale_out"] == 1 and stats["scale_in"] == 1
+            # at min and idle: the loop holds steady
+            assert scaler.step(now=105.0) is None
+        finally:
+            scaler.close()
+            rs.close()
+
+    def test_scale_out_without_launcher_is_skipped_not_fatal(
+            self, monkeypatch):
+        e0 = _engine()
+        rs = ReplicaSet([PagedGenerationService(e0, max_queue=8)],
+                        supervise=False)
+        scaler = Autoscaler(
+            rs, AutoscalePolicy(min_replicas=1, max_replicas=4,
+                                window_s=1.0, out_cooldown_s=0.0))
+        drive = {"busy": 0.95, "backlog": 0.9}
+        self._driven_set(monkeypatch, rs, drive)
+        try:
+            for i in range(5):
+                assert scaler.step(now=200.0 + i * 0.3) is None
+            stats = scaler.stats()
+            assert stats["skipped"] >= 1 and stats["scale_out"] == 0
+            assert rs.stats()["fleet"]["live_replicas"] == 1
+        finally:
+            scaler.close()
+            rs.close()
+
+    def test_pending_launch_counts_toward_max(self, monkeypatch):
+        """A launched worker is invisible to fleet_load() until it
+        compiles and registers — the in-flight launch must count toward
+        max_replicas or the policy re-fires every cooldown and storms
+        past the bound (seen live: max=2 fleet grew to 4 behind a ~20s
+        join latency). The pending entry expires after launch_grace_s so
+        a dead launch can't pin the fleet below max forever."""
+        e0 = _engine()
+        rs = ReplicaSet([PagedGenerationService(e0, max_queue=8)],
+                        supervise=False)
+        calls: list = []
+        scaler = Autoscaler(
+            rs, AutoscalePolicy(min_replicas=1, max_replicas=2,
+                                window_s=1.0, out_cooldown_s=0.0),
+            launcher=lambda: calls.append(1),  # slow join: never lands
+            launch_grace_s=5.0)
+        drive = {"busy": 0.95, "backlog": 0.9}
+        self._driven_set(monkeypatch, rs, drive)
+        try:
+            for t in (300.0, 300.3, 300.6):
+                assert scaler.step(now=t) is None  # window warming
+            assert scaler.step(now=300.9) == "out"
+            assert calls == [1]
+            # still hot, zero cooldown, worker never joined: the pending
+            # launch holds effective replicas at max — no second launch
+            for t in (301.2, 301.5, 301.8, 302.1, 302.4):
+                assert scaler.step(now=t) is None
+            assert calls == [1]
+            assert scaler.stats()["pending_launches"] == 1
+            # grace expiry presumes the launch dead and frees the slot:
+            # the next warm window may fire again
+            for t in (306.0, 306.3, 306.6):
+                assert scaler.step(now=t) is None
+            assert scaler.step(now=306.9) == "out"
+            assert calls == [1, 1]
+            assert scaler.stats()["scale_out"] == 2
+        finally:
+            scaler.close()
+            rs.close()
+
+    def test_loop_thread_lifecycle(self):
+        e0 = _engine()
+        rs = ReplicaSet([PagedGenerationService(e0, max_queue=8)],
+                        supervise=False)
+        scaler = Autoscaler(
+            rs, AutoscalePolicy(), poll_interval_s=0.05)
+        try:
+            scaler.start()
+            scaler.start()  # idempotent
+            assert any(t.name == "fleet-autoscaler" and t.is_alive()
+                       for t in threading.enumerate())
+            time.sleep(0.2)  # a few real polls: steady fleet, no decisions
+            stats = scaler.stats()
+            assert stats["scale_out"] == 0 and stats["scale_in"] == 0
+        finally:
+            scaler.close()
+            rs.close()
+        assert not any(t.name == "fleet-autoscaler" and t.is_alive()
+                       for t in threading.enumerate())
+
+
+# ==========================================================================
+# worker_serve — concurrent redial: newest router connection wins
+
+class _FakeEngine:
+    page_size = 8
+    max_slots = 2
+
+
+class _FakeService:
+    """Minimal duck-typed service for the worker_serve listener drill: the
+    redial semantics live entirely in the accept loop, so the engine is
+    dead weight here (the server only reads its shape for the ready
+    frame)."""
+
+    engine = _FakeEngine()
+    broken = False
+    closed = False
+    tick_failure_count = 0
+    pump_leaked_count = 0
+    max_queue = 8
+    default_timeout_s = 30.0
+    default_deadline_s = 0.0
+    retry_budget = 0
+    tick_stall_budget_s = 0.0
+
+    def heartbeat_age(self):
+        return 0.0
+
+    def backlog(self):
+        return 0
+
+    def projected_wait(self):
+        return 0.0
+
+    def duty_cycle(self):
+        return {"idle": 1.0}
+
+    def close(self):
+        self.closed = True
+
+
+_FAKE_SINGLETON = _FakeService()
+
+
+def _fake_factory(**_kw):
+    return _FAKE_SINGLETON
+
+
+class TestWorkerServeRedial:
+    def test_newer_router_connection_supersedes_typed(self, monkeypatch):
+        """An advertised worker keeps accepting while a connection is
+        live: the NEWEST handshake wins, the superseded link gets one
+        typed final err frame, and the shared service carries over (no
+        rebuild between connections)."""
+        from sentio_tpu.runtime import worker as worker_mod
+
+        monkeypatch.setattr(worker_mod, "_resolve_factory",
+                            lambda path: _fake_factory)
+        spec = worker_mod.WorkerSpec(
+            auth_token="serve-token", status_interval_s=0.05,
+            telemetry_interval_s=0.0)
+        stop = threading.Event()
+        bound: dict = {}
+        ready = threading.Event()
+
+        def on_bound(addr):
+            bound["addr"] = addr
+            ready.set()
+
+        server = threading.Thread(
+            target=worker_mod.worker_serve,
+            args=("127.0.0.1", 0, spec, stop, on_bound),
+            name="worker-serve-drill", daemon=True)
+        server.start()
+        t1 = t2 = None
+        try:
+            assert ready.wait(timeout=10)
+            def recv_kind(t, kind, timeout_s=10.0):
+                from sentio_tpu.runtime.transport import TransportError
+                deadline = time.monotonic() + timeout_s
+                while time.monotonic() < deadline:
+                    try:
+                        got = t.recv(timeout_s=timeout_s)
+                    except TransportError:
+                        return None  # link cut under us
+                    if got is None:
+                        return None
+                    frame, _epoch = got
+                    if frame[1] == kind:
+                        return frame[2]
+                return None
+
+            t1 = dial(bound["addr"])
+            ack1 = send_hello(t1, "serve-token", 0, os.getpid(), epoch=1,
+                              timeout_s=5.0)
+            assert int(ack1["epoch"]) == 1
+            # the first link is live (ready + status frames flow) ...
+            assert recv_kind(t1, "ready") is not None
+            assert recv_kind(t1, "status") is not None
+            # ... when a SECOND router dials in at a higher epoch
+            t2 = dial(bound["addr"])
+            ack2 = send_hello(t2, "serve-token", 0, os.getpid(), epoch=2,
+                              timeout_s=5.0)
+            assert int(ack2["epoch"]) == 2
+            # the superseded link drains one typed final err, then dies
+            superseded = recv_kind(t1, "err")
+            assert superseded is not None, "no typed supersede frame"
+            assert superseded["cls"] == "ReplicaUnavailable"
+            assert "superseded" in superseded["message"]
+            assert superseded["retryable"] is False
+            # the new connection serves: the ready frame and status flow
+            assert recv_kind(t2, "ready") is not None
+            assert recv_kind(t2, "status") is not None
+        finally:
+            stop.set()
+            for t in (t1, t2):
+                if t is not None:
+                    t.close()
+            server.join(timeout=10)
+        assert not server.is_alive()
+        # the shared service survived the supersede and was closed ONCE,
+        # by the listener teardown — not by the connection swap
+        assert _FAKE_SINGLETON.closed is True

@@ -336,7 +336,7 @@ class TestRepoGate:
         }
         assert set(chans["worker-to-router"]["emits"]) == {
             "ready", "status", "ok", "err", "tok", "end", "telemetry",
-            "pong",
+            "pong", "deregister",
         }
         assert "generate" in chans["router-to-worker"]["emits"]
         assert "__shutdown__" in chans["router-to-worker"]["emits"]
